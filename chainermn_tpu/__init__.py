@@ -1,0 +1,35 @@
+"""chainermn_tpu — TPU-native distributed training framework.
+
+A ground-up rebuild of ChainerMN's capability set (reference:
+``gshuichi/chainermn``; see SURVEY.md) designed for TPU hardware: collectives
+are XLA ops over a ``jax.sharding.Mesh`` (ICI), object traffic rides the
+process-space side channel (DCN), and the training step is one fused jitted
+program. Facade parity: ``[U] chainermn/__init__.py`` (unverified cite).
+"""
+
+from chainermn_tpu.communicators import (
+    CommunicatorBase,
+    FlatCommunicator,
+    HierarchicalCommunicator,
+    MeshCommunicator,
+    NaiveCommunicator,
+    SingleNodeCommunicator,
+    TpuCommunicator,
+    TwoDimensionalCommunicator,
+    create_communicator,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CommunicatorBase",
+    "MeshCommunicator",
+    "NaiveCommunicator",
+    "FlatCommunicator",
+    "TpuCommunicator",
+    "HierarchicalCommunicator",
+    "TwoDimensionalCommunicator",
+    "SingleNodeCommunicator",
+    "create_communicator",
+    "__version__",
+]

@@ -1,0 +1,67 @@
+"""Gradient flat-packing helpers.
+
+TPU re-design of ``[U] chainermn/communicators/_memory_utility.py``
+(SURVEY.md S2.9 — unverified cite): the reference JIT-compiles CUDA kernels to
+gather many parameter gradients into one flat pinned/device buffer, cast
+fp32<->fp16, and divide by comm size. On TPU none of that needs hand-written
+kernels — XLA fuses concatenate/cast/scale into the surrounding program — so
+this module is pure tracing-level plumbing: flatten a pytree of gradient
+leaves into one buffer **per dtype** (the reference assumes homogeneous fp32;
+modern mixed bf16/f32 trees get one buffer each) and restore it.
+
+Why flat at all, when XLA could fuse per-leaf collectives? One large collective
+per dtype amortizes ICI latency exactly the way the reference's single
+``MPI_Allreduce``/``ncclAllReduce`` on the packed buffer amortizes NIC/ring
+latency, and gives the compiler one contiguous buffer to schedule around.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class _PackMeta:
+    dtype: np.dtype
+    indices: tuple[int, ...]      # positions in the original leaf list
+    shapes: tuple[tuple[int, ...], ...]
+    sizes: tuple[int, ...]
+
+
+def pack_leaves(leaves: list[jax.Array]) -> tuple[list[jax.Array], list[_PackMeta]]:
+    """Group leaves by dtype and concatenate each group into one flat buffer.
+
+    Returns (buffers, metas); ``unpack_leaves`` inverts. Order inside a buffer
+    follows original leaf order, so pack/unpack round-trips exactly.
+    """
+    by_dtype: dict[np.dtype, list[int]] = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    buffers, metas = [], []
+    for dtype, idxs in by_dtype.items():
+        group = [jnp.ravel(leaves[i]) for i in idxs]
+        buffers.append(jnp.concatenate(group) if len(group) > 1 else group[0])
+        metas.append(
+            _PackMeta(
+                dtype=dtype,
+                indices=tuple(idxs),
+                shapes=tuple(tuple(leaves[i].shape) for i in idxs),
+                sizes=tuple(int(np.prod(leaves[i].shape or (1,))) for i in idxs),
+            )
+        )
+    return buffers, metas
+
+
+def unpack_leaves(buffers: list[jax.Array], metas: list[_PackMeta]) -> list[jax.Array]:
+    n = sum(len(m.indices) for m in metas)
+    out: list = [None] * n
+    for buf, meta in zip(buffers, metas):
+        offset = 0
+        for idx, shape, size in zip(meta.indices, meta.shapes, meta.sizes):
+            out[idx] = jax.lax.dynamic_slice_in_dim(buf, offset, size).reshape(shape)
+            offset += size
+    return out

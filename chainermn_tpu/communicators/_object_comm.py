@@ -1,0 +1,230 @@
+"""Host-side (process-space) object communication.
+
+TPU-native replacement for the reference's pickle-over-MPI object transport
+(``[U] chainermn/communicators/mpi_communicator_base.py`` — ``send_obj`` /
+``bcast_obj`` / ``gather_obj`` etc., built on a ``_MessageType`` header plus
+chunked raw buffer sends; SURVEY.md S2.2, unverified cite).
+
+Design: object comm is *bootstrap/side-channel* traffic (dataset scattering,
+metric dicts, checkpoint agreement) — low rate, host side, DCN on multi-host
+pods. Three transports, picked automatically:
+
+1. **Single process** (includes every single-host TPU VM and the CPU test
+   mesh): all "ranks" share one interpreter -> identity semantics. Zero copy.
+2. **Multi-process with jax.distributed**: the coordination-service KV store
+   carries pickled chunks (the same store XLA uses to bootstrap — the analog
+   of the reference bootstrapping NCCL ids over MPI), with
+   ``multihost_utils`` array broadcast for the large-payload bcast path.
+3. **Native sidecar** (``chainermn_tpu.native.objstore``): optional C++ TCP
+   object store for high-rate obj traffic; drops in as the same interface.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+_CHUNK = 1 << 20  # KV-store values are strings; keep chunks modest.
+
+
+class SingleProcessObjectComm:
+    """Process-space object comm when there is exactly one process.
+
+    All collectives degenerate: every "process rank" is us. ``send_obj`` /
+    ``recv_obj`` still work (mailbox) so rank-agnostic library code runs
+    unchanged.
+    """
+
+    def __init__(self) -> None:
+        self.rank = 0
+        self.size = 1
+        self._mailbox: dict[tuple[int, int, int], list[Any]] = {}
+
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
+        if dest != 0:
+            raise ValueError(f"dest {dest} out of range for 1-process run")
+        self._mailbox.setdefault((0, dest, tag), []).append(obj)
+
+    def recv_obj(self, source: int, tag: int = 0) -> Any:
+        q = self._mailbox.get((source, 0, tag))
+        if not q:
+            raise RuntimeError(
+                f"recv_obj(source={source}, tag={tag}): nothing sent. "
+                "Host p2p in a single process requires a prior send_obj."
+            )
+        return q.pop(0)
+
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        return obj
+
+    def gather_obj(self, obj: Any, root: int = 0) -> list[Any]:
+        return [obj]
+
+    def allgather_obj(self, obj: Any) -> list[Any]:
+        return [obj]
+
+    def allreduce_obj(self, obj: Any, reduce_func: Callable | None = None) -> Any:
+        return obj
+
+    def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
+        if objs is None:
+            raise ValueError("root must supply the sequence to scatter")
+        if len(objs) != 1:
+            raise ValueError(f"scatter_obj needs len == size (1), got {len(objs)}")
+        return objs[0]
+
+    def barrier(self) -> None:
+        pass
+
+
+class KVStoreObjectComm:
+    """Process-space object comm over jax.distributed's coordination KV store.
+
+    Chunked pickled payloads with a tiny length header — the same
+    header-then-chunks shape as the reference's ``_MessageType`` protocol,
+    re-hosted on the KV store instead of MPI messages.
+
+    Key freshness: collective ops use a per-instance, per-op counter that every
+    process advances identically (SPMD host code calls collectives in the same
+    order everywhere — the same assumption MPI collectives make). Point-to-point
+    ops use a per-(src, dst, tag) sequence advanced by both endpoints of the
+    pair, so uninvolved processes never desynchronize. Instances are numbered
+    by construction order (again identical across SPMD processes), so two
+    communicators never share a key namespace. Each writer deletes its
+    *previous* round's keys when starting the next one — one-epoch-lagged GC
+    that never races readers of the current epoch.
+    """
+
+    _instance_counter = 0
+
+    def __init__(self) -> None:
+        self.rank = jax.process_index()
+        self.size = jax.process_count()
+        from jax._src import distributed  # KV store client (no public alias yet)
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "Multi-process object communication requires "
+                "jax.distributed.initialize() (the reference requires "
+                "mpiexec for the same reason)."
+            )
+        self._client = client
+        self._uid = KVStoreObjectComm._instance_counter
+        KVStoreObjectComm._instance_counter += 1
+        self._op_seq: dict[str, int] = {}
+        self._p2p_seq: dict[tuple[int, int, int], int] = {}
+
+    # -- chunked byte transport over the KV store ----------------------- #
+
+    def _put(self, key: str, payload: bytes) -> None:
+        import base64
+
+        n = max(1, (len(payload) + _CHUNK - 1) // _CHUNK)
+        self._client.key_value_set(f"{key}/hdr", f"{len(payload)}:{n}")
+        for i in range(n):
+            chunk = payload[i * _CHUNK : (i + 1) * _CHUNK]
+            self._client.key_value_set(
+                f"{key}/{i}", base64.b64encode(chunk).decode("ascii")
+            )
+
+    def _get(self, key: str, timeout_ms: int = 600_000) -> bytes:
+        import base64
+
+        hdr = self._client.blocking_key_value_get(f"{key}/hdr", timeout_ms)
+        total, n = (int(v) for v in hdr.split(":"))
+        payload = b"".join(
+            base64.b64decode(self._client.blocking_key_value_get(f"{key}/{i}", timeout_ms))
+            for i in range(n)
+        )
+        assert len(payload) == total
+        return payload
+
+    def _delete_dir(self, key_prefix: str) -> None:
+        try:  # best-effort GC; the store tolerates missing keys
+            self._client.key_value_delete(key_prefix + "/")
+        except Exception:
+            pass
+
+    def _op_key(self, op: str) -> str:
+        """Advance the collective counter for ``op``; GC the previous round."""
+        seq = self._op_seq.get(op, 0)
+        self._op_seq[op] = seq + 1
+        base = f"chainermn_tpu/obj/{self._uid}/{op}"
+        if seq > 0:
+            self._delete_dir(f"{base}/{seq - 1}")
+        return f"{base}/{seq}"
+
+    def _p2p_key(self, src: int, dst: int, tag: int) -> str:
+        pair = (src, dst, tag)
+        seq = self._p2p_seq.get(pair, 0)
+        self._p2p_seq[pair] = seq + 1
+        base = f"chainermn_tpu/obj/{self._uid}/p2p/{src}/{dst}/{tag}"
+        if seq > 0:
+            self._delete_dir(f"{base}/{seq - 1}")
+        return f"{base}/{seq}"
+
+    # -- collectives ----------------------------------------------------- #
+
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
+        self._put(self._p2p_key(self.rank, dest, tag), pickle.dumps(obj))
+
+    def recv_obj(self, source: int, tag: int = 0) -> Any:
+        return pickle.loads(self._get(self._p2p_key(source, self.rank, tag)))
+
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        key = f"{self._op_key('bcast')}/{root}"
+        if self.rank == root:
+            self._put(key, pickle.dumps(obj))
+            return obj
+        return pickle.loads(self._get(key))
+
+    def gather_obj(self, obj: Any, root: int = 0) -> list[Any] | None:
+        key = self._op_key("gather")
+        self._put(f"{key}/{self.rank}", pickle.dumps(obj))
+        if self.rank != root:
+            return None
+        return [pickle.loads(self._get(f"{key}/{r}")) for r in range(self.size)]
+
+    def allgather_obj(self, obj: Any) -> list[Any]:
+        key = self._op_key("allgather")
+        self._put(f"{key}/{self.rank}", pickle.dumps(obj))
+        return [pickle.loads(self._get(f"{key}/{r}")) for r in range(self.size)]
+
+    def allreduce_obj(self, obj: Any, reduce_func: Callable | None = None) -> Any:
+        import functools
+
+        gathered = self.allgather_obj(obj)
+        if reduce_func is None:
+            reduce_func = lambda a, b: a + b  # noqa: E731 — reference default: sum
+        return functools.reduce(reduce_func, gathered)
+
+    def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
+        key = f"{self._op_key('scatter')}/{root}"
+        if self.rank == root:
+            if objs is None or len(objs) != self.size:
+                raise ValueError("root must supply a sequence of length size")
+            for r, o in enumerate(objs):
+                self._put(f"{key}/{r}", pickle.dumps(o))
+            return objs[root]
+        return pickle.loads(self._get(f"{key}/{self.rank}"))
+
+    def barrier(self) -> None:
+        self.allgather_obj(None)
+
+
+def create_object_comm():
+    """Pick the transport for this launch (native sidecar > KV store > local)."""
+    if jax.process_count() == 1:
+        return SingleProcessObjectComm()
+    try:
+        from chainermn_tpu.native import objstore  # optional C++ sidecar
+
+        if objstore.available():
+            return objstore.NativeObjectComm()
+    except Exception:
+        pass
+    return KVStoreObjectComm()

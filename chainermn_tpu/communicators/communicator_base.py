@@ -1,0 +1,189 @@
+"""Abstract communicator contract.
+
+TPU-native re-design of ``[U] chainermn/communicators/communicator_base.py``
+(SURVEY.md S2.2 — unverified upstream-layout cite). The reference contract is
+kept name-for-name (``rank``/``size``/``intra_rank``/``inter_rank``, array and
+object collectives, ``bcast_data``, ``allreduce_grad`` /
+``multi_node_mean_grad``, ``split``) so reference-shaped training scripts carry
+over, but the execution model is inverted (DESIGN.md): a communicator owns a
+``jax.sharding.Mesh`` and its collectives are XLA ops, not byte-movers.
+
+Two calling contexts for every array collective:
+
+- **traced**: argument is a tracer inside ``shard_map``/``pjit`` over this
+  communicator's mesh -> lowers to the bare ``lax`` collective. Hot path.
+- **eager**: argument is a concrete array in **rank-major** layout — a global
+  array whose leading axis has length ``size``, slice ``i`` being "rank i's
+  array". The communicator runs a cached ``jit(shard_map(...))``. This mirrors
+  the reference's per-rank test semantics without per-rank processes.
+
+Object communication (``*_obj``) lives in *process* space (host side, DCN on a
+multi-host pod), exactly like the reference's pickle-over-MPI path
+(``[U] chainermn/communicators/mpi_communicator_base.py`` — ``_MessageType``
+header + chunked raw sends). Here it rides the jax.distributed KV store or the
+native objstore sidecar; in a single-process run it degenerates to identity.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Sequence
+
+ReduceOp = str  # 'sum' | 'mean' | 'max' | 'min' | 'prod'
+
+
+class CommunicatorBase(abc.ABC):
+    """The contract every communicator implements.
+
+    Reference parity: every public method/property of the reference's
+    ``CommunicatorBase`` has a counterpart here; additions are marked *TPU
+    extension* in their docstrings.
+    """
+
+    # ------------------------------------------------------------------ #
+    # Topology                                                            #
+    # ------------------------------------------------------------------ #
+
+    @property
+    @abc.abstractmethod
+    def size(self) -> int:
+        """Number of participants (devices along the communicator axis)."""
+
+    @property
+    @abc.abstractmethod
+    def rank(self) -> int:
+        """This *process*'s rank. In single-controller SPMD the per-device
+        rank only exists inside traced code — use :meth:`axis_index` there.
+        Host-side, ``rank`` identifies the process (0 in single-process runs),
+        which is what the reference uses it for (root checks, data loading)."""
+
+    @property
+    @abc.abstractmethod
+    def intra_rank(self) -> int:
+        """Rank within the node (reference: GPU index on the host)."""
+
+    @property
+    @abc.abstractmethod
+    def inter_rank(self) -> int:
+        """Node index (reference: host index)."""
+
+    @property
+    @abc.abstractmethod
+    def intra_size(self) -> int:
+        """Participants per node (ICI-local devices per process)."""
+
+    @property
+    @abc.abstractmethod
+    def inter_size(self) -> int:
+        """Number of nodes (processes)."""
+
+    @abc.abstractmethod
+    def axis_index(self):
+        """Traced device rank: ``lax.axis_index`` over the communicator axis.
+        Only valid inside ``shard_map``/``pjit`` over this mesh. *TPU
+        extension* — the SPMD replacement for per-process ``comm.rank``."""
+
+    # ------------------------------------------------------------------ #
+    # Array collectives (dual traced/eager — see module docstring)        #
+    # ------------------------------------------------------------------ #
+
+    @abc.abstractmethod
+    def allreduce(self, x, op: ReduceOp = "sum"):
+        """Reference ``allreduce``. Traced: ``lax.psum``/``pmax``/... Eager:
+        rank-major in, rank-major out (every slice holds the reduction)."""
+
+    @abc.abstractmethod
+    def bcast(self, x, root: int = 0):
+        """Reference ``bcast``: root's array to all ranks."""
+
+    @abc.abstractmethod
+    def gather(self, x, root: int = 0):
+        """Reference ``gather``: stacked ``[size, ...]`` result (global —
+        in SPMD "only root has it" is a sharding, not a location)."""
+
+    @abc.abstractmethod
+    def allgather(self, x):
+        """Reference ``allgather``: every rank receives all ranks' arrays."""
+
+    @abc.abstractmethod
+    def scatter(self, x, root: int = 0):
+        """Reference ``scatter``: slice ``i`` of root's ``[size, ...]`` array
+        to rank ``i``."""
+
+    @abc.abstractmethod
+    def alltoall(self, x):
+        """Reference ``alltoall``: rank i's slice j goes to rank j's slice i."""
+
+    @abc.abstractmethod
+    def send(self, x, dest: int, tag: int = 0) -> None:
+        """Host-side point-to-point send (reference MPI ``send``). For
+        *traced* p2p inside a step function use
+        :mod:`chainermn_tpu.functions` (``ppermute``-based, differentiable)."""
+
+    @abc.abstractmethod
+    def recv(self, source: int, tag: int = 0):
+        """Host-side point-to-point receive paired with :meth:`send`."""
+
+    # ------------------------------------------------------------------ #
+    # Object communication (process space, host side)                     #
+    # ------------------------------------------------------------------ #
+
+    @abc.abstractmethod
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None: ...
+
+    @abc.abstractmethod
+    def recv_obj(self, source: int, tag: int = 0) -> Any: ...
+
+    @abc.abstractmethod
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any: ...
+
+    @abc.abstractmethod
+    def gather_obj(self, obj: Any, root: int = 0) -> list[Any] | None: ...
+
+    @abc.abstractmethod
+    def allgather_obj(self, obj: Any) -> list[Any]: ...
+
+    @abc.abstractmethod
+    def allreduce_obj(self, obj: Any, reduce_func: Callable | None = None) -> Any: ...
+
+    @abc.abstractmethod
+    def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any: ...
+
+    # ------------------------------------------------------------------ #
+    # Model helpers — the data-parallel integration surface               #
+    # ------------------------------------------------------------------ #
+
+    @abc.abstractmethod
+    def bcast_data(self, params):
+        """Reference ``bcast_data(model)``: replicate a parameter pytree so
+        every participant starts identical. Here: device_put with a replicated
+        ``NamedSharding`` (+ process-0 broadcast on multi-host)."""
+
+    @abc.abstractmethod
+    def multi_node_mean_grad(self, grads, zero_fill: bool = False):
+        """Reference ``allreduce_grad`` / ``multi_node_mean_grad``: average a
+        gradient pytree across participants. Traced (the hot path — fuses into
+        the jitted train step) or eager rank-major. Strategy subclasses differ
+        ONLY in how this moves bytes, mirroring SURVEY.md S2.3-2.8."""
+
+    def allreduce_grad(self, grads, zero_fill: bool = False):
+        """Backward-compat alias (older reference name)."""
+        return self.multi_node_mean_grad(grads, zero_fill)
+
+    # ------------------------------------------------------------------ #
+    # Topology surgery                                                    #
+    # ------------------------------------------------------------------ #
+
+    @abc.abstractmethod
+    def split(self, color, key=None) -> "CommunicatorBase":
+        """Reference ``split(color, key)`` -> sub-communicator.
+
+        SPMD re-design: ``color`` is a sequence of length ``size`` assigning
+        every *device rank* a color (the reference's per-process color arg,
+        gathered). Returns a communicator whose collectives are scoped to the
+        caller-colored groups via ``axis_index_groups`` — no new bootstrap.
+        """
+
+    @abc.abstractmethod
+    def finalize(self) -> None:
+        """Release cached executables (reference: free MPI/NCCL comms)."""

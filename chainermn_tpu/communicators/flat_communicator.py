@@ -1,0 +1,20 @@
+"""Flat-buffer allreduce strategy.
+
+Parity with ``[U] chainermn/communicators/flat_communicator.py`` (SURVEY.md
+S2.3 — unverified cite): pack every gradient into ONE flat buffer, run a
+single collective, unpack and divide by size. One large ICI collective per
+dtype group amortizes launch/ring latency the way the reference's single
+``MPI_Allreduce`` amortizes NIC latency.
+"""
+
+from jax import lax
+
+from chainermn_tpu.communicators import _memory_utility
+from chainermn_tpu.communicators.mesh_communicator import MeshCommunicator
+
+
+class FlatCommunicator(MeshCommunicator):
+    def _mean_leaves_traced(self, leaves):
+        buffers, metas = _memory_utility.pack_leaves(leaves)
+        reduced = [self._t_allreduce(b, "mean") for b in buffers]
+        return _memory_utility.unpack_leaves(reduced, metas)

@@ -1,0 +1,16 @@
+"""Per-parameter allreduce strategy.
+
+Parity with ``[U] chainermn/communicators/naive_communicator.py`` (SURVEY.md
+S2.3 — unverified cite): the reference issues one ``MPI_Allreduce`` per
+parameter on whatever memory MPI can see; it is the CPU-only baseline and the
+backend every distributed test can run. Here the analog is one ``lax.pmean``
+per gradient leaf — the simplest correct strategy, and the one the CPU test
+mesh exercises. (Under jit XLA may still fuse neighbouring collectives; the
+*strategy* is "no packing", not "no fusion".)
+"""
+
+from chainermn_tpu.communicators.mesh_communicator import MeshCommunicator
+
+
+class NaiveCommunicator(MeshCommunicator):
+    pass  # base class behaviour IS the naive strategy

@@ -1,0 +1,47 @@
+"""The flagship strategy: fused flat allreduce with optional compressed dtype.
+
+TPU analog of ``[U] chainermn/communicators/pure_nccl_communicator.py``
+(SURVEY.md S2.3/S2.8 — unverified cite). The reference's pure-NCCL strategy is
+(a) one NCCL ring over ALL ranks, (b) fused pack+cast kernels so the wire
+dtype can be fp16 (``allreduce_grad_dtype``), (c) a dedicated CUDA stream.
+The TPU mapping:
+
+- (a) one collective over the whole mesh axis -> XLA's ICI allreduce;
+- (b) ``allreduce_grad_dtype='bfloat16'`` casts the packed buffer before the
+  ``psum`` and back after (divide folded in) — bf16 keeps fp32's exponent
+  range, so unlike the reference's fp16 path there is no overflow hazard;
+  XLA fuses the casts into the collective's neighbourhood, which is exactly
+  what the reference's hand-written pack+cast kernel buys;
+- (c) stream overlap -> XLA's async collectives + the double-buffering
+  optimizer option (``optimizers.py``) for explicit one-step-stale overlap.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from chainermn_tpu.communicators import _memory_utility
+from chainermn_tpu.communicators.mesh_communicator import MeshCommunicator
+
+
+class TpuCommunicator(MeshCommunicator):
+    def __init__(self, *args, allreduce_grad_dtype=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.allreduce_grad_dtype = (
+            np.dtype(allreduce_grad_dtype) if allreduce_grad_dtype else None
+        )
+
+    def _copy_strategy_state(self, sub):
+        sub.allreduce_grad_dtype = self.allreduce_grad_dtype
+
+    def _mean_leaves_traced(self, leaves):
+        buffers, metas = _memory_utility.pack_leaves(leaves)
+        wire = self.allreduce_grad_dtype
+        out = []
+        for buf in buffers:
+            orig = buf.dtype
+            if wire is not None and orig != wire:
+                buf = buf.astype(wire)
+            buf = self._t_allreduce(buf, "sum")
+            out.append(buf.astype(orig) * (1.0 / self.size))
+        return _memory_utility.unpack_leaves(out, metas)

@@ -1,0 +1,17 @@
+from chainermn_tpu.parallel.mesh import (
+    DEFAULT_AXIS,
+    INTER_AXIS,
+    INTRA_AXIS,
+    RankGeometry,
+    make_hierarchical_mesh,
+    make_mesh,
+)
+
+__all__ = [
+    "DEFAULT_AXIS",
+    "INTER_AXIS",
+    "INTRA_AXIS",
+    "RankGeometry",
+    "make_mesh",
+    "make_hierarchical_mesh",
+]

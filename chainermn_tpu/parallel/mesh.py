@@ -1,0 +1,123 @@
+"""Mesh construction and rank geometry.
+
+TPU-native equivalent of the reference's communication bootstrap utilities
+(``[U] chainermn/communicators/_communication_utility.py`` — ``init_ranks``,
+``init_intra_mpi_comm``, ``init_inter_mpi_comm``, ``init_nccl_comm``; SURVEY.md
+S2.9, unverified upstream-layout cite):
+
+- hostname-gather rank geometry            -> ``jax.devices()`` metadata
+  (``process_index`` plays the role of the hostname: devices with the same
+  process are "intra-node"/ICI-local, across processes is "inter-node"/DCN)
+- NCCL unique-id broadcast over MPI        -> handled inside ``jax.distributed``
+  (its KV store is the bootstrap side channel; nothing for us to do)
+- intra-/inter-node sub-MPI-communicators  -> factoring the device list into a
+  2-D mesh with ``inter`` x ``intra`` axes
+
+Nothing here moves bytes; a ``Mesh`` is pure metadata consumed by ``shard_map``
+/ ``pjit``, which is where XLA inserts the actual ICI/DCN collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DEFAULT_AXIS = "ranks"
+INTER_AXIS = "inter"  # across processes (DCN on multi-host pods)
+INTRA_AXIS = "intra"  # within a process (ICI-local devices)
+
+
+def _sorted_devices(devices: Sequence[jax.Device] | None) -> list[jax.Device]:
+    """Devices in (process_index, id) order so mesh rank order is stable and
+    contiguous ranks are ICI-local — the same property the reference's
+    hierarchical communicators get from hostname-sorted MPI ranks."""
+    if devices is None:
+        devices = jax.devices()
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def make_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    axis_name: str = DEFAULT_AXIS,
+) -> Mesh:
+    """One flat communicator axis over all devices (the ``pure_nccl`` shape:
+    a single collective ring over every participant)."""
+    devs = _sorted_devices(devices)
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def make_hierarchical_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    inter_axis: str = INTER_AXIS,
+    intra_axis: str = INTRA_AXIS,
+) -> Mesh:
+    """Two-level ``inter x intra`` mesh mirroring the reference's
+    intra-node / inter-node communicator split.
+
+    On a real multi-host pod the ``intra`` axis is ICI-local to each process
+    and ``inter`` crosses DCN. In a single process we factor the device count
+    into the most square (inter, intra) grid so the two-level collective
+    algorithms remain exercisable (the reference's tests likewise fake
+    multi-node with multiple ranks on one box).
+    """
+    devs = _sorted_devices(devices)
+    n = len(devs)
+    per_proc: dict[int, int] = {}
+    for d in devs:
+        per_proc[d.process_index] = per_proc.get(d.process_index, 0) + 1
+    n_proc = len(per_proc)
+    local = n // n_proc
+    if n_proc > 1 and all(v == local for v in per_proc.values()):
+        grid = (n_proc, local)
+    else:
+        # Single process (or ragged): factor n as the most square grid.
+        inter = int(np.sqrt(n))
+        while n % inter:
+            inter -= 1
+        grid = (inter, n // inter)
+    arr = np.array(devs).reshape(grid)
+    return Mesh(arr, (inter_axis, intra_axis))
+
+
+@dataclasses.dataclass(frozen=True)
+class RankGeometry:
+    """Host-side rank geometry, ChainerMN-shaped (``[U] _communication_utility.
+    init_ranks`` returned (global, intra, inter) ranks per process).
+
+    In the SPMD rebuild there are two rank spaces:
+
+    - **device ranks** (0..size-1): positions along the communicator's mesh
+      axis. Collectives operate in this space; inside traced code the current
+      device rank is ``lax.axis_index(axis)``.
+    - **process ranks** (0..process_count-1): host-side identity, used for
+      object communication, root-only logging, and data loading. This is what
+      the fields below describe for *the calling process*.
+    """
+
+    size: int            # total devices on the comm axis
+    rank: int            # this process's rank (process space)
+    intra_rank: int      # this process's rank within its node
+    inter_rank: int      # this process's node index
+    intra_size: int      # devices per process (ICI-local)
+    inter_size: int      # number of processes
+    local_device_ranks: tuple[int, ...]  # device ranks this process controls
+
+    @classmethod
+    def from_mesh(cls, mesh: Mesh) -> "RankGeometry":
+        devs = list(mesh.devices.flat)
+        pidx = jax.process_index()
+        procs = sorted({d.process_index for d in devs})
+        local = tuple(i for i, d in enumerate(devs) if d.process_index == pidx)
+        return cls(
+            size=len(devs),
+            rank=pidx,
+            intra_rank=0,  # one jax process per host in supported launches
+            inter_rank=procs.index(pidx) if pidx in procs else 0,
+            intra_size=max(1, len(local)),
+            inter_size=len(procs),
+            local_device_ranks=local,
+        )

@@ -1,0 +1,288 @@
+"""Collective semantics across every communicator strategy.
+
+Mirrors the reference's pattern of parameterizing one test body over all
+communicator classes ([U] tests/chainermn_tests/communicator_tests/
+test_communicator.py, SURVEY.md S4): numerics of each collective on small
+arrays, topology properties, object comm, and gradient averaging.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu import create_communicator
+
+STRATEGIES = ["naive", "flat", "tpu", "hierarchical", "two_dimensional", "single_node"]
+
+
+@pytest.fixture(scope="module", params=STRATEGIES)
+def comm(request):
+    return create_communicator(request.param)
+
+
+def _ranked(comm, shape=(3,), dtype=np.float32):
+    """Rank-major array: slice i is rank i's data, value depends on i."""
+    n = comm.size
+    base = np.arange(np.prod(shape), dtype=dtype).reshape(shape)
+    return np.stack([base + i for i in range(n)])
+
+
+def test_topology(comm):
+    assert comm.size == len(jax.devices())
+    assert comm.rank == 0  # single-process test harness
+    assert comm.inter_size * comm.intra_size == comm.size
+    assert 0 <= comm.intra_rank < comm.intra_size
+
+
+def test_allreduce_sum(comm):
+    x = _ranked(comm)
+    y = np.asarray(comm.allreduce(x, "sum"))
+    expected = x.sum(axis=0)
+    for r in range(comm.size):
+        np.testing.assert_allclose(y[r], expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("op", ["mean", "max", "min", "prod"])
+def test_allreduce_ops(comm, op):
+    x = _ranked(comm, shape=(2,)) * 0.5 + 1.0
+    y = np.asarray(comm.allreduce(x, op))
+    expected = getattr(x, op if op != "prod" else "prod")(axis=0)
+    for r in range(comm.size):
+        np.testing.assert_allclose(y[r], expected, rtol=1e-5)
+
+
+@pytest.mark.parametrize("root", [0, 3])
+def test_bcast(comm, root):
+    x = _ranked(comm)
+    y = np.asarray(comm.bcast(x, root=root))
+    for r in range(comm.size):
+        np.testing.assert_allclose(y[r], x[root])
+
+
+def test_gather_allgather(comm):
+    x = _ranked(comm, shape=(2, 2))
+    g = np.asarray(comm.gather(x, root=0))
+    np.testing.assert_allclose(g, x)  # stacked [size, ...]
+    ag = np.asarray(comm.allgather(x))
+    assert ag.shape == (comm.size, comm.size, 2, 2)
+    for r in range(comm.size):
+        np.testing.assert_allclose(ag[r], x)
+
+
+def test_scatter(comm):
+    n = comm.size
+    # every rank supplies the same [n, ...] table; rank r receives row r
+    table = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    x = np.broadcast_to(table, (n, n, 4))
+    y = np.asarray(comm.scatter(x, root=0))
+    for r in range(n):
+        np.testing.assert_allclose(y[r], table[r])
+
+
+def test_alltoall(comm):
+    n = comm.size
+    # x[i, j] = what rank i sends to rank j
+    x = np.arange(n * n, dtype=np.float32).reshape(n, n, 1)
+    y = np.asarray(comm.alltoall(x))
+    for i in range(n):
+        for j in range(n):
+            np.testing.assert_allclose(y[j, i], x[i, j])
+
+
+def test_ppermute_ring(comm):
+    n = comm.size
+    x = _ranked(comm)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    y = np.asarray(comm.ppermute(x, perm))
+    for r in range(n):
+        np.testing.assert_allclose(y[(r + 1) % n], x[r])
+
+
+def test_traced_collective_inside_shard_map(comm):
+    """The hot path: collectives called on tracers fuse into the program."""
+    n = comm.size
+
+    def step(x):
+        total = comm.allreduce(x, "sum")
+        rank = comm.axis_index()
+        return total + rank.astype(x.dtype)
+
+    f = jax.jit(comm.shard_map(step, in_specs=P(comm.axis_name), out_specs=P(comm.axis_name)))
+    x = jnp.arange(float(n)).reshape(n, 1)
+    y = np.asarray(f(x))
+    expected_total = float(np.arange(n).sum())
+    for r in range(n):
+        np.testing.assert_allclose(y[r], expected_total + r)
+
+
+def test_multi_node_mean_grad_eager(comm):
+    n = comm.size
+    grads = {
+        "w": np.stack([np.full((2, 3), float(i)) for i in range(n)]).astype(np.float32),
+        "b": np.stack([np.full((4,), float(2 * i)) for i in range(n)]).astype(np.float32),
+    }
+    out = comm.multi_node_mean_grad(grads)
+    mean_i = (n - 1) / 2.0
+    for r in range(n):
+        np.testing.assert_allclose(np.asarray(out["w"])[r], np.full((2, 3), mean_i), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["b"])[r], np.full((4,), 2 * mean_i), rtol=1e-6)
+
+
+def test_multi_node_mean_grad_traced_matches_naive(comm):
+    """All strategies must produce identical means (the reference's
+    communicator tests assert exactly this equivalence)."""
+    n = comm.size
+    rng = np.random.RandomState(0)
+    grads = {
+        "w": rng.randn(n, 5, 3).astype(np.float32),
+        "b": rng.randn(n, 7).astype(np.float32),
+    }
+
+    def step(g):
+        return comm.multi_node_mean_grad(g)
+
+    f = jax.jit(comm.shard_map(step, in_specs=P(comm.axis_name), out_specs=P(comm.axis_name)))
+    out = f(grads)
+    for k in grads:
+        expected = grads[k].mean(axis=0, keepdims=True)
+        for r in range(n):
+            np.testing.assert_allclose(
+                np.asarray(out[k])[r], expected[0], rtol=1e-5, atol=1e-6
+            )
+
+
+def test_mixed_dtype_grads(comm):
+    """Flat packing must handle mixed bf16/f32 trees (one buffer per dtype)."""
+    n = comm.size
+    grads = {
+        "f32": np.stack([np.full((3,), float(i)) for i in range(n)]).astype(np.float32),
+        "bf16": jnp.stack([jnp.full((5,), float(i), jnp.bfloat16) for i in range(n)]),
+    }
+    out = comm.multi_node_mean_grad(grads)
+    mean_i = (n - 1) / 2.0
+    assert out["bf16"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out["f32"])[0], np.full((3,), mean_i), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out["bf16"].astype(jnp.float32))[0], np.full((5,), mean_i), rtol=2e-2
+    )
+
+
+def test_bcast_data(comm):
+    params = {"w": np.ones((2, 2), np.float32), "b": np.zeros((3,), np.float32)}
+    out = comm.bcast_data(params)
+    assert out["w"].sharding.is_fully_replicated
+    np.testing.assert_allclose(np.asarray(out["w"]), params["w"])
+
+
+def test_obj_comm_single_process(comm):
+    assert comm.bcast_obj({"a": 1}) == {"a": 1}
+    assert comm.gather_obj([1, 2]) == [[1, 2]]
+    assert comm.allgather_obj("x") == ["x"]
+    assert comm.allreduce_obj(5) == 5
+    assert comm.scatter_obj([42]) == 42
+    comm.send_obj("hello", dest=0, tag=7)
+    assert comm.recv_obj(source=0, tag=7) == "hello"
+
+
+def test_host_send_recv(comm):
+    x = np.arange(4.0)
+    comm.send(x, dest=comm.rank, tag=1)
+    y = comm.recv(source=comm.rank, tag=1)
+    np.testing.assert_allclose(np.asarray(y), x)
+
+
+def test_host_send_rejects_device_rank(comm):
+    """Host p2p is process-space; device ranks belong to functions.send."""
+    if comm.size > 1:
+        with pytest.raises(ValueError, match="process"):
+            comm.send(np.ones(2), dest=comm.size - 1)
+
+
+def test_allreduce_grad_alias(comm):
+    n = comm.size
+    g = {"w": np.ones((n, 2), np.float32)}
+    out = comm.allreduce_grad(g)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.ones((n, 2)))
+
+
+class TestSplit:
+    def test_split_groups_allreduce(self):
+        comm = create_communicator("naive")
+        n = comm.size
+        colors = [r % 2 for r in range(n)]  # evens / odds
+        sub = comm.split(colors)
+        assert sub.size == n // 2
+        x = np.stack([np.full((2,), float(r)) for r in range(n)]).astype(np.float32)
+        y = np.asarray(sub.allreduce(x, "sum"))
+        even_sum = sum(r for r in range(n) if r % 2 == 0)
+        odd_sum = sum(r for r in range(n) if r % 2 == 1)
+        for r in range(n):
+            np.testing.assert_allclose(y[r], even_sum if r % 2 == 0 else odd_sum)
+
+    def test_split_bcast_and_mean(self):
+        comm = create_communicator("flat")
+        n = comm.size
+        half = n // 2
+        colors = [0] * half + [1] * half
+        sub = comm.split(colors)
+        x = np.stack([np.full((1,), float(r)) for r in range(n)]).astype(np.float32)
+        y = np.asarray(sub.bcast(x, root=0))  # group-local root
+        for r in range(n):
+            np.testing.assert_allclose(y[r], 0.0 if r < half else float(half))
+        m = np.asarray(sub.allreduce(x, "mean"))
+        np.testing.assert_allclose(m[0], np.mean([float(r) for r in range(half)]))
+
+    def test_split_rejects_ragged(self):
+        comm = create_communicator("naive")
+        n = comm.size
+        with pytest.raises(ValueError):
+            comm.split([0] + [1] * (n - 1))
+
+    def test_split_preserves_strategy(self):
+        """split() must keep the strategy class and its config (the reference
+        returns the same communicator class from split)."""
+        comm = create_communicator("tpu", allreduce_grad_dtype="bfloat16")
+        sub = comm.split([r % 2 for r in range(comm.size)])
+        assert type(sub) is type(comm)
+        assert sub.allreduce_grad_dtype == comm.allreduce_grad_dtype
+        n = comm.size
+        grads = {"w": np.stack([np.full((3,), float(r)) for r in range(n)]).astype(np.float32)}
+        out = np.asarray(sub.multi_node_mean_grad(grads)["w"])
+        even_mean = np.mean([r for r in range(n) if r % 2 == 0])
+        np.testing.assert_allclose(out[0], even_mean, rtol=2e-2)
+
+    def test_split_hierarchical_falls_back(self):
+        comm = create_communicator("two_dimensional")
+        sub = comm.split([r % 2 for r in range(comm.size)])
+        assert type(sub) is type(comm)
+        n = comm.size
+        grads = {"w": np.stack([np.full((2,), float(r)) for r in range(n)]).astype(np.float32)}
+        out = np.asarray(sub.multi_node_mean_grad(grads)["w"])
+        odd_mean = np.mean([r for r in range(n) if r % 2 == 1])
+        np.testing.assert_allclose(out[1], odd_mean, rtol=1e-6)
+
+
+def test_factory_names():
+    with pytest.warns(UserWarning):
+        c = create_communicator("pure_nccl")
+    assert isinstance(c, chainermn_tpu.TpuCommunicator)
+    with pytest.warns(UserWarning):
+        c = create_communicator("non_cuda_aware")
+    assert isinstance(c, chainermn_tpu.HierarchicalCommunicator)
+    with pytest.raises(ValueError):
+        create_communicator("bogus")
+    with pytest.raises(ValueError):
+        create_communicator("naive", allreduce_grad_dtype="bfloat16")
+
+
+def test_tpu_compressed_allreduce_dtype():
+    comm = create_communicator("tpu", allreduce_grad_dtype="bfloat16")
+    n = comm.size
+    grads = {"w": np.stack([np.full((3,), float(i)) for i in range(n)]).astype(np.float32)}
+    out = comm.multi_node_mean_grad(grads)
+    assert out["w"].dtype == np.float32  # cast back after the wire
+    np.testing.assert_allclose(np.asarray(out["w"])[0], (n - 1) / 2.0, rtol=2e-2)
