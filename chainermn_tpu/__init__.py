@@ -7,6 +7,14 @@ process-space side channel (DCN), and the training step is one fused jitted
 program. Facade parity: ``[U] chainermn/__init__.py`` (unverified cite).
 """
 
+from chainermn_tpu import functions
+from chainermn_tpu.datasets import (
+    create_empty_dataset,
+    scatter_dataset,
+    scatter_index,
+)
+from chainermn_tpu.evaluators import create_multi_node_evaluator
+from chainermn_tpu.optimizers import create_multi_node_optimizer
 from chainermn_tpu.communicators import (
     CommunicatorBase,
     FlatCommunicator,
@@ -31,5 +39,11 @@ __all__ = [
     "TwoDimensionalCommunicator",
     "SingleNodeCommunicator",
     "create_communicator",
+    "create_multi_node_optimizer",
+    "create_multi_node_evaluator",
+    "scatter_dataset",
+    "scatter_index",
+    "create_empty_dataset",
+    "functions",
     "__version__",
 ]

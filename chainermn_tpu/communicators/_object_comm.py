@@ -93,9 +93,16 @@ class KVStoreObjectComm:
     ops use a per-(src, dst, tag) sequence advanced by both endpoints of the
     pair, so uninvolved processes never desynchronize. Instances are numbered
     by construction order (again identical across SPMD processes), so two
-    communicators never share a key namespace. Each writer deletes its
-    *previous* round's keys when starting the next one — one-epoch-lagged GC
-    that never races readers of the current epoch.
+    communicators never share a key namespace.
+
+    GC without races: a round's keys may only be deleted once every reader has
+    consumed them. Single-reader rounds (p2p recv; gather at root) are deleted
+    by that reader immediately after consumption. Multi-reader rounds (bcast /
+    allgather / scatter) get an ``ack/<rank>`` key from each reader; the
+    round's GC owner checks acks *lazily* on its next use of the same op and
+    deletes only fully-acked rounds — unacked rounds are kept (a bounded leak
+    beats a 600s blocking-get failure on a slow process). If the store lacks
+    directory listing, GC degrades to never-delete, which is still correct.
     """
 
     _instance_counter = 0
@@ -117,6 +124,7 @@ class KVStoreObjectComm:
         KVStoreObjectComm._instance_counter += 1
         self._op_seq: dict[str, int] = {}
         self._p2p_seq: dict[tuple[int, int, int], int] = {}
+        self._pending: dict[str, list[str]] = {}  # rounds awaiting reader acks
 
     # -- chunked byte transport over the KV store ----------------------- #
 
@@ -150,22 +158,41 @@ class KVStoreObjectComm:
             pass
 
     def _op_key(self, op: str) -> str:
-        """Advance the collective counter for ``op``; GC the previous round."""
+        """Advance the collective counter for ``op`` (no GC here — see
+        class docstring for the ack-based scheme)."""
         seq = self._op_seq.get(op, 0)
         self._op_seq[op] = seq + 1
-        base = f"chainermn_tpu/obj/{self._uid}/{op}"
-        if seq > 0:
-            self._delete_dir(f"{base}/{seq - 1}")
-        return f"{base}/{seq}"
+        return f"chainermn_tpu/obj/{self._uid}/{op}/{seq}"
 
     def _p2p_key(self, src: int, dst: int, tag: int) -> str:
         pair = (src, dst, tag)
         seq = self._p2p_seq.get(pair, 0)
         self._p2p_seq[pair] = seq + 1
-        base = f"chainermn_tpu/obj/{self._uid}/p2p/{src}/{dst}/{tag}"
-        if seq > 0:
-            self._delete_dir(f"{base}/{seq - 1}")
-        return f"{base}/{seq}"
+        return f"chainermn_tpu/obj/{self._uid}/p2p/{src}/{dst}/{tag}/{seq}"
+
+    # -- ack-based lazy GC ---------------------------------------------- #
+
+    def _ack(self, round_key: str) -> None:
+        self._client.key_value_set(f"{round_key}/ack/{self.rank}", "1")
+
+    def _gc_pending(self, op: str, expected_acks: int) -> None:
+        """Delete previously-written rounds of ``op`` whose readers have all
+        acked. Called by the round's GC owner; failures mean 'keep' (leak,
+        never race)."""
+        pend = self._pending.setdefault(op, [])
+        keep = []
+        for rk in pend:
+            done = False
+            try:
+                acks = self._client.key_value_dir_get(f"{rk}/ack/")
+                done = len(acks) >= expected_acks
+            except Exception:
+                done = False
+            if done:
+                self._delete_dir(rk)
+            else:
+                keep.append(rk)
+        self._pending[op] = keep
 
     # -- collectives ----------------------------------------------------- #
 
@@ -173,26 +200,41 @@ class KVStoreObjectComm:
         self._put(self._p2p_key(self.rank, dest, tag), pickle.dumps(obj))
 
     def recv_obj(self, source: int, tag: int = 0) -> Any:
-        return pickle.loads(self._get(self._p2p_key(source, self.rank, tag)))
+        key = self._p2p_key(source, self.rank, tag)
+        out = pickle.loads(self._get(key))
+        self._delete_dir(key)  # sole reader: immediate GC is race-free
+        return out
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
-        key = f"{self._op_key('bcast')}/{root}"
+        key = self._op_key("bcast")
         if self.rank == root:
-            self._put(key, pickle.dumps(obj))
+            self._gc_pending("bcast", self.size - 1)
+            self._put(f"{key}/payload", pickle.dumps(obj))
+            self._pending.setdefault("bcast", []).append(key)
             return obj
-        return pickle.loads(self._get(key))
+        out = pickle.loads(self._get(f"{key}/payload"))
+        self._ack(key)
+        return out
 
     def gather_obj(self, obj: Any, root: int = 0) -> list[Any] | None:
         key = self._op_key("gather")
-        self._put(f"{key}/{self.rank}", pickle.dumps(obj))
+        self._put(f"{key}/val/{self.rank}", pickle.dumps(obj))
         if self.rank != root:
             return None
-        return [pickle.loads(self._get(f"{key}/{r}")) for r in range(self.size)]
+        out = [pickle.loads(self._get(f"{key}/val/{r}")) for r in range(self.size)]
+        self._delete_dir(key)  # root is the only reader and has read all
+        return out
 
     def allgather_obj(self, obj: Any) -> list[Any]:
         key = self._op_key("allgather")
-        self._put(f"{key}/{self.rank}", pickle.dumps(obj))
-        return [pickle.loads(self._get(f"{key}/{r}")) for r in range(self.size)]
+        if self.rank == 0:
+            self._gc_pending("allgather", self.size)
+        self._put(f"{key}/val/{self.rank}", pickle.dumps(obj))
+        out = [pickle.loads(self._get(f"{key}/val/{r}")) for r in range(self.size)]
+        self._ack(key)
+        if self.rank == 0:
+            self._pending.setdefault("allgather", []).append(key)
+        return out
 
     def allreduce_obj(self, obj: Any, reduce_func: Callable | None = None) -> Any:
         import functools
@@ -203,14 +245,19 @@ class KVStoreObjectComm:
         return functools.reduce(reduce_func, gathered)
 
     def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
-        key = f"{self._op_key('scatter')}/{root}"
+        key = self._op_key("scatter")
         if self.rank == root:
             if objs is None or len(objs) != self.size:
                 raise ValueError("root must supply a sequence of length size")
+            self._gc_pending("scatter", self.size - 1)
             for r, o in enumerate(objs):
-                self._put(f"{key}/{r}", pickle.dumps(o))
+                if r != root:
+                    self._put(f"{key}/val/{r}", pickle.dumps(o))
+            self._pending.setdefault("scatter", []).append(key)
             return objs[root]
-        return pickle.loads(self._get(f"{key}/{self.rank}"))
+        out = pickle.loads(self._get(f"{key}/val/{self.rank}"))
+        self._ack(key)
+        return out
 
     def barrier(self) -> None:
         self.allgather_obj(None)

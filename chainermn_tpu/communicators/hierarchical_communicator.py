@@ -79,7 +79,20 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
                 buf = jnp.concatenate([buf, jnp.zeros((pad,), buf.dtype)])
             shard = lax.psum_scatter(buf, intra, scatter_dimension=0, tiled=True)
             shard = lax.psum(shard, inter)
-            full = lax.all_gather(shard, intra, tiled=True)
+            # Final all-gather leg, expressed as a one-hot psum. Why not
+            # lax.all_gather: JAX's VMA analysis does not mark all_gather
+            # output replicated over the gathered axis, which would force
+            # check_vma=False (or 'reduced'-annotated out_specs) onto every
+            # user's shard_map. The trade: the slab is a full-buffer-sized
+            # temporary (mostly zeros) and a ring psum over it moves ~2x the
+            # bytes of the all_gather it replaces — acceptable for a parity
+            # strategy whose slow leg is DCN anyway; switch to
+            # all_gather(..., to='reduced') once reduced out_specs are
+            # plumbed through the public API.
+            idx = lax.axis_index(intra)
+            slab = jnp.zeros((n_intra, shard.shape[0]), shard.dtype)
+            slab = lax.dynamic_update_index_in_dim(slab, shard, idx, 0)
+            full = lax.psum(slab, intra).reshape(-1)
             out.append(full[:n] * scale)
         return _memory_utility.unpack_leaves(out, metas)
 

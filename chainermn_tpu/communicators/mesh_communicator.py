@@ -254,7 +254,7 @@ class MeshCommunicator(CommunicatorBase):
                 local = jax.tree_util.tree_unflatten(
                     treedef, [l[0] for l in flat_local]
                 )
-                out = body(*local) if isinstance(local, tuple) else body(local)
+                out = body(*local)  # args is always a tuple of pytrees
                 return jax.tree_util.tree_map(lambda o: o[None, ...], out)
 
             fn = jax.jit(

@@ -1,0 +1,149 @@
+"""Dataset scattering across processes.
+
+Re-design of ``[U] chainermn/datasets/__init__.py`` (``scatter_dataset``,
+``scatter_index``) and ``[U] chainermn/datasets/empty_dataset.py``
+(SURVEY.md S2.13 — unverified cites). The reference's root rank permutes the
+index space, slices it into ``size`` near-equal ``SubDataset`` shards, and
+ships each shard to its rank over pickled MPI messages.
+
+TPU re-design: shards live in *process* space (each host process feeds its
+local devices; per-device distribution happens at ``device_put`` time via the
+batch sharding, not at dataset level). Only the *permutation* travels over the
+wire — every process holds the same underlying dataset object in the common
+launch pattern (shared filesystem / storage bucket), so shipping indices is
+enough; set ``force_transport=True`` for the reference behaviour of moving
+the actual records when only root can see the data.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+
+
+class SubDataset:
+    """An index-remapped view of a dataset (reference: chainer's SubDataset
+    as used by scatter_dataset). Supports len/getitem/iteration."""
+
+    def __init__(self, dataset, indices: Sequence[int]) -> None:
+        self._dataset = dataset
+        self._indices = np.asarray(indices, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._dataset[int(j)] for j in self._indices[i]]
+        return self._dataset[int(self._indices[i])]
+
+    def __iter__(self):
+        for j in self._indices:
+            yield self._dataset[int(j)]
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self._indices
+
+
+def scatter_index(
+    n_total: int, comm: CommunicatorBase, root: int = 0,
+    *, n_shards: Optional[int] = None, shard_id: Optional[int] = None,
+) -> tuple[int, int]:
+    """Partition ``range(n_total)`` into near-equal contiguous shards; return
+    this shard's ``(begin, end)``. Reference ``scatter_index``. The first
+    ``n_total % n_shards`` shards get one extra element."""
+    del root  # pure arithmetic: no transport needed for an index split
+    n = n_shards if n_shards is not None else max(1, comm.inter_size)
+    i = shard_id if shard_id is not None else comm.rank
+    if not 0 <= i < n:
+        raise ValueError(f"shard_id {i} out of range [0, {n})")
+    base, extra = divmod(n_total, n)
+    begin = i * base + min(i, extra)
+    end = begin + base + (1 if i < extra else 0)
+    return begin, end
+
+
+def scatter_dataset(
+    dataset,
+    comm: CommunicatorBase,
+    shuffle: bool = False,
+    root: int = 0,
+    seed: Optional[int] = None,
+    *,
+    n_shards: Optional[int] = None,
+    shard_id: Optional[int] = None,
+    force_transport: bool = False,
+):
+    """Shard ``dataset`` across processes (reference ``scatter_dataset``).
+
+    Root draws the (optionally shuffled) permutation and broadcasts it so all
+    shards are disjoint and exhaustive. By default each process keeps a
+    ``SubDataset`` view over its local ``dataset`` object; with
+    ``force_transport=True`` root ships the actual records (for sources only
+    root can read — the reference always does this, paying the transport).
+
+    ``n_shards``/``shard_id`` override the process-space geometry (used by
+    tests to emulate N ranks in one process, and by hybrid-parallel setups
+    that shard over a sub-axis).
+    """
+    n = n_shards if n_shards is not None else max(1, comm.inter_size)
+    i = shard_id if shard_id is not None else comm.rank
+    if comm.rank == root:
+        n_total = len(dataset)
+        if shuffle:
+            rng = np.random.RandomState(seed)
+            order = rng.permutation(n_total)
+        else:
+            order = np.arange(n_total)
+    else:
+        order = None
+    order = comm.bcast_obj(order, root=root)
+    n_total = len(order)
+
+    shards = []
+    for s in range(n):
+        b, e = scatter_index(n_total, comm, n_shards=n, shard_id=s)
+        shards.append(order[b:e])
+
+    if force_transport:
+        if comm.rank == root:
+            payloads = [[dataset[int(j)] for j in idx] for idx in shards]
+        else:
+            payloads = None
+        n_proc = max(1, comm.inter_size)
+        if n == n_proc and shard_id is None:
+            # aligned with process geometry: true scatter (each process
+            # receives only its shard, the reference's transport pattern)
+            local = comm.scatter_obj(payloads, root=root)
+        else:
+            # overridden geometry: ship all shards, pick locally (transport
+            # is already the expensive part; correctness over cleverness)
+            payloads = comm.bcast_obj(payloads, root=root)
+            local = payloads[i]
+        return SubDataset(local, np.arange(len(local)))
+    return SubDataset(dataset, shards[i])
+
+
+def create_empty_dataset(dataset):
+    """Zero-length placeholder with the dataset interface (reference
+    ``create_empty_dataset``): lets non-root ranks build pipelines that
+    expect a dataset object when only root holds data."""
+    return SubDataset(dataset, np.empty((0,), np.int64))
+
+
+def get_n_iterations_for_one_epoch(dataset, local_batch_size: int) -> int:
+    """ceil(len/batch) — reference helper of the same name (med confidence)."""
+    return -(-len(dataset) // local_batch_size)
+
+
+__all__ = [
+    "SubDataset",
+    "scatter_dataset",
+    "scatter_index",
+    "create_empty_dataset",
+    "get_n_iterations_for_one_epoch",
+]

@@ -1,0 +1,70 @@
+"""Multi-node evaluation.
+
+Re-design of ``[U] chainermn/evaluators/__init__.py``'s
+``create_multi_node_evaluator`` (SURVEY.md S2.14 — unverified cite): each
+rank evaluates its dataset shard, per-metric results are averaged across
+ranks, and only root's report is authoritative.
+
+Protocol: an *evaluator* is anything with an ``evaluate() -> dict`` method or
+a plain callable returning a metrics dict (the reference requires a Chainer
+``Evaluator``; we only need the result-dict contract). Metric values may be
+scalars or jax/numpy arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+
+
+def _mean_dicts(dicts: list[Mapping[str, Any]]) -> dict[str, Any]:
+    keys = list(dicts[0].keys())
+    for d in dicts[1:]:
+        if list(d.keys()) != keys:
+            raise ValueError(
+                f"evaluators returned mismatched metric keys: {keys} vs {list(d.keys())}"
+            )
+    out: dict[str, Any] = {}
+    for k in keys:
+        mean = np.mean([np.asarray(d[k]) for d in dicts], axis=0)
+        out[k] = float(mean) if mean.ndim == 0 else mean  # elementwise for arrays
+    return out
+
+
+class _MultiNodeEvaluator:
+    """Wrapper produced by :func:`create_multi_node_evaluator`."""
+
+    def __init__(self, actual_evaluator, communicator: CommunicatorBase) -> None:
+        self._evaluator = actual_evaluator
+        self._comm = communicator
+
+    def evaluate(self) -> dict[str, Any]:
+        inner = self._evaluator
+        local = inner.evaluate() if hasattr(inner, "evaluate") else inner()
+        if not isinstance(local, Mapping):
+            raise TypeError(
+                f"evaluator must return a metrics dict, got {type(local).__name__}"
+            )
+        gathered = self._comm.allgather_obj(dict(local))
+        return _mean_dicts(gathered)
+
+    __call__ = evaluate
+
+    def __getattr__(self, name):  # delegate everything else to the wrapped one
+        return getattr(self._evaluator, name)
+
+
+def create_multi_node_evaluator(actual_evaluator, communicator: CommunicatorBase):
+    """Wrap an evaluator so results are cross-rank means (reference name).
+
+    The wrapped evaluator's ``evaluate()`` is called on every process with its
+    local shard; the returned dict's values are averaged elementwise across
+    processes. All processes receive the averaged dict (root-only reporting is
+    the caller's choice, as in the reference examples)."""
+    return _MultiNodeEvaluator(actual_evaluator, communicator)
+
+
+__all__ = ["create_multi_node_evaluator"]

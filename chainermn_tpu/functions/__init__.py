@@ -1,0 +1,33 @@
+"""Differentiable communication ops (``[U] chainermn/functions/`` parity)."""
+
+from chainermn_tpu.functions.point_to_point import (
+    DelegateVariable,
+    current_rank,
+    pseudo_connect,
+    rank_context,
+    recv,
+    send,
+)
+from chainermn_tpu.functions.collective_communication import (
+    allgather,
+    allreduce,
+    alltoall,
+    bcast,
+    gather,
+    scatter,
+)
+
+__all__ = [
+    "DelegateVariable",
+    "rank_context",
+    "current_rank",
+    "send",
+    "recv",
+    "pseudo_connect",
+    "allreduce",
+    "allgather",
+    "alltoall",
+    "bcast",
+    "gather",
+    "scatter",
+]

@@ -1,0 +1,53 @@
+"""Differentiable collective communication.
+
+Re-design of ``[U] chainermn/functions/collective_communication.py``
+(SURVEY.md S2.10 — unverified cite). The reference implements each collective
+as a FunctionNode whose backward is the hand-written transposed collective
+(allgather <-> reduce-scatter-sum, alltoall <-> alltoall, bcast <-> gather+sum
+at root, scatter <-> gather). Here every forward lowers to a ``lax``
+collective, and JAX's transpose rules derive exactly those backwards — the
+tests assert the transposition property numerically.
+
+All functions are dual-context like the communicator methods: traced inside
+``shard_map`` (per-rank local values) or eager on rank-major arrays.
+"""
+
+from __future__ import annotations
+
+__all__ = ["allreduce", "allgather", "alltoall", "bcast", "gather", "scatter"]
+
+
+def allreduce(x, communicator, op: str = "sum"):
+    """Differentiable allreduce. Reference note: chainermn's differentiable
+    ``allreduce`` divides by size in backward (mean-like semantics for
+    loss-parallel training); we keep forward-op symmetry instead — the
+    backward of sum-allreduce is sum-allreduce of the cotangents, which is
+    what psum's transpose provides."""
+    return communicator.allreduce(x, op)
+
+
+def allgather(x, communicator):
+    """Differentiable allgather; backward reduces each rank's cotangent slice
+    back to its owner (reduce-scatter-sum) via all_gather's transpose."""
+    return communicator.allgather(x)
+
+
+def alltoall(x, communicator):
+    """Differentiable alltoall; backward is the transposed alltoall."""
+    return communicator.alltoall(x)
+
+
+def bcast(x, communicator, root: int = 0):
+    """Differentiable broadcast; backward sums cotangents onto root (the
+    transpose of the masked-psum forward)."""
+    return communicator.bcast(x, root)
+
+
+def gather(x, communicator, root: int = 0):
+    """Differentiable gather; backward scatters root's cotangent slices back."""
+    return communicator.gather(x, root)
+
+
+def scatter(x, communicator, root: int = 0):
+    """Differentiable scatter; backward gathers cotangents onto root."""
+    return communicator.scatter(x, root)

@@ -1,0 +1,163 @@
+"""Differentiable point-to-point communication.
+
+Re-design of ``[U] chainermn/functions/point_to_point_communication.py``
+(SURVEY.md S2.10 — unverified cite). The reference's ``send`` returns a
+zero-sized *delegate variable* that keeps the autograd edge alive across the
+process boundary, ``recv`` materializes the tensor on the peer, and their
+backwards run the *transposed* communication (send.backward receives the
+gradient, recv.backward sends it); ``pseudo_connect`` grafts the delegate onto
+another variable so disjoint per-process subgraphs backprop in a deadlock-free
+order.
+
+The SPMD inversion (DESIGN.md): both endpoints of a p2p transfer live in ONE
+traced program, so the primitive is a single ``ppermute`` whose transpose rule
+*is* the reference's hand-written transposed backward — JAX's autodiff derives
+it. What remains of the reference machinery:
+
+- ``send``/``recv`` keep their per-rank calling convention via a *rank
+  context*: code that plays logical rank r (a ``MultiNodeChainList`` branch,
+  or a user's ``with rank_context(r):`` block) calls ``send(x, comm, rank=d)``
+  and the (r, d) pair builds the static permutation.
+- The delegate variable survives as the carrier of the in-flight payload
+  between the ``send`` call site and the ``recv`` call site (in SPMD the
+  payload must travel through the program; zeros off the destination rank).
+  Its secondary reference role — ordering disconnected subgraphs — is
+  preserved by ``pseudo_connect`` via ``lax.optimization_barrier``.
+- Deadlock-freedom is structural: one program, one collective schedule, no
+  per-process blocking calls to mis-order. The reference's subtlest failure
+  mode (S3.3: mis-ordered send/recv pairs hanging in MPI) cannot be
+  expressed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_RANK_CONTEXT: list[int] = []
+
+
+@contextlib.contextmanager
+def rank_context(rank: int):
+    """Declare that the enclosed code plays logical rank ``rank``.
+
+    The SPMD replacement for "this code runs on process r": inside, ``send``/
+    ``recv`` infer their local endpoint. Nestable; ``MultiNodeChainList``
+    manages it per component.
+    """
+    _RANK_CONTEXT.append(int(rank))
+    try:
+        yield
+    finally:
+        _RANK_CONTEXT.pop()
+
+
+def current_rank() -> int:
+    if not _RANK_CONTEXT:
+        raise RuntimeError(
+            "send/recv need a logical rank: wrap the call in "
+            "`with chainermn_tpu.functions.rank_context(r):` (or use "
+            "MultiNodeChainList, which does this for you)."
+        )
+    return _RANK_CONTEXT[-1]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DelegateVariable:
+    """In-flight p2p payload + autograd edge carrier.
+
+    Parity with the reference's zero-sized delegate: holds the edge that makes
+    backward communication happen in transposed order. In SPMD it additionally
+    carries the payload itself (valid on the destination rank, zeros
+    elsewhere — a ``ppermute`` with a partial permutation yields zeros on
+    non-destinations, which is exactly the "empty variable" the reference
+    returns on the source side).
+    """
+
+    data: Any
+    src: int = dataclasses.field(metadata={"static": True})
+    dst: int = dataclasses.field(metadata={"static": True})
+
+    def tree_flatten(self):
+        return (self.data,), (self.src, self.dst)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], *aux)
+
+
+def send(x, communicator, rank: int, tag: int = 0) -> DelegateVariable:
+    """Send ``x`` from the current logical rank to ``rank``.
+
+    Returns a delegate variable; pass it to the matching ``recv`` (directly,
+    or positionally through your program the way the reference threads
+    delegates). Differentiable: the cotangent arriving at the destination is
+    routed back to ``x`` by the ppermute transpose.
+    """
+    del tag  # payloads are positional in SPMD; kept for signature parity
+    src = current_rank()
+    if not 0 <= rank < communicator.size:
+        raise ValueError(f"send: peer rank {rank} out of range [0, {communicator.size})")
+    if rank == src:
+        raise ValueError("send: source and destination rank are both "
+                         f"{src}; self-sends are the identity — drop the send")
+    moved = jax.tree_util.tree_map(
+        lambda leaf: communicator.ppermute(leaf, [(src, rank)]), x
+    )
+    return DelegateVariable(moved, src=src, dst=rank)
+
+
+def recv(communicator, rank: int, delegate_variable: DelegateVariable | None = None,
+         tag: int = 0, force_tuple: bool = False):
+    """Receive the payload sent from ``rank`` to the current logical rank.
+
+    ``delegate_variable`` is the value returned by the matching ``send``. The
+    reference's recv(comm, rank) can omit it only because MPI delivers by
+    (peer, tag) out-of-band; in one SPMD program the payload must arrive
+    through the program, so the delegate is required here — a structural
+    difference, documented, not hidden.
+    """
+    del tag
+    dst = current_rank()
+    if delegate_variable is None:
+        raise ValueError(
+            "recv requires the delegate_variable returned by the matching "
+            "send: in a single SPMD program the payload travels through the "
+            "traced graph, not out-of-band (see functions/point_to_point.py "
+            "docstring)."
+        )
+    if delegate_variable.src != rank or delegate_variable.dst != dst:
+        raise ValueError(
+            f"recv endpoint mismatch: delegate carries {delegate_variable.src}"
+            f"->{delegate_variable.dst}, recv expects {rank}->{dst}"
+        )
+    data = delegate_variable.data
+    if force_tuple and not isinstance(data, tuple):
+        return (data,)
+    return data
+
+
+def pseudo_connect(delegate_variable: DelegateVariable | None, *actual_variables):
+    """Graft a delegate's dependency onto ``actual_variables``.
+
+    Parity with the reference's ``pseudo_connect``: ensures the communication
+    captured by ``delegate_variable`` is ordered with (and its backward
+    reached from) the returned value. Implemented with
+    ``lax.optimization_barrier`` so XLA cannot reorder or DCE the transfer,
+    and the delegate's autograd edge joins the returned value's graph.
+    """
+    if delegate_variable is None:
+        return actual_variables if len(actual_variables) > 1 else actual_variables[0]
+    dleaves = jax.tree_util.tree_leaves(delegate_variable.data)
+    tied = []
+    for v in actual_variables:
+        leaves, treedef = jax.tree_util.tree_flatten(v)
+        out = lax.optimization_barrier(tuple(leaves) + tuple(dleaves))
+        tied.append(jax.tree_util.tree_unflatten(treedef, out[: len(leaves)]))
+    return tuple(tied) if len(tied) > 1 else tied[0]

@@ -1,0 +1,27 @@
+"""MLP — the reference's MNIST workload model.
+
+Parity target: the 784-[units]-[units]-10 MLP in
+``[U] examples/mnist/train_mnist.py`` (SURVEY.md S2.15 — unverified cite).
+TPU notes: compute in bfloat16 by default (params stay f32; casts fuse into
+the matmuls on the MXU), gelu instead of the reference era's relu is NOT used
+— relu kept for workload parity.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    n_units: int = 1000
+    n_out: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.compute_dtype)
+        x = nn.relu(nn.Dense(self.n_units, dtype=self.compute_dtype)(x))
+        x = nn.relu(nn.Dense(self.n_units, dtype=self.compute_dtype)(x))
+        x = nn.Dense(self.n_out, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)  # logits in f32 for a stable softmax

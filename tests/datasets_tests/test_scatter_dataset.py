@@ -1,0 +1,76 @@
+"""Dataset scattering: partition exactness (reference datasets_tests)."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu import (
+    create_communicator,
+    create_empty_dataset,
+    scatter_dataset,
+    scatter_index,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+@pytest.mark.parametrize("n_total,n_shards", [(100, 8), (7, 8), (64, 8), (13, 4)])
+def test_scatter_index_partition(comm, n_total, n_shards):
+    spans = [
+        scatter_index(n_total, comm, n_shards=n_shards, shard_id=i)
+        for i in range(n_shards)
+    ]
+    covered = []
+    for b, e in spans:
+        assert 0 <= b <= e <= n_total
+        covered.extend(range(b, e))
+    assert covered == list(range(n_total))  # disjoint, exhaustive, ordered
+    sizes = [e - b for b, e in spans]
+    assert max(sizes) - min(sizes) <= 1  # near-equal
+
+
+def test_scatter_dataset_shards_are_partition(comm):
+    data = list(range(103))
+    shards = [
+        scatter_dataset(data, comm, n_shards=8, shard_id=i) for i in range(8)
+    ]
+    all_items = sorted(x for s in shards for x in s)
+    assert all_items == data
+    assert max(len(s) for s in shards) - min(len(s) for s in shards) <= 1
+
+
+def test_scatter_dataset_shuffle_seed(comm):
+    data = list(range(50))
+    a = scatter_dataset(data, comm, shuffle=True, seed=7, n_shards=4, shard_id=0)
+    b = scatter_dataset(data, comm, shuffle=True, seed=7, n_shards=4, shard_id=0)
+    c = scatter_dataset(data, comm, shuffle=True, seed=8, n_shards=4, shard_id=0)
+    assert list(a) == list(b)
+    assert list(a) != list(c)
+    # shuffled shards still partition the whole
+    shards = [
+        scatter_dataset(data, comm, shuffle=True, seed=7, n_shards=4, shard_id=i)
+        for i in range(4)
+    ]
+    assert sorted(x for s in shards for x in s) == data
+
+
+def test_scatter_dataset_force_transport(comm):
+    data = [{"x": i} for i in range(10)]
+    shard = scatter_dataset(data, comm, force_transport=True)
+    assert list(shard) == data  # single process: root keeps everything
+
+
+def test_subdataset_interface(comm):
+    data = list(range(20))
+    shard = scatter_dataset(data, comm, n_shards=4, shard_id=1)
+    assert len(shard) == 5
+    assert shard[0] == data[shard.indices[0]]
+    assert shard[1:3] == [data[j] for j in shard.indices[1:3]]
+
+
+def test_empty_dataset(comm):
+    empty = create_empty_dataset(list(range(5)))
+    assert len(empty) == 0
+    assert list(empty) == []
