@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 ImageNet-shape training throughput (images/sec/chip).
+
+Mirrors the reference's headline workload (BASELINE.md: ChainerMN ResNet-50
+ImageNet; the 15-min/1024-GPU run sustained ~125 images/sec/GPU on P100).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is images/sec/chip divided by the reference's 125 img/s/GPU.
+
+Runs on whatever accelerator jax sees (the driver provides the real TPU);
+synthetic data — this measures the training step, not input pipelines.
+"""
+
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.models import ResNet50
+    from chainermn_tpu.training import jit_train_step
+
+    devs = jax.devices()
+    log(f"devices: {devs}")
+    n_chips = len(devs)
+
+    comm = chainermn_tpu.create_communicator("tpu", allreduce_grad_dtype="bfloat16")
+    model = ResNet50(num_classes=1000)
+
+    batch = 128 * n_chips
+    while batch >= 8:
+        try:
+            rng = jax.random.PRNGKey(0)
+            images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.bfloat16)
+            labels = jnp.zeros((batch,), jnp.int32)
+            t0 = time.time()
+            variables = model.init(rng, images[:2], train=True)
+            variables = comm.bcast_data(variables)
+            opt = chainermn_tpu.create_multi_node_optimizer(
+                optax.sgd(0.1, momentum=0.9), comm
+            )
+            opt_state = jax.device_put(opt.init(variables["params"]), comm.named_sharding())
+            log(f"init done in {time.time() - t0:.1f}s; batch={batch}")
+
+            step = jit_train_step(model, opt, comm)
+            t0 = time.time()
+            variables, opt_state, loss = jax.block_until_ready(
+                step(variables, opt_state, images, labels)
+            )
+            log(f"compile+first step: {time.time() - t0:.1f}s; loss={float(loss):.3f}")
+            for _ in range(2):  # warmup
+                variables, opt_state, loss = jax.block_until_ready(
+                    step(variables, opt_state, images, labels)
+                )
+            n_steps = 10
+            t0 = time.time()
+            for _ in range(n_steps):
+                variables, opt_state, loss = step(variables, opt_state, images, labels)
+            jax.block_until_ready(loss)
+            dt = time.time() - t0
+            imgs_per_sec = batch * n_steps / dt
+            per_chip = imgs_per_sec / n_chips
+            log(f"{n_steps} steps in {dt:.2f}s -> {imgs_per_sec:.1f} img/s total")
+            print(json.dumps({
+                "metric": "resnet50_imagenet_train_throughput",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / 125.0, 3),
+            }))
+            return
+        except Exception as e:  # OOM or shape limits: halve and retry
+            log(f"batch {batch} failed: {type(e).__name__}: {str(e)[:200]}")
+            batch //= 2
+    raise SystemExit("benchmark could not run at any batch size")
+
+
+if __name__ == "__main__":
+    main()

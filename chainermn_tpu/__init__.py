@@ -14,6 +14,11 @@ from chainermn_tpu.datasets import (
     scatter_index,
 )
 from chainermn_tpu.evaluators import create_multi_node_evaluator
+from chainermn_tpu.links import (
+    MultiNodeBatchNormalization,
+    MultiNodeChainList,
+    create_mnbn_model,
+)
 from chainermn_tpu.optimizers import create_multi_node_optimizer
 from chainermn_tpu.communicators import (
     CommunicatorBase,
@@ -41,6 +46,9 @@ __all__ = [
     "create_communicator",
     "create_multi_node_optimizer",
     "create_multi_node_evaluator",
+    "MultiNodeChainList",
+    "MultiNodeBatchNormalization",
+    "create_mnbn_model",
     "scatter_dataset",
     "scatter_index",
     "create_empty_dataset",

@@ -124,7 +124,8 @@ class KVStoreObjectComm:
         KVStoreObjectComm._instance_counter += 1
         self._op_seq: dict[str, int] = {}
         self._p2p_seq: dict[tuple[int, int, int], int] = {}
-        self._pending: dict[str, list[str]] = {}  # rounds awaiting reader acks
+        # rounds this process wrote, awaiting reader acks: op -> [(key, n_acks)]
+        self._pending: dict[str, list[tuple[str, int]]] = {}
 
     # -- chunked byte transport over the KV store ----------------------- #
 
@@ -175,13 +176,14 @@ class KVStoreObjectComm:
     def _ack(self, round_key: str) -> None:
         self._client.key_value_set(f"{round_key}/ack/{self.rank}", "1")
 
-    def _gc_pending(self, op: str, expected_acks: int) -> None:
+    def _gc_pending(self, op: str) -> None:
         """Delete previously-written rounds of ``op`` whose readers have all
-        acked. Called by the round's GC owner; failures mean 'keep' (leak,
-        never race)."""
+        acked. Every process calls this on every use of ``op`` (its pending
+        list only contains rounds *it* wrote, so ownership follows the writer
+        even when roots rotate). Failures mean 'keep' — leak, never race."""
         pend = self._pending.setdefault(op, [])
         keep = []
-        for rk in pend:
+        for rk, expected_acks in pend:
             done = False
             try:
                 acks = self._client.key_value_dir_get(f"{rk}/ack/")
@@ -191,7 +193,7 @@ class KVStoreObjectComm:
             if done:
                 self._delete_dir(rk)
             else:
-                keep.append(rk)
+                keep.append((rk, expected_acks))
         self._pending[op] = keep
 
     # -- collectives ----------------------------------------------------- #
@@ -207,10 +209,10 @@ class KVStoreObjectComm:
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
         key = self._op_key("bcast")
+        self._gc_pending("bcast")
         if self.rank == root:
-            self._gc_pending("bcast", self.size - 1)
             self._put(f"{key}/payload", pickle.dumps(obj))
-            self._pending.setdefault("bcast", []).append(key)
+            self._pending["bcast"].append((key, self.size - 1))
             return obj
         out = pickle.loads(self._get(f"{key}/payload"))
         self._ack(key)
@@ -227,13 +229,12 @@ class KVStoreObjectComm:
 
     def allgather_obj(self, obj: Any) -> list[Any]:
         key = self._op_key("allgather")
-        if self.rank == 0:
-            self._gc_pending("allgather", self.size)
+        self._gc_pending("allgather")
         self._put(f"{key}/val/{self.rank}", pickle.dumps(obj))
         out = [pickle.loads(self._get(f"{key}/val/{r}")) for r in range(self.size)]
         self._ack(key)
-        if self.rank == 0:
-            self._pending.setdefault("allgather", []).append(key)
+        if self.rank == 0:  # one designated janitor per round is enough
+            self._pending["allgather"].append((key, self.size))
         return out
 
     def allreduce_obj(self, obj: Any, reduce_func: Callable | None = None) -> Any:
@@ -246,14 +247,14 @@ class KVStoreObjectComm:
 
     def scatter_obj(self, objs: Sequence[Any] | None, root: int = 0) -> Any:
         key = self._op_key("scatter")
+        self._gc_pending("scatter")
         if self.rank == root:
             if objs is None or len(objs) != self.size:
                 raise ValueError("root must supply a sequence of length size")
-            self._gc_pending("scatter", self.size - 1)
             for r, o in enumerate(objs):
                 if r != root:
                     self._put(f"{key}/val/{r}", pickle.dumps(o))
-            self._pending.setdefault("scatter", []).append(key)
+            self._pending["scatter"].append((key, self.size - 1))
             return objs[root]
         out = pickle.loads(self._get(f"{key}/val/{self.rank}"))
         self._ack(key)

@@ -21,11 +21,11 @@ from chainermn_tpu.communicators.communicator_base import CommunicatorBase
 
 
 def _mean_dicts(dicts: list[Mapping[str, Any]]) -> dict[str, Any]:
-    keys = list(dicts[0].keys())
+    keys = sorted(dicts[0].keys())
     for d in dicts[1:]:
-        if list(d.keys()) != keys:
+        if sorted(d.keys()) != keys:  # order-insensitive; sets must match
             raise ValueError(
-                f"evaluators returned mismatched metric keys: {keys} vs {list(d.keys())}"
+                f"evaluators returned mismatched metric keys: {keys} vs {sorted(d.keys())}"
             )
     out: dict[str, Any] = {}
     for k in keys:

@@ -1,0 +1,15 @@
+"""Links: model-parallel composition & sync-BN (``[U] chainermn/links/``)."""
+
+from chainermn_tpu.links.batch_normalization import (
+    MultiNodeBatchNormalization,
+    multi_node_batch_normalization,
+)
+from chainermn_tpu.links.create_mnbn_model import create_mnbn_model
+from chainermn_tpu.links.multi_node_chain_list import MultiNodeChainList
+
+__all__ = [
+    "MultiNodeChainList",
+    "MultiNodeBatchNormalization",
+    "multi_node_batch_normalization",
+    "create_mnbn_model",
+]

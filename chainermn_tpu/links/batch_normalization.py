@@ -1,0 +1,112 @@
+"""Multi-node (cross-replica) batch normalization.
+
+Re-design of ``[U] chainermn/links/batch_normalization.py`` and the
+underlying ``[U] chainermn/functions/batch_normalization.py`` (SURVEY.md
+S2.10-2.11 — unverified cites). The reference allreduces the batch mean and
+squared-mean before normalizing, and allreduces the two stat-gradients in
+backward.
+
+TPU mapping: inside a ``shard_map``-traced step the stats reduction is a
+``psum`` over the communicator axis, and the backward reductions fall out of
+autodiff (psum's transpose). Two entry points:
+
+- :func:`multi_node_batch_normalization` — the functional form (parity with
+  the reference's FunctionNode).
+- :class:`MultiNodeBatchNormalization` — flax module, drop-in for
+  ``nn.BatchNorm`` (parity with the reference's drop-in link). Implemented
+  directly on the functional form (not nn.BatchNorm) so running-stat updates
+  also see the *global* batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def multi_node_batch_normalization(
+    x, gamma, beta, communicator, eps: float = 2e-5,
+):
+    """Normalize ``x`` with batch statistics pooled across the communicator.
+
+    ``x``: [batch, ..., features] per-rank local batch (traced under
+    shard_map), or rank-major eagerly. Returns (y, global_mean, global_var)
+    so callers can maintain running statistics.
+    """
+    axes = tuple(range(x.ndim - 1))
+    # local moments -> cross-rank mean (the reference allreduces mean and
+    # sq-mean; mathematically identical, and one fused pair of psums here)
+    mean = jnp.mean(x, axis=axes)
+    sqmean = jnp.mean(jnp.square(x), axis=axes)
+    mean = communicator.allreduce(mean, "mean")
+    sqmean = communicator.allreduce(sqmean, "mean")
+    var = sqmean - jnp.square(mean)
+    y = (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    return y, mean, var
+
+
+class MultiNodeBatchNormalization(nn.Module):
+    """Drop-in ``nn.BatchNorm`` replacement with cross-replica statistics.
+
+    Matches flax BatchNorm's interface subset the examples need:
+    ``use_running_average`` selects stored vs batch stats; running stats are
+    updated with the *global* batch moments, so evaluation is consistent
+    across replicas without an extra AllreducePersistent pass (which is still
+    provided for parity in extensions/).
+    """
+
+    communicator: Any
+    use_running_average: Optional[bool] = None
+    momentum: float = 0.9
+    epsilon: float = 2e-5
+    dtype: Optional[jnp.dtype] = None
+    use_scale: bool = True
+    use_bias: bool = True
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        # call-time value wins; constructor value is the default; absent both,
+        # train-mode batch statistics (False)
+        if use_running_average is None:
+            use_running_average = self.use_running_average
+        use_ra = bool(use_running_average) if use_running_average is not None else False
+        features = x.shape[-1]
+        gamma = (
+            self.param("scale", self.scale_init, (features,))
+            if self.use_scale else jnp.ones((features,))
+        )
+        beta = (
+            self.param("bias", self.bias_init, (features,))
+            if self.use_bias else jnp.zeros((features,))
+        )
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,))
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,))
+        )
+        if use_ra:
+            y = (x - ra_mean.value) * jax.lax.rsqrt(
+                ra_var.value + self.epsilon
+            ) * gamma + beta
+            return y.astype(self.dtype or x.dtype)
+        if self.is_initializing():
+            # shape-only pass, possibly outside any mesh trace: local stats
+            # (values are discarded; avoids requiring init under shard_map)
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+            y = (x - mean) * jax.lax.rsqrt(var + self.epsilon) * gamma + beta
+            return y.astype(self.dtype or x.dtype)
+        y, mean, var = multi_node_batch_normalization(
+            x, gamma, beta, self.communicator, eps=self.epsilon
+        )
+        m = self.momentum
+        ra_mean.value = m * ra_mean.value + (1 - m) * mean
+        ra_var.value = m * ra_var.value + (1 - m) * var
+        return y.astype(self.dtype or x.dtype)

@@ -1,0 +1,88 @@
+"""Recursive BatchNorm -> MultiNodeBatchNormalization replacement.
+
+Re-design of ``[U] chainermn/links/create_mnbn_model.py`` (SURVEY.md S2.11 —
+unverified cite): the reference walks a Chain/Sequential, replacing every
+``L.BatchNormalization`` with the multi-node link, copying hyperparameters.
+
+Flax modules are frozen dataclasses, so the walk is a reconstruct: every
+dataclass field (including inside lists/tuples/dicts) holding an
+``nn.BatchNorm`` is swapped for a hyperparameter-matched
+``MultiNodeBatchNormalization``, recursively through submodules.
+
+Limitation (documented, structural): ``@nn.compact`` modules that *construct*
+``nn.BatchNorm`` inline in ``__call__`` cannot be rewritten by walking — the
+submodule does not exist until trace time. Declare BN as a field (setup-style
+or a module attribute), as all in-repo models do, or use
+``MultiNodeBatchNormalization`` directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+
+from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
+
+
+def _convert_bn(bn: nn.BatchNorm, communicator) -> MultiNodeBatchNormalization:
+    # refuse configs MNBN cannot represent, rather than silently changing
+    # the math or the parameter tree
+    if bn.axis != -1:
+        raise ValueError(
+            f"create_mnbn_model: nn.BatchNorm(axis={bn.axis}) unsupported; "
+            "MultiNodeBatchNormalization normalizes the trailing feature axis"
+        )
+    if getattr(bn, "axis_name", None) is not None:
+        raise ValueError(
+            "create_mnbn_model: nn.BatchNorm already has axis_name set "
+            f"({bn.axis_name!r}) — it is cross-replica already; converting "
+            "would double-reduce"
+        )
+    return MultiNodeBatchNormalization(
+        communicator=communicator,
+        use_running_average=bn.use_running_average,
+        momentum=bn.momentum,
+        epsilon=bn.epsilon,
+        dtype=bn.dtype,
+        use_scale=bn.use_scale,
+        use_bias=bn.use_bias,
+        scale_init=bn.scale_init,
+        bias_init=bn.bias_init,
+        name=bn.name,
+    )
+
+
+def _walk(obj: Any, communicator) -> Any:
+    if isinstance(obj, nn.BatchNorm):
+        return _convert_bn(obj, communicator)
+    if isinstance(obj, nn.Module):
+        changes = {}
+        for f in dataclasses.fields(obj):
+            if f.name in ("name", "parent"):
+                continue
+            val = getattr(obj, f.name)
+            new = _walk(val, communicator)
+            if new is not val:
+                changes[f.name] = new
+        if changes:
+            return obj.clone(**changes)
+        return obj
+    if isinstance(obj, (list, tuple)):
+        walked = [_walk(v, communicator) for v in obj]
+        if any(w is not v for w, v in zip(walked, obj)):
+            return type(obj)(walked)
+        return obj
+    if isinstance(obj, dict):
+        walked = {k: _walk(v, communicator) for k, v in obj.items()}
+        if any(walked[k] is not obj[k] for k in obj):
+            return walked
+        return obj
+    return obj
+
+
+def create_mnbn_model(model: nn.Module, communicator) -> nn.Module:
+    """Return a copy of ``model`` with every field-declared ``nn.BatchNorm``
+    replaced by :class:`MultiNodeBatchNormalization` (reference name)."""
+    return _walk(model, communicator)

@@ -1,0 +1,194 @@
+"""Model-parallel composition: MultiNodeChainList.
+
+Re-design of ``[U] chainermn/links/multi_node_chain_list.py`` (SURVEY.md
+S2.11 — unverified cite). In the reference, every process builds its own
+chain of components; ``add_link(link, rank_in, rank_out)`` declares where each
+component's inputs come from and outputs go, and ``__call__`` interleaves
+compute with blocking MPI send/recv, relying on delegate variables to order
+the backward graph (S3.3 — the trickiest semantic in the reference, where a
+mis-ordered pair deadlocks the job).
+
+Single-controller re-design: ONE object declares the WHOLE cross-rank model —
+``add_link`` gains an explicit ``rank=`` (who owns the component), since there
+is no ambient process identity. Execution is compute-follows-data MPMD:
+
+- each component's parameters live on its rank's device (committed);
+- "send/recv" is ``jax.device_put`` of boundary tensors onto the consumer's
+  device — on TPU this is a direct ICI transfer, and its autodiff transpose
+  is the reverse transfer, which is exactly the reference's transposed
+  backward communication;
+- each component's apply is jitted separately (compilation is per-stage;
+  placement follows its committed parameters);
+- ordering needs no delegate protocol: data dependence in one Python trace
+  is total, so the reference's deadlock class is unrepresentable.
+
+Like the reference, scheduling is sequential fill-drain per batch — NO
+microbatch pipelining (upstream has none either, SURVEY.md S2.16). The
+scan+ppermute microbatched pipeline lives separately in
+``chainermn_tpu.ops.pipeline`` as a TPU extension.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Sequence
+
+import jax
+
+
+def _as_tuple(v) -> tuple:
+    if v is None:
+        return ()
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,)
+
+
+@dataclasses.dataclass
+class _Component:
+    link: Any                      # flax.linen.Module (or any (init, apply) pair)
+    rank: int                      # logical rank (mesh flat index) owning it
+    rank_in: tuple[int, ...]       # () => consumes the model inputs
+    rank_out: tuple[int, ...]      # () => contributes to the model outputs
+
+
+class MultiNodeChainList:
+    """Cross-rank model as an ordered component list (reference name).
+
+    Usage (2-rank MLP, the reference's mnist model-parallel example shape)::
+
+        model = MultiNodeChainList(comm)
+        model.add_link(MLP0(), rank=0, rank_in=None, rank_out=1)
+        model.add_link(MLP1(), rank=1, rank_in=0, rank_out=None)
+        params = model.init(key, x)
+        y = model.apply(params, x)          # differentiable end-to-end
+
+    Components execute in insertion order. ``rank_in=None`` feeds the model
+    inputs; an int/list receives the outputs previously sent toward this
+    component's rank by those ranks. ``rank_out=None`` emits a model output;
+    an int/list sends to later components on those ranks. Multi-input,
+    multi-output, and non-adjacent topologies work exactly as upstream.
+    """
+
+    def __init__(self, comm) -> None:
+        self._comm = comm
+        self._components: list[_Component] = []
+        self._apply_cache: dict[int, Any] = {}
+
+    # ------------------------------------------------------------------ #
+
+    def add_link(self, link, rank: int, rank_in=None, rank_out=None) -> None:
+        if not 0 <= rank < self._comm.size:
+            raise ValueError(f"rank {rank} out of range [0, {self._comm.size})")
+        self._components.append(
+            _Component(link, rank, _as_tuple(rank_in), _as_tuple(rank_out))
+        )
+
+    def _device(self, rank: int):
+        return list(self._comm.mesh.devices.flat)[rank]
+
+    # ------------------------------------------------------------------ #
+
+    def init(self, key, *inputs):
+        """Initialize every component's flax *variables* (params AND state
+        collections like batch_stats) on its own device; returns a list of
+        variables dicts, one per component, committed to its rank."""
+        if not self._components:
+            raise ValueError("MultiNodeChainList has no components; call add_link")
+        keys = jax.random.split(key, len(self._components))
+        variables: list[Any] = []
+
+        def call(comp, idx, args):
+            y, v = comp.link.init_with_output(keys[idx], *args)
+            variables.append(jax.device_put(v, self._device(comp.rank)))
+            return y
+
+        self._run(inputs, call)
+        return variables
+
+    def apply(self, variables: Sequence[Any], *inputs, mutable=False):
+        """Forward through all components with ICI transfers at boundaries.
+
+        Differentiable: ``jax.grad`` of a loss of the output reaches every
+        component's variables and the inputs (backward transfers reversed).
+        ``mutable`` (e.g. ``["batch_stats"]``) is forwarded to each
+        component's apply; when set, returns ``(output, updated_states)``
+        with ``updated_states`` a per-component list ({} for stateless
+        components) to merge back into ``variables``.
+        """
+        if len(variables) != len(self._components):
+            raise ValueError(
+                f"variables has {len(variables)} entries for "
+                f"{len(self._components)} components"
+            )
+        mutable_key = tuple(mutable) if isinstance(mutable, (list, tuple)) else mutable
+        updated: list[Any] = []
+
+        def call(comp, idx, args):
+            fn = self._apply_cache.get((idx, mutable_key))
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(comp.link.apply, mutable=mutable_key)
+                    if mutable_key
+                    else comp.link.apply
+                )
+                self._apply_cache[(idx, mutable_key)] = fn
+            if mutable_key:
+                y, upd = fn(variables[idx], *args)
+                updated.append(upd)
+                return y
+            return fn(variables[idx], *args)
+
+        out = self._run(inputs, call)
+        if mutable_key:
+            return out, updated
+        return out
+
+    def merge_updates(self, variables: Sequence[Any], updated: Sequence[Any]):
+        """Merge ``apply(..., mutable=...)``'s updated state collections back
+        into the per-component variables list."""
+        return [
+            {**v, **u} if u else v for v, u in zip(variables, updated)
+        ]
+
+    # ------------------------------------------------------------------ #
+
+    def _run(self, inputs, call):
+        """Forward walker. ``mailbox[(src_rank, dst_rank)]`` holds in-flight
+        tensors — the single-controller descendant of the reference's
+        delegate queue."""
+        mailbox: dict[tuple[int, int], list[Any]] = {}
+        outputs: list[Any] = []
+        for idx, comp in enumerate(self._components):
+            dev = self._device(comp.rank)
+            # gather inputs: model inputs, or queued sends from rank_in
+            if not comp.rank_in:
+                args = [jax.device_put(x, dev) for x in inputs]
+            else:
+                args = []
+                for src in comp.rank_in:
+                    q = mailbox.get((src, comp.rank))
+                    if not q:
+                        raise RuntimeError(
+                            f"component #{idx} (rank {comp.rank}) expects an "
+                            f"input from rank {src}, but nothing was sent — "
+                            "check add_link order and rank_in/rank_out wiring"
+                        )
+                    args.append(jax.device_put(q.pop(0), dev))  # <- "recv"
+            y = call(comp, idx, args)
+            # route outputs
+            if not comp.rank_out:
+                outputs.append(y)
+            else:
+                for dst in comp.rank_out:
+                    mailbox.setdefault((comp.rank, dst), []).append(y)  # <- "send"
+        undelivered = {k: len(v) for k, v in mailbox.items() if v}
+        if undelivered:
+            raise RuntimeError(
+                f"undelivered sends remain {undelivered}: a rank_out named a "
+                "rank that no later component (rank_in) consumes"
+            )
+        if not outputs:
+            raise RuntimeError("no component declared rank_out=None (model output)")
+        return outputs[0] if len(outputs) == 1 else tuple(outputs)

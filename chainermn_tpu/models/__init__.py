@@ -1,3 +1,21 @@
 from chainermn_tpu.models.mlp import MLP
+from chainermn_tpu.models.resnet import (
+    AlexNet,
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
 
-__all__ = ["MLP"]
+__all__ = [
+    "MLP",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "AlexNet",
+]

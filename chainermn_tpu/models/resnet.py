@@ -1,0 +1,140 @@
+"""ResNet family — the reference's ImageNet benchmark workload.
+
+Parity target: ``[U] examples/imagenet/models/resnet50.py`` (SURVEY.md S2.15
+— unverified cite; the reference also ships alex/googlenet example models).
+This is a fresh flax implementation tuned for TPU:
+
+- NHWC layout (TPU-native), bfloat16 compute / float32 params & BN stats:
+  casts fuse into the convs on the MXU, BN accumulates in f32;
+- ``norm`` is an injected factory, so multi-node sync-BN is
+  ``functools.partial(MultiNodeBatchNormalization, communicator=comm)``
+  instead of a post-hoc module walk (the walker in links/ still exists for
+  field-declared BN, matching the reference's ``create_mnbn_model``);
+- v1.5 downsampling (stride on the 3x3, not the 1x1) — the variant every
+  modern ImageNet ResNet-50 baseline means.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="downsample",
+            )(x)
+            residual = self.norm(name="downsample_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides),
+                name="downsample",
+            )(x)
+            residual = self.norm(name="downsample_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: ModuleDef = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    norm: Callable | None = None  # factory; None -> plain BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.compute_dtype, padding="SAME"
+        )
+        if self.norm is not None:
+            norm = functools.partial(self.norm, use_running_average=not train)
+        else:
+            norm = functools.partial(
+                nn.BatchNorm, use_running_average=not train,
+                momentum=0.9, epsilon=1e-5, dtype=self.compute_dtype,
+            )
+        x = x.astype(self.compute_dtype)
+        x = conv(self.width, (7, 7), strides=(2, 2), name="stem_conv")(x)
+        x = nn.relu(norm(name="stem_norm")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                x = self.block(
+                    filters=self.width * 2**i,
+                    strides=2 if i > 0 and j == 0 else 1,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return x
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2], block=BasicBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3], block=BasicBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3], block=BottleneckBlock)
+ResNet101 = functools.partial(ResNet, stage_sizes=[3, 4, 23, 3], block=BottleneckBlock)
+ResNet152 = functools.partial(ResNet, stage_sizes=[3, 8, 36, 3], block=BottleneckBlock)
+
+
+class AlexNet(nn.Module):
+    """Parity with the reference's examples/imagenet ``alex`` model (small,
+    era-appropriate; useful as a cheap smoke workload)."""
+
+    num_classes: int = 1000
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        x = nn.relu(nn.Conv(64, (11, 11), strides=(4, 4), dtype=dt)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(192, (5, 5), dtype=dt)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), dtype=dt)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), dtype=dt)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), dtype=dt)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=dt)(x))
+        x = nn.relu(nn.Dense(4096, dtype=dt)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))

@@ -102,6 +102,35 @@ def _double_buffering_optimizer(
     return optax.GradientTransformation(init, update)
 
 
+def create_component_wise_optimizer(
+    actual_optimizer: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Apply an optimizer independently per component of a
+    ``MultiNodeChainList`` params list.
+
+    Needed because each component's params are *committed* to its rank's
+    device; a single optax update over the whole list would jit mixed-device
+    arguments and fail. Per-component application keeps every update on its
+    owner device — the reference has the same structure implicitly (each
+    process's optimizer only sees its local sub-model, SURVEY.md S2.11/S2.12).
+    """
+
+    def init(params_list):
+        return [actual_optimizer.init(p) for p in params_list]
+
+    def update(grads_list, state_list, params_list=None):
+        if params_list is None:
+            params_list = [None] * len(grads_list)
+        updates, new_states = [], []
+        for g, s, p in zip(grads_list, state_list, params_list):
+            u, ns = actual_optimizer.update(g, s, p)
+            updates.append(u)
+            new_states.append(ns)
+        return updates, new_states
+
+    return optax.GradientTransformation(init, update)
+
+
 def wait_double_buffering(state: _DoubleBufferState) -> Any:
     """Flush helper: the stale mean still pending in ``state`` (apply it
     manually after the last step if you need exact parity with non-buffered

@@ -1,0 +1,93 @@
+"""Canonical data-parallel training step.
+
+The reference's per-step control flow lives in Chainer's Trainer/Updater
+(SURVEY.md S1: ChainerMN only wraps the optimizer hook, S3.2). In the TPU
+rebuild the equivalent "hot loop contract" is a single jitted SPMD program:
+forward + backward + cross-rank gradient mean + optimizer update + BN-stat
+sync, built here once and reused by bench.py, the examples, and
+``__graft_entry__``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+
+
+def make_classification_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    comm: CommunicatorBase,
+    train_kwargs: Optional[dict] = None,
+) -> Callable:
+    """Build the per-rank step body (to be wrapped by :func:`jit_train_step`).
+
+    ``variables`` is a flax variables dict ({'params', 'batch_stats', ...});
+    mutable collections (BN running stats) are updated from the local batch
+    and then cross-rank averaged inside the step, so evaluation state is
+    replica-consistent by construction (the reference needs a separate
+    AllreducePersistent pass for this; we keep that extension for parity but
+    the canonical step doesn't need it).
+    """
+    train_kwargs = dict(train_kwargs or {})
+
+    def step(variables, opt_state, images, labels):
+        params = variables["params"]
+        rest = {k: v for k, v in variables.items() if k != "params"}
+        mutable = list(rest.keys())
+
+        def loss_fn(p):
+            out = model.apply(
+                {"params": p, **rest}, images, mutable=mutable, **train_kwargs
+            )
+            logits, updated = out if mutable else (out, {})
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            return loss, updated
+
+        (loss, updated), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # replica-consistent mutable state (BN running stats are tiny; one
+        # extra small collective per step)
+        synced = {
+            k: jax.tree_util.tree_map(lambda a: comm.allreduce(a, "mean"), v)
+            for k, v in updated.items()
+        }
+        new_variables = {"params": params, **synced}
+        return new_variables, opt_state, comm.allreduce(loss, "mean")
+
+    return step
+
+
+def jit_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    comm: CommunicatorBase,
+    donate: bool = True,
+    train_kwargs: Optional[dict] = None,
+) -> Callable:
+    """The full jitted SPMD train step over the communicator's mesh.
+
+    Call as ``step(variables, opt_state, images, labels)`` with ``variables``/
+    ``opt_state`` replicated and the batch rank-major (leading axis = global
+    batch, sharded over the mesh). Buffer donation keeps params/opt-state
+    updates in-place on HBM (the reference's grow-only arenas play this role,
+    SURVEY.md S2.9).
+    """
+    body = make_classification_train_step(model, optimizer, comm, train_kwargs)
+    data = comm.data_spec
+    sm = comm.shard_map(
+        body,
+        in_specs=(P(), P(), data, data),
+        out_specs=(P(), P(), P()),
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(sm, donate_argnums=donate_argnums)

@@ -1,0 +1,134 @@
+"""Multi-node BatchNorm: cross-replica statistics.
+
+Mirrors ``[U] tests/chainermn_tests/links_tests/test_batch_normalization.py``
+(SURVEY.md S4). Key property: MNBN over per-rank shards == plain BN over the
+concatenated global batch, in values AND gradients.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu import (
+    MultiNodeBatchNormalization,
+    create_communicator,
+    create_mnbn_model,
+)
+from chainermn_tpu.links.batch_normalization import multi_node_batch_normalization
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+def test_functional_matches_global_bn(comm):
+    n = comm.size
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4, 6).astype(np.float32)  # rank-major: n ranks x batch 4
+    gamma = rng.rand(6).astype(np.float32) + 0.5
+    beta = rng.randn(6).astype(np.float32)
+
+    def step(xl):
+        y, mean, var = multi_node_batch_normalization(
+            xl, jnp.asarray(gamma), jnp.asarray(beta), comm
+        )
+        return y
+
+    f = jax.jit(comm.shard_map(step, in_specs=P(comm.axis_name), out_specs=P(comm.axis_name)))
+    y = np.asarray(f(x))
+
+    flat = x.reshape(-1, 6)  # the global batch
+    mean, var = flat.mean(0), flat.var(0)
+    expected = ((flat - mean) / np.sqrt(var + 2e-5) * gamma + beta).reshape(x.shape)
+    np.testing.assert_allclose(y, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_functional_gradient_matches_global_bn(comm):
+    n = comm.size
+    rng = np.random.RandomState(1)
+    x = rng.randn(n, 3, 5).astype(np.float32)
+    gamma = jnp.ones((5,))
+    beta = jnp.zeros((5,))
+
+    def loss_mn(xx):
+        def step(xl):
+            y, _, _ = multi_node_batch_normalization(xl, gamma, beta, comm)
+            return y
+        f = comm.shard_map(step, in_specs=P(comm.axis_name), out_specs=P(comm.axis_name))
+        return jnp.sum(jnp.sin(f(xx)))
+
+    def loss_global(xx):
+        flat = xx.reshape(-1, 5)
+        mean = jnp.mean(flat, 0)
+        var = jnp.mean(jnp.square(flat), 0) - mean**2
+        y = (flat - mean) * jax.lax.rsqrt(var + 2e-5)
+        return jnp.sum(jnp.sin(y.reshape(xx.shape)))
+
+    g_mn = np.asarray(jax.grad(loss_mn)(jnp.asarray(x)))
+    g_ref = np.asarray(jax.grad(loss_global)(jnp.asarray(x)))
+    np.testing.assert_allclose(g_mn, g_ref, rtol=1e-3, atol=1e-5)
+
+
+def test_module_training_and_running_stats(comm):
+    n = comm.size
+    mnbn = MultiNodeBatchNormalization(communicator=comm)
+    x = np.random.RandomState(2).randn(n, 4, 3).astype(np.float32) * 2 + 1
+
+    variables = mnbn.init(jax.random.PRNGKey(0), x[0])
+
+    def step(v, xl):
+        y, updates = mnbn.apply(v, xl, mutable=["batch_stats"])
+        return y, updates["batch_stats"]
+
+    f = jax.jit(
+        comm.shard_map(
+            step, in_specs=(P(), P(comm.axis_name)), out_specs=(P(comm.axis_name), P()),
+        )
+    )
+    y, stats = f(variables, x)
+    flat = x.reshape(-1, 3)
+    # running stats moved toward the GLOBAL batch moments
+    expected_mean = 0.1 * flat.mean(0)  # momentum 0.9, init 0
+    np.testing.assert_allclose(np.asarray(stats["mean"]), expected_mean, rtol=1e-4, atol=1e-5)
+    # normalized output: per-feature global mean ~0
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 3).mean(0), 0.0, atol=1e-4)
+
+    # inference path uses running stats, no communicator needed
+    vars2 = {"params": variables["params"], "batch_stats": stats}
+    out = mnbn.apply(vars2, x[0], use_running_average=True)
+    assert out.shape == x[0].shape
+
+
+class _BnNet(nn.Module):
+    bn: nn.Module = None
+
+    def setup(self):
+        self.dense = nn.Dense(8)
+        self.norm = self.bn if self.bn is not None else nn.BatchNorm(use_running_average=False)
+
+    def __call__(self, x):
+        return self.norm(self.dense(x))
+
+
+def test_create_mnbn_model_walker(comm):
+    base = _BnNet(bn=nn.BatchNorm(use_running_average=False, momentum=0.95, epsilon=1e-3))
+    converted = create_mnbn_model(base, comm)
+    assert isinstance(converted.bn, MultiNodeBatchNormalization)
+    assert converted.bn.momentum == 0.95
+    assert converted.bn.epsilon == 1e-3
+    # untouched modules compare equal
+    assert isinstance(converted, _BnNet)
+
+    nested = [nn.BatchNorm(use_running_average=False), nn.Dense(3)]
+    walked = create_mnbn_model(nn.Sequential(nested), comm)
+    assert isinstance(walked.layers[0], MultiNodeBatchNormalization)
+    assert isinstance(walked.layers[1], nn.Dense)
+
+
+def test_create_mnbn_model_no_bn_is_identity(comm):
+    m = nn.Dense(4)
+    assert create_mnbn_model(m, comm) is m

@@ -1,0 +1,164 @@
+"""MultiNodeChainList: cross-rank model composition.
+
+Mirrors ``[U] tests/chainermn_tests/links_tests/test_multi_node_chain_list.py``
+(SURVEY.md S4): forward equivalence with the monolithic model, gradients
+through the rank boundaries, multi-output and non-adjacent topologies, and a
+few training steps.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from chainermn_tpu import MultiNodeChainList, create_communicator
+
+
+class Stage0(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.relu(nn.Dense(16)(x))
+
+
+class Stage1(nn.Module):
+    @nn.compact
+    def __call__(self, h):
+        return nn.Dense(4)(h)
+
+
+class Mono(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(nn.relu(nn.Dense(16)(x)))
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+def _two_stage(comm):
+    m = MultiNodeChainList(comm)
+    m.add_link(Stage0(), rank=0, rank_in=None, rank_out=1)
+    m.add_link(Stage1(), rank=1, rank_in=0, rank_out=None)
+    return m
+
+
+def test_forward_matches_monolithic(comm):
+    model = _two_stage(comm)
+    x = np.random.RandomState(0).randn(8, 12).astype(np.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    y = model.apply(params, x)
+    assert y.shape == (8, 4)
+    # same math with the same weights, single device (host copies so the
+    # committed per-rank placements don't conflict in this reference calc)
+    p0, p1 = jax.device_get(params[0]), jax.device_get(params[1])
+    mono_y = Stage1().apply(p1, Stage0().apply(p0, x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(mono_y), rtol=1e-6)
+
+
+def test_params_live_on_their_ranks(comm):
+    model = _two_stage(comm)
+    x = np.zeros((2, 12), np.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    devs = list(comm.mesh.devices.flat)
+    for i, expected_dev in enumerate([devs[0], devs[1]]):
+        for leaf in jax.tree_util.tree_leaves(params[i]):
+            assert leaf.devices() == {expected_dev}, (i, leaf.devices())
+
+
+def test_gradients_cross_the_boundary(comm):
+    model = _two_stage(comm)
+    x = np.random.RandomState(1).randn(4, 12).astype(np.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    def loss(ps, xb):
+        return jnp.sum(model.apply(ps, xb) ** 2)
+
+    gp, gx = jax.grad(loss, argnums=(0, 1))(params, jnp.asarray(x))
+    for g in gp:  # every stage received a gradient
+        assert any(float(jnp.abs(l).sum()) > 0 for l in jax.tree_util.tree_leaves(g))
+    assert float(jnp.abs(gx).sum()) > 0  # and it flowed back to the input
+
+
+def test_three_stage_relay_and_training(comm):
+    m = MultiNodeChainList(comm)
+    m.add_link(Stage0(), rank=0, rank_in=None, rank_out=2)
+    m.add_link(nn.Dense(16), rank=2, rank_in=0, rank_out=3)  # non-adjacent hop
+    m.add_link(Stage1(), rank=3, rank_in=2, rank_out=None)
+    x = np.random.RandomState(2).randn(16, 12).astype(np.float32)
+    target = np.random.RandomState(3).randn(16, 4).astype(np.float32)
+    params = m.init(jax.random.PRNGKey(1), x)
+    from chainermn_tpu.optimizers import create_component_wise_optimizer
+
+    opt = create_component_wise_optimizer(optax.adam(1e-2))
+    opt_state = opt.init(params)
+
+    def loss(ps):
+        return jnp.mean((m.apply(ps, x) - target) ** 2)
+
+    l0 = float(loss(params))
+    for _ in range(25):
+        g = jax.grad(loss)(params)
+        updates, opt_state = opt.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+    assert float(loss(params)) < l0 * 0.5
+
+
+def test_multi_input_component(comm):
+    class Combine(nn.Module):
+        @nn.compact
+        def __call__(self, a, b):
+            return nn.Dense(4)(jnp.concatenate([a, b], axis=-1))
+
+    m = MultiNodeChainList(comm)
+    m.add_link(Stage0(), rank=0, rank_in=None, rank_out=2)
+    m.add_link(Stage0(), rank=1, rank_in=None, rank_out=2)
+    m.add_link(Combine(), rank=2, rank_in=[0, 1], rank_out=None)
+    x = np.random.RandomState(4).randn(4, 12).astype(np.float32)
+    params = m.init(jax.random.PRNGKey(2), x)
+    y = m.apply(params, x)
+    assert y.shape == (4, 4)
+
+
+def test_stateful_component_batch_stats(comm):
+    """Components with state collections (BatchNorm) must work — the
+    reference composes BN-bearing chains across ranks routinely."""
+
+    class BnStage(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(8)(x)
+            return nn.BatchNorm(use_running_average=False)(x)
+
+    m = MultiNodeChainList(comm)
+    m.add_link(BnStage(), rank=0, rank_in=None, rank_out=1)
+    m.add_link(Stage1(), rank=1, rank_in=0, rank_out=None)
+    x = np.random.RandomState(5).randn(6, 12).astype(np.float32) * 3 + 1
+    variables = m.init(jax.random.PRNGKey(0), x)
+    assert "batch_stats" in variables[0]
+    y, updated = m.apply(variables, x, mutable=["batch_stats"])
+    assert y.shape == (6, 4)
+    assert updated[0]["batch_stats"]  # BN stats advanced
+    assert updated[1] == {}           # stateless component untouched
+    variables = m.merge_updates(variables, updated)
+    assert "batch_stats" in variables[0]
+
+
+def test_wiring_errors(comm):
+    m = MultiNodeChainList(comm)
+    m.add_link(Stage1(), rank=1, rank_in=0, rank_out=None)  # nothing sent from 0
+    with pytest.raises(RuntimeError, match="nothing was sent"):
+        m.init(jax.random.PRNGKey(0), np.zeros((2, 16), np.float32))
+
+    m2 = MultiNodeChainList(comm)
+    m2.add_link(Stage0(), rank=0, rank_in=None, rank_out=1)  # never consumed
+    m2.add_link(Stage1(), rank=1, rank_in=None, rank_out=None)
+    with pytest.raises(RuntimeError, match="undelivered"):
+        m2.init(jax.random.PRNGKey(0), np.zeros((2, 12), np.float32))
+
+    m3 = MultiNodeChainList(comm)
+    with pytest.raises(ValueError, match="out of range"):
+        m3.add_link(Stage0(), rank=comm.size + 5)
