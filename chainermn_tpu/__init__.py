@@ -14,6 +14,17 @@ from chainermn_tpu.datasets import (
     scatter_index,
 )
 from chainermn_tpu.evaluators import create_multi_node_evaluator
+from chainermn_tpu.extensions import (
+    AllreducePersistent,
+    ObservationAggregator,
+    create_multi_node_checkpointer,
+)
+from chainermn_tpu.global_except_hook import add_hook as add_global_except_hook
+from chainermn_tpu.iterators import (
+    SerialIterator,
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
 from chainermn_tpu.links import (
     MultiNodeBatchNormalization,
     MultiNodeChainList,
@@ -52,6 +63,13 @@ __all__ = [
     "scatter_dataset",
     "scatter_index",
     "create_empty_dataset",
+    "SerialIterator",
+    "create_multi_node_iterator",
+    "create_synchronized_iterator",
+    "AllreducePersistent",
+    "ObservationAggregator",
+    "create_multi_node_checkpointer",
+    "add_global_except_hook",
     "functions",
     "__version__",
 ]

@@ -1,0 +1,22 @@
+"""Trainer-extension equivalents: persistence sync, checkpointing, metric
+aggregation (SURVEY.md S2.14).
+
+The reference plugs these into Chainer's Trainer extension protocol; the
+rebuild has no trainer object, so each extension is a plain callable/class
+the training loop invokes at its chosen interval — same contract, kwargs-
+first, no framework coupling.
+"""
+
+from chainermn_tpu.extensions.allreduce_persistent import AllreducePersistent
+from chainermn_tpu.extensions.checkpoint import (
+    MultiNodeCheckpointer,
+    create_multi_node_checkpointer,
+)
+from chainermn_tpu.extensions.observation_aggregator import ObservationAggregator
+
+__all__ = [
+    "AllreducePersistent",
+    "MultiNodeCheckpointer",
+    "create_multi_node_checkpointer",
+    "ObservationAggregator",
+]

@@ -1,0 +1,57 @@
+"""AllreducePersistent — sync non-parameter model state across replicas.
+
+Re-design of ``[U] chainermn/extensions/allreduce_persistent.py``
+(SURVEY.md S2.14 — unverified cite): the reference allreduce-means every
+``namedpersistent()`` array (BN running mean/var) so evaluation sees
+consistent statistics without MultiNodeBatchNormalization.
+
+Flax mapping: "persistents" are the non-``params`` collections of a
+variables dict (``batch_stats`` et al.). The canonical jitted train step
+(``chainermn_tpu.training``) already keeps them replica-consistent inside
+the step; this extension covers the reference workflow where per-replica
+state drifts (custom loops, eager rank-major state) and is averaged
+on demand before evaluation/checkpointing.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+
+
+class AllreducePersistent:
+    """Callable extension: average all non-params collections across ranks.
+
+    Usage::
+
+        sync = AllreducePersistent(comm)
+        variables = sync(variables)          # eager, rank-major state
+        # or inside a traced step: variables = sync(variables)
+
+    Works in both calling contexts because the communicator's ``allreduce``
+    is dual traced/eager.
+    """
+
+    # mirror of the reference extension's default trigger (every epoch);
+    # carried as metadata for loops that honor it
+    trigger = (1, "epoch")
+    priority = -100  # run after optimizer updates, like the reference
+
+    def __init__(self, communicator: CommunicatorBase) -> None:
+        self._comm = communicator
+
+    def __call__(self, variables):
+        if not isinstance(variables, dict):
+            raise TypeError(
+                f"expected a flax variables dict, got {type(variables).__name__}"
+            )
+        out = {}
+        for collection, tree in variables.items():
+            if collection == "params":
+                out[collection] = tree
+            else:
+                out[collection] = jax.tree_util.tree_map(
+                    lambda a: self._comm.allreduce(a, "mean"), tree
+                )
+        return out

@@ -1,0 +1,44 @@
+"""ObservationAggregator — cross-rank averaging of reported metrics.
+
+Re-design of the reference's ``ObservationAggregator`` extension
+(SURVEY.md S5, metrics/observability — later-version addition, med
+confidence): per-rank observation dicts (loss, accuracy, timings) are
+averaged across ranks so root's log reflects the whole job, not one shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+
+
+class ObservationAggregator:
+    """Callable: ``agg(observation_dict) -> cross-rank mean dict``.
+
+    Non-numeric values pass through from root untouched. Keys must agree
+    across ranks (they do in SPMD loops by construction).
+    """
+
+    def __init__(self, communicator: CommunicatorBase) -> None:
+        self._comm = communicator
+
+    def __call__(self, observation: Mapping[str, Any]) -> dict[str, Any]:
+        gathered = self._comm.allgather_obj(dict(observation))
+        keys = list(gathered[0].keys())
+        for d in gathered[1:]:
+            if list(d.keys()) != keys:
+                raise ValueError(
+                    f"observation keys diverged across ranks: {keys} vs {list(d.keys())}"
+                )
+        out: dict[str, Any] = {}
+        for k in keys:
+            vals = [d[k] for d in gathered]
+            if all(isinstance(v, (int, float, np.number, np.ndarray)) or hasattr(v, "shape") for v in vals):
+                mean = np.mean([np.asarray(v) for v in vals], axis=0)
+                out[k] = float(mean) if mean.ndim == 0 else mean
+            else:
+                out[k] = vals[0]
+        return out

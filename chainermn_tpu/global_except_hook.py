@@ -1,0 +1,64 @@
+"""Fail-fast global exception hook.
+
+Re-design of ``[U] chainermn/global_except_hook.py`` (SURVEY.md S2.14/S3.5 —
+unverified cite). Reference behavior: a ``sys.excepthook`` that prints the
+traceback and calls ``MPI_Abort`` on COMM_WORLD so one rank's Python
+exception kills the whole job instead of leaving the other ranks deadlocked
+inside a collective.
+
+TPU mapping: XLA collectives hang across processes exactly the way
+NCCL/MPI ones do. The abort primitive here is a hard process exit
+(``os._exit``) after flushing the traceback — in a multi-process
+``jax.distributed`` job the coordination service notices the death and the
+job scheduler tears down the remaining workers (the barrier-timeout path),
+which is the strongest abort available without an MPI runtime. Install is
+idempotent and chainable (the previous hook still runs first).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+_installed = False
+
+
+def _make_hook(prev_hook, exit_code: int):
+    def _global_except_hook(exctype, value, tb):
+        try:
+            rank = os.environ.get("JAX_PROCESS_INDEX", os.environ.get("RANK", "?"))
+            sys.stderr.write(
+                f"chainermn_tpu: uncaught exception on process {rank} — "
+                "aborting the job to avoid deadlocked collectives\n"
+            )
+            if prev_hook not in (None, sys.__excepthook__):
+                prev_hook(exctype, value, tb)  # prior hook owns the printing
+            else:
+                traceback.print_exception(exctype, value, tb)
+            sys.stderr.flush()
+            sys.stdout.flush()
+        finally:
+            # the MPI_Abort analog: die hard, never hang in atexit/teardown
+            os._exit(exit_code)
+
+    return _global_except_hook
+
+
+def add_hook(exit_code: int = 1) -> None:
+    """Install the hook (reference ``add_hook``). Idempotent.
+
+    Enabled automatically at import when ``CHAINERMN_TPU_GLOBAL_EXCEPT_HOOK=1``
+    (the reference gates on an env var likewise). Only meaningful in
+    multi-process jobs; in single-process runs a normal traceback+exit
+    happens anyway, so the hook is harmless.
+    """
+    global _installed
+    if _installed:
+        return
+    sys.excepthook = _make_hook(sys.excepthook, exit_code)
+    _installed = True
+
+
+if os.environ.get("CHAINERMN_TPU_GLOBAL_EXCEPT_HOOK", "0") == "1":
+    add_hook()

@@ -43,10 +43,13 @@ def make_classification_train_step(
         mutable = list(rest.keys())
 
         def loss_fn(p):
-            out = model.apply(
-                {"params": p, **rest}, images, mutable=mutable, **train_kwargs
-            )
-            logits, updated = out if mutable else (out, {})
+            if mutable:
+                logits, updated = model.apply(
+                    {"params": p, **rest}, images, mutable=mutable, **train_kwargs
+                )
+            else:
+                logits = model.apply({"params": p}, images, **train_kwargs)
+                updated = {}
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels
             ).mean()

@@ -1,0 +1,108 @@
+"""Checkpointer tests: save/GC/newest-common-iteration resume
+(reference extensions_tests — SURVEY.md S2.14)."""
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu import create_communicator, create_multi_node_checkpointer
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+def _state(step):
+    return {
+        "params": {"w": jnp.full((3, 3), float(step)), "b": jnp.zeros((3,))},
+        "iteration": step,
+    }
+
+
+def test_save_load_roundtrip(comm, tmp_path):
+    cp = create_multi_node_checkpointer("t", comm, path=str(tmp_path))
+    cp.save(_state(7), iteration=7)
+    loaded, it = cp.maybe_load()
+    assert it == 7
+    np.testing.assert_array_equal(loaded["params"]["w"], np.full((3, 3), 7.0))
+    assert loaded["iteration"] == 7
+
+
+def test_fresh_start_when_empty(comm, tmp_path):
+    cp = create_multi_node_checkpointer("t", comm, path=str(tmp_path))
+    sentinel = {"x": 1}
+    state, it = cp.maybe_load(sentinel)
+    assert it == 0 and state is sentinel
+
+
+def test_gc_retains_newest(comm, tmp_path):
+    cp = create_multi_node_checkpointer("t", comm, path=str(tmp_path), n_retains=3)
+    for i in range(1, 8):
+        cp.save(_state(i), iteration=i)
+    assert cp._local_iterations() == [5, 6, 7]
+    _, it = cp.maybe_load()
+    assert it == 7
+
+
+def test_newest_common_iteration_across_ranks(comm, tmp_path):
+    # emulate 2 ranks sharing a directory: rank overrides (the test-geometry
+    # escape hatch, as in scatter_dataset's n_shards/shard_id)
+    cp0 = create_multi_node_checkpointer("j", comm, path=str(tmp_path), rank=0)
+    cp1 = create_multi_node_checkpointer("j", comm, path=str(tmp_path), rank=1)
+    for i in (1, 2, 3):
+        cp0.save(_state(i), iteration=i)
+    for i in (1, 2):  # rank 1 crashed before saving iteration 3
+        cp1.save(_state(i), iteration=i)
+    # agreement must pick 2 (newest iteration both ranks hold)
+    local0 = set(cp0._local_iterations())
+    local1 = set(cp1._local_iterations())
+    assert max(local0 & local1) == 2
+
+
+def test_atomic_write_ignores_partial(comm, tmp_path):
+    cp = create_multi_node_checkpointer("t", comm, path=str(tmp_path))
+    cp.save(_state(1), iteration=1)
+    # a crashed mid-save leaves only a .tmp — must not be visible
+    orphan = cp.filename(9) + ".tmp"
+    with open(orphan, "wb") as f:
+        f.write(b"partial garbage")
+    assert cp._local_iterations() == [1]
+    # a restart sweeps the orphan away
+    cp2 = create_multi_node_checkpointer("t", comm, path=str(tmp_path))
+    assert not os.path.exists(orphan)
+    _, it = cp2.maybe_load()
+    assert it == 1
+
+
+def test_finalize_removes_all(comm, tmp_path):
+    cp = create_multi_node_checkpointer("t", comm, path=str(tmp_path))
+    cp.save(_state(1), 1)
+    cp.save(_state(2), 2)
+    cp.finalize()
+    assert cp._local_iterations() == []
+    state, it = cp.maybe_load("fresh")
+    assert (state, it) == ("fresh", 0)
+
+
+def test_iterator_state_in_snapshot(comm, tmp_path):
+    from chainermn_tpu import SerialIterator
+
+    it = SerialIterator(list(range(10)), batch_size=3, shuffle=True, seed=5)
+    next(it)
+    cp = create_multi_node_checkpointer("t", comm, path=str(tmp_path))
+    cp.save({"iterator": it.state_dict()}, iteration=1)
+    expected = [next(it) for _ in range(3)]
+
+    it2 = SerialIterator(list(range(10)), batch_size=3, shuffle=True, seed=5)
+    loaded, _ = cp.maybe_load()
+    it2.load_state_dict(loaded["iterator"])
+    assert [next(it2) for _ in range(3)] == expected
+
+
+def test_bad_name_rejected(comm, tmp_path):
+    with pytest.raises(ValueError):
+        create_multi_node_checkpointer("../evil", comm, path=str(tmp_path))

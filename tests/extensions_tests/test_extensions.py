@@ -1,0 +1,66 @@
+"""AllreducePersistent + ObservationAggregator + except hook tests
+(reference extensions_tests — SURVEY.md S2.14)."""
+
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu import (
+    AllreducePersistent,
+    ObservationAggregator,
+    create_communicator,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+class TestAllreducePersistent:
+    def test_batch_stats_averaged_rank_major(self, comm, n_devices):
+        # rank-major eager state: slice i = rank i's running stats
+        per_rank_mean = jnp.arange(n_devices, dtype=jnp.float32).reshape(-1, 1)
+        variables = {
+            "params": {"w": jnp.ones((n_devices, 2))},
+            "batch_stats": {"bn": {"mean": per_rank_mean * jnp.ones((1, 4))}},
+        }
+        synced = AllreducePersistent(comm)(variables)
+        want = float(np.arange(n_devices).mean())
+        np.testing.assert_allclose(
+            np.asarray(synced["batch_stats"]["bn"]["mean"]), want, rtol=1e-6
+        )
+        # params untouched
+        np.testing.assert_array_equal(
+            np.asarray(synced["params"]["w"]), np.ones((n_devices, 2))
+        )
+
+    def test_rejects_non_dict(self, comm):
+        with pytest.raises(TypeError):
+            AllreducePersistent(comm)(jnp.ones((4,)))
+
+
+class TestObservationAggregator:
+    def test_single_process_identity_mean(self, comm):
+        agg = ObservationAggregator(comm)
+        out = agg({"loss": 2.0, "tag": "hello"})
+        assert out["loss"] == pytest.approx(2.0)
+        assert out["tag"] == "hello"
+
+
+def test_global_except_hook_aborts_subprocess():
+    """The hook must print the traceback and hard-exit with the chosen code."""
+    code = (
+        "import chainermn_tpu\n"
+        "chainermn_tpu.add_global_except_hook(exit_code=3)\n"
+        "raise RuntimeError('boom-on-rank')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
+    )
+    assert r.returncode == 3
+    assert "boom-on-rank" in r.stderr
+    assert "aborting the job" in r.stderr

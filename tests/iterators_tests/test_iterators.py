@@ -1,0 +1,95 @@
+"""Iterator tests (reference iterators_tests — SURVEY.md S2.13)."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu import create_communicator
+from chainermn_tpu.iterators import (
+    SerialIterator,
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+class TestSerialIterator:
+    def test_epoch_sequential(self):
+        it = SerialIterator(list(range(10)), batch_size=3)
+        batches = [next(it) for _ in range(4)]
+        assert batches[0] == [0, 1, 2]
+        assert batches[3] == [9]  # final short batch flushes the epoch
+        assert it.epoch == 1 and it.is_new_epoch
+        assert next(it) == [0, 1, 2]  # repeat=True rolls over
+        assert not it.is_new_epoch
+
+    def test_no_repeat_stops(self):
+        it = SerialIterator(list(range(4)), batch_size=2, repeat=False)
+        assert next(it) == [0, 1]
+        assert next(it) == [2, 3]
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_shuffle_covers_epoch(self):
+        it = SerialIterator(list(range(12)), batch_size=5, shuffle=True, seed=0)
+        seen = []
+        while not it.is_new_epoch:
+            seen.extend(next(it))
+        assert sorted(seen) == list(range(12))
+        assert seen != list(range(12))  # actually shuffled (seed-dependent)
+
+    def test_epoch_detail(self):
+        it = SerialIterator(list(range(8)), batch_size=4)
+        next(it)
+        assert it.epoch_detail == pytest.approx(0.5)
+        next(it)
+        assert it.epoch_detail == pytest.approx(1.0)
+
+    def test_state_roundtrip(self):
+        it = SerialIterator(list(range(10)), batch_size=3, shuffle=True, seed=1)
+        next(it)
+        state = it.state_dict()
+        a = [next(it) for _ in range(3)]
+        it2 = SerialIterator(list(range(10)), batch_size=3, shuffle=True, seed=1)
+        it2.load_state_dict(state)
+        b = [next(it2) for _ in range(3)]
+        assert a == b
+        assert it.epoch == it2.epoch
+
+
+class TestMultiNodeIterator:
+    def test_master_path(self, comm):
+        base = SerialIterator(list(range(6)), batch_size=2)
+        it = create_multi_node_iterator(base, comm)
+        assert next(it) == [0, 1]
+        assert next(it) == [2, 3]
+        assert it.epoch_detail == pytest.approx(4 / 6)
+
+    def test_master_stop_propagates(self, comm):
+        base = SerialIterator(list(range(2)), batch_size=2, repeat=False)
+        it = create_multi_node_iterator(base, comm)
+        assert next(it) == [0, 1]
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_master_requires_iterator(self, comm):
+        with pytest.raises(ValueError):
+            create_multi_node_iterator(None, comm)
+
+
+class TestSynchronizedIterator:
+    def test_reseeds_in_place(self, comm):
+        a = SerialIterator(list(range(20)), batch_size=5, shuffle=True, seed=3)
+        b = SerialIterator(list(range(20)), batch_size=5, shuffle=True, seed=99)
+        sa = create_synchronized_iterator(a, comm, seed=1234)
+        sb = create_synchronized_iterator(b, comm, seed=1234)
+        # single-process: both got root's broadcast seed -> identical draws
+        assert a._seed == b._seed
+        assert next(sa) == next(sb)
+
+    def test_rejects_unseedable(self, comm):
+        with pytest.raises(TypeError):
+            create_synchronized_iterator(iter([1, 2]), comm)
