@@ -8,6 +8,7 @@ from chainermn_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
+from chainermn_tpu.models.transformer import TransformerBlock, TransformerLM
 
 __all__ = [
     "MLP",
@@ -18,4 +19,6 @@ __all__ = [
     "ResNet101",
     "ResNet152",
     "AlexNet",
+    "TransformerBlock",
+    "TransformerLM",
 ]

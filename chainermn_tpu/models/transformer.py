@@ -1,0 +1,89 @@
+"""Transformer LM — the long-context flagship family.
+
+No counterpart in the reference (it predates attention; SURVEY.md S2.16
+marks SP/CP absent) — this is the TPU-first extension workload that
+exercises sequence parallelism end to end. Design notes:
+
+- layout ``[batch, seq, heads, head_dim]``; params f32, compute bf16 by
+  default (casts fuse into the MXU matmuls);
+- attention is pluggable (``'full' | 'ring' | 'ulysses'`` from
+  :mod:`chainermn_tpu.parallel.sequence`) so the same module runs
+  single-chip or sequence-sharded inside ``comm.shard_map`` with the
+  sequence axis in the batch ``PartitionSpec``;
+- static shapes, ``nn.scan``-free explicit layer stack (layer count is a
+  Python constant — XLA sees a straight-line program it can pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chainermn_tpu.parallel.sequence import sequence_parallel_attention
+
+
+class TransformerBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    attention: str = "full"
+    sequence_axis: Optional[str] = None
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, pos_offset=0):
+        dt = self.compute_dtype
+        d_head = self.d_model // self.n_heads
+        attn_fn = sequence_parallel_attention(
+            self.attention, self.sequence_axis, causal=True
+        )
+
+        h = nn.LayerNorm(dtype=dt)(x)
+        qkv = nn.DenseGeneral((3, self.n_heads, d_head), dtype=dt, name="qkv")(h)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        o = attn_fn(q, k, v)
+        x = x + nn.DenseGeneral(self.d_model, axis=(-2, -1), dtype=dt, name="proj")(o)
+
+        h = nn.LayerNorm(dtype=dt)(x)
+        h = nn.Dense(self.d_ff, dtype=dt)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.d_model, dtype=dt)(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM. ``__call__(tokens[B, T_local], pos_offset)`` ->
+    logits ``[B, T_local, vocab]``; when sequence-sharded, ``pos_offset`` is
+    each shard's global position base (pass ``axis_index * T_local`` inside
+    the traced step)."""
+
+    vocab_size: int
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: Optional[int] = None
+    max_len: int = 65536
+    attention: str = "full"
+    sequence_axis: Optional[str] = None
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0):
+        d_ff = self.d_ff or 4 * self.d_model
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     dtype=self.compute_dtype, name="embed")(tokens)
+        pos = pos_offset + jnp.arange(tokens.shape[1])
+        x = x + nn.Embed(self.max_len, self.d_model,
+                         dtype=self.compute_dtype, name="pos_embed")(pos)[None]
+        for i in range(self.n_layers):
+            x = TransformerBlock(
+                self.d_model, self.n_heads, d_ff,
+                attention=self.attention, sequence_axis=self.sequence_axis,
+                compute_dtype=self.compute_dtype, name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)

@@ -6,6 +6,12 @@ from chainermn_tpu.parallel.mesh import (
     make_hierarchical_mesh,
     make_mesh,
 )
+from chainermn_tpu.parallel.sequence import (
+    full_attention,
+    ring_attention,
+    sequence_parallel_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "DEFAULT_AXIS",
@@ -14,4 +20,8 @@ __all__ = [
     "RankGeometry",
     "make_mesh",
     "make_hierarchical_mesh",
+    "full_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "sequence_parallel_attention",
 ]

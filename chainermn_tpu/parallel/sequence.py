@@ -1,0 +1,210 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has **no** long-context machinery (SURVEY.md S2.16/S5: it
+predates attention; its closest shape is the alltoall channel-parallel
+convolution). These are the TPU-first extensions the rebuild owes
+first-class support for long sequences:
+
+- **Ring attention** (:func:`ring_attention`): the sequence axis is sharded
+  over a mesh axis; K/V blocks rotate around the ring via ``lax.ppermute``
+  while each device's Q stays put, merging partial results with the
+  flash-attention online-softmax recurrence. Comm volume per step is one
+  K/V block over ICI neighbor links — the collective pattern overlaps with
+  the blockwise matmuls (XLA pipelines the ppermute with the einsums).
+- **Ulysses attention** (:func:`ulysses_attention`): ``lax.all_to_all``
+  re-shards from sequence-sharded to head-sharded, runs exact local
+  attention per head group, and all-to-alls back — the same collective
+  shape as the reference's channel-parallel conv example, applied to heads.
+
+Both are *traced* functions: call them inside ``shard_map``/``pjit`` over
+the communicator's mesh (e.g. via ``comm.shard_map``). Both are exact —
+they compute the same result as full attention on the gathered sequence
+(tested against the single-device reference), and both differentiate
+(``ppermute``/``all_to_all`` have transposed-communication VJPs, the same
+property the reference's differentiable collectives hand-implement).
+
+Layouts follow the TPU-friendly convention ``[batch, seq, heads, head_dim]``
+with contractions in f32 (``preferred_element_type``) so bf16 inputs hit the
+MXU without accumulating in bf16.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = -1e30  # finite "minus infinity": avoids inf-inf NaNs in masked rows
+
+
+def _block_attend(q, k, v, *, scale, mask, m, l, o):
+    """One flash-style block update.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
+    (m, l, o): running max [B, H, Tq], denominator [B, H, Tq], unnormalized
+    accumulator [B, Tq, H, D]. Returns updated (m, l, o).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, _NEG_BIG)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])  # [B, H, Tq, Tk]
+    l = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l, o
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Exact attention over a sequence sharded along ``axis_name``.
+
+    Args (all per-device shards, inside ``shard_map``):
+      q, k, v: ``[B, T_local, H, D]`` — the local sequence block.
+      causal: apply a causal mask over *global* positions (block offsets are
+        derived from ``lax.axis_index``; shard i holds positions
+        ``[i*T_local, (i+1)*T_local)``).
+
+    Returns ``[B, T_local, H, D]`` in ``q.dtype``.
+    """
+    if not isinstance(axis_name, str):
+        raise ValueError(
+            f"ring_attention needs a single named mesh axis, got {axis_name!r} "
+            "— use a flat communicator (e.g. 'tpu') for sequence parallelism"
+        )
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+
+    q32 = q.astype(jnp.float32)
+    # mark the accumulators as per-device state (varying over the ring axis);
+    # without it the fori_loop carry's replicated-ness changes across steps
+    _vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    m0 = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, t), jnp.float32))
+    o0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_pos = my * t + jnp.arange(t)
+
+    def body(step, carry):
+        m, l, o, kb, vb = carry
+        src = (my - step) % n  # origin rank of the block we currently hold
+        if causal:
+            k_pos = src * t + jnp.arange(t)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        m, l, o = _block_attend(q32, kb, vb, scale=scale, mask=mask, m=m, l=l, o=o)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return m, l, o, kb, vb
+
+    # k/v stay in their input dtype through the ring: the ppermute per step
+    # ships half the bytes for bf16 inputs, and _block_attend accumulates in
+    # f32 regardless (preferred_element_type + local cast)
+    m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
+    # rows with no visible keys (never happens for causal with aligned
+    # blocks, but keep the division safe)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Exact attention via all-to-all head re-sharding (DeepSpeed-Ulysses
+    collective shape, done with one XLA ``all_to_all`` each way).
+
+    Per-device shards ``[B, T_local, H, D]`` with ``H`` divisible by the
+    axis size; internally each device holds the FULL sequence for ``H/n``
+    heads, so memory per device is ``T_global * H/n`` — choose ring
+    attention instead when the full sequence per device is too large.
+    """
+    if not isinstance(axis_name, str):
+        raise ValueError(
+            f"ulysses_attention needs a single named mesh axis, got {axis_name!r} "
+            "— use a flat communicator (e.g. 'tpu') for sequence parallelism"
+        )
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"heads ({h}) must be divisible by axis size ({n})")
+
+    def to_heads(x):  # [B, T, H, D] -> [B, n*T, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_seq(x):  # [B, n*T, H/n, D] -> [B, T, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    out = full_attention(to_heads(q), to_heads(k), to_heads(v),
+                         causal=causal, scale=scale)
+    return to_seq(out)
+
+
+def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+    """Single-device exact attention, same layout/semantics — the reference
+    implementation the parallel variants are tested against, and the
+    fallback when no sequence axis is sharded."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        t, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None, :, :], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def sequence_parallel_attention(
+    kind: str,
+    axis_name: Optional[str],
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Pick an attention implementation by name: ``'ring'`` | ``'ulysses'``
+    | ``'full'``. Returns ``f(q, k, v) -> o`` for use inside a traced step."""
+    if kind == "full" or axis_name is None:
+        return functools.partial(full_attention, causal=causal, scale=scale)
+    if kind not in ("ring", "ulysses"):
+        raise ValueError(f"unknown attention kind {kind!r}; use ring|ulysses|full")
+    impl = ring_attention if kind == "ring" else ulysses_attention
+
+    def f(q, k, v):
+        try:
+            lax.axis_size(axis_name)
+        except NameError:
+            # axis not bound: we're outside shard_map (flax init, eval on a
+            # gathered sequence) — the whole sequence is local, so exact
+            # full attention IS the correct semantics (params are identical)
+            return full_attention(q, k, v, causal=causal, scale=scale)
+        return impl(q, k, v, axis_name, causal=causal, scale=scale)
+
+    return f

@@ -94,3 +94,66 @@ def jit_train_step(
     )
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(sm, donate_argnums=donate_argnums)
+
+
+def jit_lm_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    comm: CommunicatorBase,
+    shard_sequence: bool = False,
+    donate: bool = True,
+) -> Callable:
+    """Jitted next-token-prediction step for :class:`TransformerLM`-shaped
+    models. Call as ``step(params, opt_state, tokens, targets)``.
+
+    ``shard_sequence=False``: batch axis sharded over the mesh (pure DP).
+    ``shard_sequence=True``: the SEQUENCE axis is sharded (context
+    parallelism for long-context training) — build the model with
+    ``attention='ring'`` (or ``'ulysses'``) and
+    ``sequence_axis=comm.axis_name``; each shard's global position base is
+    threaded through ``pos_offset``. Gradients are averaged over the axis by
+    the multi-node optimizer either way, so params stay replicated.
+    """
+    # Mismatched model/step configs run without error but compute the wrong
+    # attention (the axis IS bound inside shard_map either way) — reject.
+    attn = getattr(model, "attention", None)
+    seq_axis = getattr(model, "sequence_axis", None)
+    if attn is not None:
+        if shard_sequence:
+            if attn not in ("ring", "ulysses") or seq_axis != comm.axis_name:
+                raise ValueError(
+                    f"shard_sequence=True needs the model built with "
+                    f"attention='ring'|'ulysses' and sequence_axis="
+                    f"{comm.axis_name!r}; got attention={attn!r}, "
+                    f"sequence_axis={seq_axis!r}"
+                )
+        elif seq_axis is not None:
+            raise ValueError(
+                f"model has sequence_axis={seq_axis!r} but shard_sequence="
+                f"False shards the batch axis — the sequence-parallel "
+                f"attention would mix different batch shards' K/V"
+            )
+
+    def body(params, opt_state, tokens, targets):
+        t_local = tokens.shape[1]
+        pos_offset = comm.axis_index() * t_local if shard_sequence else 0
+
+        def loss_fn(p):
+            logits = model.apply(p, tokens, pos_offset)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_opt_state, comm.allreduce(loss, "mean")
+
+    data = P(None, comm.axis_name) if shard_sequence else comm.data_spec
+    sm = comm.shard_map(
+        body,
+        in_specs=(P(), P(), data, data),
+        out_specs=(P(), P(), P()),
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(sm, donate_argnums=donate_argnums)

@@ -1,0 +1,82 @@
+"""Ring/Ulysses sequence parallelism: exactness vs full attention, gradients
+(TPU-first extension — SURVEY.md S2.16/S5 marks this absent upstream)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.parallel.sequence import (
+    full_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("tpu")
+
+
+def _qkv(b=2, t=32, h=8, d=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, t, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+def _sharded(comm, fn, *, causal):
+    spec = P(None, comm.axis_name)  # shard the sequence axis
+
+    def body(q, k, v):
+        return fn(q, k, v, comm.axis_name, causal=causal)
+
+    return jax.jit(comm.shard_map(body, in_specs=(spec, spec, spec),
+                                  out_specs=spec))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", [ring_attention, ulysses_attention])
+def test_matches_full_attention(comm, causal, impl):
+    q, k, v = _qkv()
+    want = full_attention(q, k, v, causal=causal)
+    got = _sharded(comm, impl, causal=causal)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", [ring_attention, ulysses_attention])
+def test_gradients_match_full_attention(comm, impl):
+    q, k, v = _qkv(t=16, h=8, d=8)
+
+    def loss_full(q, k, v):
+        return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+    sharded = _sharded(comm, impl, causal=True)
+
+    def loss_sharded(q, k, v):
+        return (sharded(q, k, v) ** 2).sum()
+
+    g_want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_ring_bf16_inputs(comm):
+    q, k, v = _qkv(t=16)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = _sharded(comm, ring_attention, causal=True)(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    want = full_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(want),
+                               atol=4e-2, rtol=4e-2)
+
+
+def test_ulysses_rejects_indivisible_heads(comm):
+    q, k, v = _qkv(h=6)
+    with pytest.raises(ValueError):
+        _sharded(comm, ulysses_attention, causal=False)(q, k, v)
