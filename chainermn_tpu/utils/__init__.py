@@ -1,0 +1,29 @@
+"""Small host-side utilities (no reference counterpart; the reference leans
+on mpi4py/chainer for these)."""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_env_platform() -> None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment, in-process.
+
+    Some containers register a PJRT plugin from ``sitecustomize`` at
+    interpreter startup and force their platform regardless of the env var.
+    Calling this before the first backend touch makes ``JAX_PLATFORMS=cpu
+    python examples/...`` (the emulated multi-device workflow) reliable.
+    No-op when the variable is unset or the backend is already initialized.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # backend already up; the env var did its job or it's too late
+
+
+__all__ = ["apply_env_platform"]

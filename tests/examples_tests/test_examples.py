@@ -1,0 +1,83 @@
+"""Smoke tests for the example scripts (SURVEY.md S2.15: the reference's
+examples are its de-facto integration tests; CI smoke-runs MNIST under
+``mpiexec -n 2`` — here each script runs as one controller over emulated
+devices)."""
+
+import os
+import subprocess
+import sys
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def run_example(
+    relpath: str,
+    args: list[str],
+    n_devices: int = 2,
+    expect_rc: int = 0,
+) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    script = os.path.join(EXAMPLES, relpath)
+    proc = subprocess.run(
+        [sys.executable, script, *args],
+        cwd=os.path.dirname(script),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == expect_rc, (
+        f"{relpath} exited rc={proc.returncode}, expected {expect_rc}\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
+    )
+    return proc
+
+
+TINY_MNIST = ["--epoch", "1", "--n-train", "512", "--n-test", "128",
+              "--unit", "32", "--batchsize", "32"]
+
+
+def test_train_mnist():
+    proc = run_example("mnist/train_mnist.py", TINY_MNIST)
+    assert "epoch   1" in proc.stdout
+
+
+def test_train_mnist_model_parallel():
+    proc = run_example("mnist/train_mnist_model_parallel.py", TINY_MNIST)
+    assert "epoch   1" in proc.stdout
+
+
+def test_train_mnist_checkpoint_crash_resume(tmp_path):
+    args = ["--epoch", "2", "--n-train", "512", "--unit", "32",
+            "--batchsize", "32", "--frequency", "2", "--out", str(tmp_path)]
+    crash = run_example(
+        "mnist/train_mnist_checkpoint.py", args + ["--stop-at", "3"],
+        expect_rc=1,
+    )
+    assert "simulated crash at iteration 3" in crash.stdout
+    resume = run_example("mnist/train_mnist_checkpoint.py", args)
+    assert "resumed from iteration 2" in resume.stdout
+
+
+def test_train_imagenet():
+    proc = run_example(
+        "imagenet/train_imagenet.py",
+        ["--arch", "resnet18", "--batchsize", "2", "--iterations", "2",
+         "--image-size", "32", "--classes", "10", "--n-synthetic", "64"],
+    )
+    assert "done: 2 iterations" in proc.stdout
+
+
+def test_train_imagenet_mnbn_double_buffering():
+    proc = run_example(
+        "imagenet/train_imagenet.py",
+        ["--arch", "resnet18", "--batchsize", "2", "--iterations", "2",
+         "--image-size", "32", "--classes", "10", "--n-synthetic", "64",
+         "--mnbn", "--double-buffering"],
+    )
+    assert "done: 2 iterations" in proc.stdout
