@@ -170,7 +170,9 @@ class MeshCommunicator(CommunicatorBase):
             if op == "min":
                 return lax.pmin(x, self._axes)
             if op == "prod":
-                return jnp.prod(self._gathered(x), axis=0)
+                return jax.tree_util.tree_map(
+                    lambda g: jnp.prod(g, axis=0), self._gathered(x)
+                )
             raise ValueError(f"unknown reduce op {op!r}")
         # Grouped: psum(axis_index_groups=...) is not implemented under
         # shard_map in current JAX; pmax/pmin are. Emulate sum/mean/prod via
@@ -181,13 +183,10 @@ class MeshCommunicator(CommunicatorBase):
         if op == "min":
             return lax.pmin(x, self._axes, axis_index_groups=self._groups)
         g = self._gathered(x)
-        if op == "sum":
-            return jnp.sum(g, axis=0)
-        if op == "mean":
-            return jnp.mean(g, axis=0)
-        if op == "prod":
-            return jnp.prod(g, axis=0)
-        raise ValueError(f"unknown reduce op {op!r}")
+        reducer = {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod}.get(op)
+        if reducer is None:
+            raise ValueError(f"unknown reduce op {op!r}")
+        return jax.tree_util.tree_map(lambda a: reducer(a, axis=0), g)
 
     def _t_bcast(self, x, root: int):
         if self._groups is None:

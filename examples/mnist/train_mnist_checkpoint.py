@@ -55,6 +55,12 @@ def main() -> None:
         ArrayDataset(x_train, y_train), comm, shuffle=True, seed=0
     )
     global_batch = args.batchsize * comm.size
+    if global_batch > len(train):
+        raise SystemExit(
+            f"global batch {global_batch} (= --batchsize x {comm.size} devices) "
+            f"exceeds the {len(train)}-sample dataset: every batch would be a "
+            "ragged tail and zero training steps would run"
+        )
     it = chainermn_tpu.SerialIterator(train, global_batch, shuffle=True, seed=1)
 
     model = MLP(n_units=args.unit)

@@ -236,6 +236,19 @@ class TestSplit:
         m = np.asarray(sub.allreduce(x, "mean"))
         np.testing.assert_allclose(m[0], np.mean([float(r) for r in range(half)]))
 
+    def test_split_allreduce_pytree(self):
+        """Grouped sum/mean/prod go through gather+local-reduce; they must
+        accept pytrees like the ungrouped psum/pmean path does."""
+        comm = create_communicator("naive")
+        n = comm.size
+        sub = comm.split([r % 2 for r in range(n)])
+        x = {"a": np.stack([np.full((2,), float(r)) for r in range(n)]).astype(np.float32),
+             "b": [np.ones((n, 1), np.float32)]}
+        out = sub.allreduce(x, "mean")
+        even_mean = np.mean([r for r in range(n) if r % 2 == 0])
+        np.testing.assert_allclose(np.asarray(out["a"])[0], even_mean)
+        np.testing.assert_allclose(np.asarray(out["b"][0]), np.ones((n, 1)))
+
     def test_split_rejects_ragged(self):
         comm = create_communicator("naive")
         n = comm.size

@@ -64,6 +64,21 @@ def test_train_mnist_checkpoint_crash_resume(tmp_path):
     assert "resumed from iteration 2" in resume.stdout
 
 
+TINY_SEQ2SEQ = ["--epoch", "2", "--n-train", "256", "--n-test", "64",
+                "--unit", "24", "--batchsize", "32", "--seq-len", "6"]
+
+
+def test_seq2seq_model_parallel():
+    proc = run_example("seq2seq/seq2seq.py", TINY_SEQ2SEQ)
+    assert "epoch   2" in proc.stdout
+
+
+def test_seq2seq_hybrid_dp_mp():
+    proc = run_example("seq2seq/seq2seq.py", TINY_SEQ2SEQ + ["--hybrid"],
+                       n_devices=4)
+    assert "pairs=2, hybrid=True" in proc.stdout
+
+
 def test_train_imagenet():
     proc = run_example(
         "imagenet/train_imagenet.py",
