@@ -9,6 +9,7 @@ from chainermn_tpu.models.resnet import (
     ResNet152,
 )
 from chainermn_tpu.models.transformer import TransformerBlock, TransformerLM
+from chainermn_tpu.models.vision import GoogLeNet, InceptionBlock, VGG16
 
 __all__ = [
     "MLP",
@@ -19,6 +20,9 @@ __all__ = [
     "ResNet101",
     "ResNet152",
     "AlexNet",
+    "GoogLeNet",
+    "InceptionBlock",
+    "VGG16",
     "TransformerBlock",
     "TransformerLM",
 ]

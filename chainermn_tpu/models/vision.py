@@ -1,0 +1,106 @@
+"""GoogLeNet (Inception v1) and VGG16 — the rest of the reference's
+ImageNet example model zoo.
+
+Parity target: ``[U] examples/imagenet/models/`` (SURVEY.md S2.15 —
+unverified cite: the reference ships resnet50, alex, googlenet example
+models). Fresh flax implementations, TPU conventions throughout: NHWC,
+bfloat16 compute with float32 params, logits head in float32.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class InceptionBlock(nn.Module):
+    """Four-branch Inception v1 block: 1x1 / 1x1->3x3 / 1x1->5x5 /
+    maxpool->1x1, concatenated on the channel axis."""
+
+    b1: int          # 1x1 branch channels
+    b3_reduce: int   # 3x3 branch bottleneck
+    b3: int
+    b5_reduce: int   # 5x5 branch bottleneck
+    b5: int
+    pool_proj: int
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        conv = lambda f, k, name: nn.Conv(f, k, padding="SAME", dtype=dt, name=name)
+        y1 = nn.relu(conv(self.b1, (1, 1), "b1")(x))
+        y3 = nn.relu(conv(self.b3_reduce, (1, 1), "b3_reduce")(x))
+        y3 = nn.relu(conv(self.b3, (3, 3), "b3")(y3))
+        y5 = nn.relu(conv(self.b5_reduce, (1, 1), "b5_reduce")(x))
+        y5 = nn.relu(conv(self.b5, (5, 5), "b5")(y5))
+        yp = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        yp = nn.relu(conv(self.pool_proj, (1, 1), "pool_proj")(yp))
+        return jnp.concatenate([y1, y3, y5, yp], axis=-1)
+
+
+# (b1, b3_reduce, b3, b5_reduce, b5, pool_proj) per block, grouped by stage
+_INCEPTION_CFG = [
+    [(64, 96, 128, 16, 32, 32), (128, 128, 192, 32, 96, 64)],            # 3a-3b
+    [(192, 96, 208, 16, 48, 64), (160, 112, 224, 24, 64, 64),            # 4a-4e
+     (128, 128, 256, 24, 64, 64), (112, 144, 288, 32, 64, 64),
+     (256, 160, 320, 32, 128, 128)],
+    [(256, 160, 320, 32, 128, 128), (384, 192, 384, 48, 128, 128)],      # 5a-5b
+]
+
+
+class GoogLeNet(nn.Module):
+    """Inception v1 main tower (the era's auxiliary classifiers are a
+    training-schedule artifact, superseded by BN; omitted like modern
+    reimplementations do)."""
+
+    num_classes: int = 1000
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train  # no normalization layers in the v1 tower
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        x = nn.relu(nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME",
+                            dtype=dt, name="stem1")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(nn.Conv(64, (1, 1), dtype=dt, name="stem2_reduce")(x))
+        x = nn.relu(nn.Conv(192, (3, 3), padding="SAME", dtype=dt,
+                            name="stem2")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, blocks in enumerate(_INCEPTION_CFG):
+            if stage > 0:
+                x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            for cfg in blocks:
+                x = InceptionBlock(*cfg, compute_dtype=dt)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+
+
+class VGG16(nn.Module):
+    """VGG-16 (configuration D)."""
+
+    num_classes: int = 1000
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        for stage, (filters, reps) in enumerate(
+            [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+        ):
+            for r in range(reps):
+                x = nn.relu(nn.Conv(filters, (3, 3), padding="SAME", dtype=dt,
+                                    name=f"conv{stage + 1}_{r + 1}")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=dt)(x))
+        x = nn.relu(nn.Dense(4096, dtype=dt)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )

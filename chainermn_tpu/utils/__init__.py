@@ -26,4 +26,21 @@ def apply_env_platform() -> None:
         pass  # backend already up; the env var did its job or it's too late
 
 
-__all__ = ["apply_env_platform"]
+def ensure_batch_fits(dataset, global_batch: int, size: int = 1) -> None:
+    """Fail fast when the global batch exceeds the dataset: every batch would
+    be a ragged tail (which training loops skip, matching the reference's
+    drop-last behavior) and zero steps would run — a silent no-op otherwise.
+
+    ``size`` is the device count when the global batch was computed as
+    per-device batch x devices (used only for the error message).
+    """
+    if global_batch > len(dataset):
+        how = f" (= per-device batch x {size} devices)" if size > 1 else ""
+        raise SystemExit(
+            f"global batch {global_batch}{how} exceeds the "
+            f"{len(dataset)}-sample dataset: every batch would be a ragged "
+            "tail and zero training steps would run"
+        )
+
+
+__all__ = ["apply_env_platform", "ensure_batch_fits"]

@@ -27,7 +27,7 @@ import numpy as np
 import optax
 
 import chainermn_tpu
-from chainermn_tpu.utils import apply_env_platform
+from chainermn_tpu.utils import apply_env_platform, ensure_batch_fits
 
 apply_env_platform()  # honor JAX_PLATFORMS even under plugin-forcing containers
 from chainermn_tpu import models
@@ -40,12 +40,9 @@ ARCHS = {
     "resnet101": lambda n: models.ResNet101(num_classes=n),
     "resnet152": lambda n: models.ResNet152(num_classes=n),
     "alex": lambda n: models.AlexNet(num_classes=n),
+    "googlenet": lambda n: models.GoogLeNet(num_classes=n),
+    "vgg16": lambda n: models.VGG16(num_classes=n),
 }
-for _name in ("GoogLeNet", "VGG16"):  # present once the zoo widens
-    if hasattr(models, _name):
-        ARCHS[_name.lower()] = (
-            lambda n, _m=getattr(models, _name): _m(num_classes=n)
-        )
 
 
 class SyntheticImageNet:
@@ -143,12 +140,7 @@ def main() -> None:
             model = chainermn_tpu.create_mnbn_model(model, comm)
 
     global_batch = args.batchsize * comm.size
-    if global_batch > len(train):
-        raise SystemExit(
-            f"global batch {global_batch} (= --batchsize x {comm.size} devices) "
-            f"exceeds the {len(train)}-sample dataset: every batch would be a "
-            "ragged tail and zero training steps would run"
-        )
+    ensure_batch_fits(train, global_batch, comm.size)
     it = chainermn_tpu.SerialIterator(train, global_batch, shuffle=True, seed=1)
 
     sample = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.bfloat16)

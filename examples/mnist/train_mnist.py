@@ -28,7 +28,7 @@ import numpy as np
 import optax
 
 import chainermn_tpu
-from chainermn_tpu.utils import apply_env_platform
+from chainermn_tpu.utils import apply_env_platform, ensure_batch_fits
 
 apply_env_platform()  # honor JAX_PLATFORMS even under plugin-forcing containers
 from chainermn_tpu.models import MLP
@@ -110,12 +110,7 @@ def main() -> None:
 
     model = MLP(n_units=args.unit)
     global_batch = args.batchsize * comm.size
-    if global_batch > len(train):
-        raise SystemExit(
-            f"global batch {global_batch} (= --batchsize x {comm.size} devices) "
-            f"exceeds the {len(train)}-sample dataset: every batch would be a "
-            "ragged tail and zero training steps would run"
-        )
+    ensure_batch_fits(train, global_batch, comm.size)
     it = chainermn_tpu.SerialIterator(train, global_batch, shuffle=True, seed=1)
 
     variables = comm.bcast_data(

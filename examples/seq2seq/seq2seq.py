@@ -166,8 +166,11 @@ def main() -> None:
 
     optimizer = optax.adam(2e-3)
     n_pairs = comm.size // 2 if args.hybrid else 1
-    if args.hybrid and comm.size < 4:
-        raise SystemExit("--hybrid needs >= 4 devices (2 per MP pair)")
+    if args.hybrid and (comm.size < 4 or comm.size % 2):
+        raise SystemExit(
+            f"--hybrid needs an even device count >= 4 (2 per MP pair); "
+            f"got {comm.size}"
+        )
 
     # one chain per MP pair; identical init (same key) keeps pairs in sync,
     # the reference's bcast_data-at-start contract

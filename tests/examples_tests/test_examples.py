@@ -79,6 +79,17 @@ def test_seq2seq_hybrid_dp_mp():
     assert "pairs=2, hybrid=True" in proc.stdout
 
 
+def test_parallel_convolution():
+    proc = run_example(
+        "parallel_convolution/train_parallel_conv.py",
+        ["--check", "--epoch", "2", "--n-train", "256", "--batchsize", "32",
+         "--image-size", "16"],
+        n_devices=4,
+    )
+    assert "parity check OK" in proc.stdout
+    assert "epoch   2" in proc.stdout
+
+
 def test_train_imagenet():
     proc = run_example(
         "imagenet/train_imagenet.py",

@@ -1,0 +1,55 @@
+"""GoogLeNet / VGG16 sanity: shapes, canonical param counts, gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chainermn_tpu.models import GoogLeNet, VGG16
+
+
+def _n_params(tree):
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(tree))
+
+
+def test_googlenet_param_count():
+    model = GoogLeNet(num_classes=1000)
+    variables = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 224, 224, 3))),
+        jax.random.PRNGKey(0),
+    )
+    n = _n_params(variables["params"])
+    # torchvision googlenet main tower (no aux heads): ~5.6M
+    assert 5_000_000 < n < 7_500_000, n
+
+
+def test_vgg16_param_count():
+    model = VGG16(num_classes=1000)
+    variables = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 224, 224, 3))),
+        jax.random.PRNGKey(0),
+    )
+    n = _n_params(variables["params"])
+    # canonical VGG-16: 138,357,544
+    assert 135_000_000 < n < 140_000_000, n
+
+
+def test_googlenet_forward_backward_small():
+    model = GoogLeNet(num_classes=7, compute_dtype=jnp.float32)
+    x = jnp.ones((2, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y = model.apply(variables, x)
+    assert y.shape == (2, 7)
+    assert y.dtype == jnp.float32
+    g = jax.grad(lambda p: model.apply({"params": p}, x).sum())(
+        variables["params"]
+    )
+    assert all(jnp.all(jnp.isfinite(l)) for l in jax.tree_util.tree_leaves(g))
+
+
+def test_vgg16_forward_small():
+    model = VGG16(num_classes=4, compute_dtype=jnp.float32)
+    x = jnp.ones((1, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y = model.apply(variables, x)
+    assert y.shape == (1, 4)
+    assert y.dtype == jnp.float32
