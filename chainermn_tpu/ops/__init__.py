@@ -1,0 +1,12 @@
+"""TPU-native compute ops: Pallas kernels and pipeline schedules.
+
+No reference counterpart (``gshuichi/chainermn`` has no custom device
+kernels beyond CuPy JIT pack/cast strings, SURVEY.md S2.9) — this package
+holds the ops where hand-written kernels beat XLA's default lowering, plus
+TPU-idiomatic extensions (microbatched pipeline schedule).
+"""
+
+from chainermn_tpu.ops.flash_attention import flash_attention
+from chainermn_tpu.ops.pipeline import pipeline_apply
+
+__all__ = ["flash_attention", "pipeline_apply"]

@@ -1,0 +1,398 @@
+"""Pallas TPU flash attention (forward + backward kernels).
+
+The reference has no attention ops at all (SURVEY.md S2.16: it predates
+them); this kernel is the TPU-native hot-op for the long-context extension
+(:mod:`chainermn_tpu.parallel.sequence`). Design per the Pallas TPU guide:
+
+- one grid cell per ``(batch*heads, q_block)``; K/V rows stream through the
+  MXU in ``block_k`` tiles inside a ``fori_loop`` with the online-softmax
+  (m, l, acc) recurrence carried as loop values — attention scores are never
+  materialized in HBM, so memory is O(T) instead of O(T^2);
+- causal masking is computed from *global* positions: ``q_offset`` /
+  ``k_offset`` arrive as SMEM scalars so sequence-sharded callers (ring
+  attention shards, ``pos_offset`` in the LM) can pass traced offsets;
+- the causal path clamps the K-loop trip count to the last visible block —
+  the standard ~2x FLOP saving — with a dynamic (traced) bound;
+- backward is the standard two-kernel flash backward: ``dq`` gridded over
+  q-blocks and ``(dk, dv)`` gridded over k-blocks, both recomputing scores
+  from the saved row logsumexp (``lse``) instead of storing P;
+- contractions accumulate in f32 (``preferred_element_type``) from bf16 or
+  f32 inputs.
+
+Numerical contract: identical to
+:func:`chainermn_tpu.parallel.sequence.full_attention` (tested to fp
+tolerance, values and grads). Off TPU the kernels run in Pallas interpret
+mode, so the same code path is unit-testable on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e30
+
+
+def _smem_spec():
+    """Spec for the (1, 1) int32 offset scalars (SMEM on TPU; the guide's
+    'scalars must be 2D in SMEM' rule)."""
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _causal_hi(last_q, k_off, block_k: int, nk: int):
+    """Number of k-blocks any row of this q-block can see (traced ok).
+    floor_divide, not lax.div: toward-zero rounding overcounts by one when
+    last_q < k_off."""
+    return jnp.clip(
+        jnp.floor_divide(last_q - k_off, jnp.int32(block_k)) + 1, 0, nk
+    )
+
+
+def _pick_block(t: int, preferred: int = 128) -> int:
+    """Largest divisor of ``t`` that is <= preferred (kernel blocks must
+    tile the sequence exactly; callers fall back to XLA otherwise)."""
+    b = min(preferred, t)
+    while t % b:
+        b -= 1
+    return b
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------------- #
+# Forward                                                                     #
+# --------------------------------------------------------------------------- #
+
+def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                *, scale: float, causal: bool, block_k: int):
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    tk = k_ref.shape[1]
+    nk = tk // block_k
+    q_off = qo_ref[0, 0] + pl.program_id(1) * bq
+    k_off = ko_ref[0, 0]
+
+    q = q_ref[0].astype(jnp.float32)
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            k_pos = (k_off + j * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l = l * corr + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p, vb.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * corr[:, None] + pv
+        return m_new, l, acc
+
+    if causal:
+        # blocks whose first position is beyond the last q position never
+        # contribute: clamp the trip count (dynamic — offsets are traced)
+        hi = _causal_hi(q_off + bq - 1, k_off, block_k, nk)
+    else:
+        hi = nk
+    m0 = jnp.full((bq,), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, a0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # rows with no visible keys get lse = -inf-ish; backward masks them out
+    lse_ref[0] = jnp.where(l == 0.0, _NEG_BIG, m + jnp.log(l_safe))
+
+
+def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
+         interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    grid = (bh, tq // block_q)
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    ko = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
+    smem = _smem_spec()
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=grid,
+        in_specs=[
+            smem,
+            smem,
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qo, ko, q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------------- #
+# Backward                                                                    #
+# --------------------------------------------------------------------------- #
+
+def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, scale: float, causal: bool,
+                   block_k: int):
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    tk = k_ref.shape[1]
+    nk = tk // block_k
+    q_off = qo_ref[0, 0] + pl.program_id(1) * bq
+    k_off = ko_ref[0, 0]
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            k_pos = (k_off + j * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
+        # masked entries must not resurrect when lse is the -inf sentinel
+        # (fully-masked row): exp(-1e30 - (-1e30)) == 1 otherwise
+        p = jnp.where(s <= _NEG_BIG / 2, 0.0, jnp.exp(s - lse[:, None]))
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        hi = _causal_hi(q_off + bq - 1, k_off, block_k, nk)
+    else:
+        hi = nk
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int):
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    tq = q_ref.shape[1]
+    nq = tq // block_q
+    q_off = qo_ref[0, 0]
+    k_off = ko_ref[0, 0] + pl.program_id(1) * bk
+
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = (q_off + i * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
+            s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
+        p = jnp.where(s <= _NEG_BIG / 2, 0.0, jnp.exp(s - lse[:, None]))
+        dv = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    if causal:
+        # q blocks strictly before this k block see nothing of it
+        lo = jnp.clip(
+            jnp.floor_divide(k_off - q_off, jnp.int32(block_q)), 0, nq
+        )
+    else:
+        lo = 0
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, nq, body, (z, z))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse, qo, ko = res
+    do, _ = g  # cotangent of (out, lse); lse cotangent unused
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    smem = _smem_spec()
+    qo2 = jnp.asarray(qo, jnp.int32).reshape(1, 1)
+    ko2 = jnp.asarray(ko, jnp.int32).reshape(1, 1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, tq // block_q),
+        in_specs=[
+            smem, smem,
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        interpret=interpret,
+    )(qo2, ko2, q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=(bh, tk // block_k),
+        in_specs=[
+            smem, smem,
+            pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, tq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, tq), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qo2, ko2, q, k, v, do, lse, delta)
+    return dq, dk, dv, None, None
+
+
+# --------------------------------------------------------------------------- #
+# Public entry                                                                #
+# --------------------------------------------------------------------------- #
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, q_offset, k_offset, scale, causal, block_q, block_k,
+           interpret):
+    out, _ = _fwd(q, k, v, q_offset, k_offset, scale=scale, causal=causal,
+                  block_q=block_q, block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, q_offset, k_offset, scale, causal, block_q, block_k,
+               interpret):
+    out, lse = _fwd(q, k, v, q_offset, k_offset, scale=scale, causal=causal,
+                    block_q=block_q, block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse, q_offset, k_offset)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    dq, dk, dv, _, _ = _bwd(scale, causal, block_q, block_k, interpret,
+                            res, (g, None))
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    k_offset=0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Blockwise (flash) attention, layout ``[B, T, H, D]`` like
+    :func:`chainermn_tpu.parallel.sequence.full_attention`.
+
+    ``q_offset``/``k_offset`` are the *global* positions of ``q[:, 0]`` /
+    ``k[:, 0]`` for causal masking under sequence sharding (may be traced).
+    Differentiable (custom VJP, flash backward kernels). Runs compiled on
+    TPU, interpreted elsewhere (``interpret=None`` auto-detects).
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+    if bq < min(8, tq) or bk < min(8, tk):
+        # awkward lengths (no usable divisor): blockwise degenerates below
+        # hardware tile minimums — use the XLA path, same semantics
+        from chainermn_tpu.parallel.sequence import full_attention
+
+        static_zero_offsets = (
+            isinstance(q_offset, int) and q_offset == 0
+            and isinstance(k_offset, int) and k_offset == 0
+        )
+        if not causal or (static_zero_offsets and tq == tk):
+            return full_attention(q, k, v, causal=causal, scale=scale)
+        raise ValueError(
+            f"flash_attention: sequence lengths (tq={tq}, tk={tk}) have no "
+            "usable block divisor and the offset-causal XLA fallback is not "
+            "implemented — pad the sequence to a multiple of 8"
+        )
+
+    def fold(x):  # [B, T, H, D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    out = _flash(fold(q), fold(k), fold(v),
+                 jnp.asarray(q_offset, jnp.int32),
+                 jnp.asarray(k_offset, jnp.int32),
+                 float(scale), bool(causal), bq, bk, bool(interpret))
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,84 @@
+"""Microbatched pipeline parallelism (GPipe-style fill-drain schedule).
+
+TPU extension BEYOND the reference: upstream's ``MultiNodeChainList`` runs
+whole batches sequentially through the stages — no microbatch pipelining
+(SURVEY.md S2.16: "no GPipe/1F1B"). This op provides the schedule the
+reference lacks, the SPMD way: every device runs the SAME traced program
+(``lax.scan`` over ticks), holds ONE stage's parameters, and boundary
+activations rotate with ``lax.ppermute``; autodiff of scan+ppermute yields
+the reverse (backward) schedule with transposed transfers automatically.
+
+Bubble fraction is the textbook ``(n_stages - 1) / (n_micro + n_stages - 1)``
+— choose ``n_microbatches >> n_stages``. Stages must be shape-preserving
+(input/output shapes equal across the boundary, e.g. transformer blocks):
+the rotating buffer has one static shape.
+
+Use inside ``comm.shard_map`` with stage parameters stacked on a leading
+axis sharded over the pipeline mesh axis (``P(axis_name)``), e.g.::
+
+    def body(stacked_params, x):
+        local = jax.tree.map(lambda l: l[0], stacked_params)  # my stage
+        return pipeline_apply(stage_fn, local, x, "ranks", n_micro)
+
+    y = jax.jit(comm.shard_map(body, in_specs=(P("ranks"), P()),
+                               out_specs=P()))(stacked, x)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, Any], Any],
+    stage_params: Any,
+    x,
+    axis_name: str,
+    n_microbatches: int,
+):
+    """Run ``x`` through ``n_stages = axis_size`` pipeline stages.
+
+    Args:
+      stage_fn: ``(params, micro_in) -> micro_out``; applied by every rank to
+        its resident stage. Shape-preserving.
+      stage_params: THIS rank's stage parameters (the local shard).
+      x: full batch, replicated across the axis; leading dim divisible by
+        ``n_microbatches``.
+      axis_name: the pipeline mesh axis (inside ``shard_map``).
+
+    Returns the full-batch output of the last stage, replicated.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by n_microbatches {n_microbatches}"
+        )
+    micro = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
+    ticks = n_microbatches + n - 1
+    perm = [(i, i + 1) for i in range(n - 1)]  # stage i -> i+1 (no wrap)
+
+    def tick(state, t):
+        # rank 0 injects microbatch t (clamped; masked after drain),
+        # others consume what the previous stage sent last tick
+        inj = jnp.take(micro, jnp.clip(t, 0, n_microbatches - 1), axis=0)
+        inp = jnp.where(idx == 0, inj, state)
+        out = stage_fn(stage_params, inp)
+        return lax.ppermute(out, axis_name, perm), out
+
+    # the carry is per-device state (varying over the pipeline axis); without
+    # the cast the scan carry's replicated-ness differs between input/output
+    state0 = lax.pcast(jnp.zeros_like(micro[0]), (axis_name,), to="varying")
+    _, outs = lax.scan(tick, state0, jnp.arange(ticks))
+    # the last stage emits valid microbatch m at tick m + n - 1; everything
+    # it produced earlier is fill garbage. Select the valid window and
+    # broadcast it from the last rank (masked psum).
+    valid = lax.dynamic_slice_in_dim(outs, n - 1, n_microbatches, axis=0)
+    mine = jnp.where(idx == n - 1, valid, jnp.zeros_like(valid))
+    full = lax.psum(mine, axis_name)
+    return full.reshape(b, *x.shape[1:])

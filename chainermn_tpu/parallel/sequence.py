@@ -190,11 +190,26 @@ def sequence_parallel_attention(
     scale: Optional[float] = None,
 ):
     """Pick an attention implementation by name: ``'ring'`` | ``'ulysses'``
-    | ``'full'``. Returns ``f(q, k, v) -> o`` for use inside a traced step."""
+    | ``'full'`` | ``'flash'``. Returns ``f(q, k, v) -> o`` for use inside a
+    traced step. ``'flash'`` is the Pallas-kernel local attention
+    (:mod:`chainermn_tpu.ops.flash_attention`) — same semantics as
+    ``'full'``, O(T) memory; use it when the sequence is NOT sharded."""
+    if kind == "flash":
+        if axis_name is not None:
+            raise ValueError(
+                "attention='flash' is local (unsharded-sequence) attention; "
+                "it cannot attend across a sharded sequence axis "
+                f"({axis_name!r}) — use 'ring' or 'ulysses' there"
+            )
+        from chainermn_tpu.ops import flash_attention
+
+        return functools.partial(flash_attention, causal=causal, scale=scale)
     if kind == "full" or axis_name is None:
         return functools.partial(full_attention, causal=causal, scale=scale)
     if kind not in ("ring", "ulysses"):
-        raise ValueError(f"unknown attention kind {kind!r}; use ring|ulysses|full")
+        raise ValueError(
+            f"unknown attention kind {kind!r}; use ring|ulysses|full|flash"
+        )
     impl = ring_attention if kind == "ring" else ulysses_attention
 
     def f(q, k, v):

@@ -1,0 +1,136 @@
+"""Flash attention kernel vs the XLA reference: values and gradients.
+
+Runs in Pallas interpret mode on CPU (the TPU-compiled path is the same
+kernel code; interpret mode checks the math, SURVEY.md S4's 'multi-node
+without a cluster' testing stance applied to kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.ops import flash_attention
+from chainermn_tpu.parallel.sequence import full_attention
+
+
+def _qkv(key, b=2, t=64, h=2, d=16, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, t, h, d)
+    return (jax.random.normal(kq, shape, dtype),
+            jax.random.normal(kk, shape, dtype),
+            jax.random.normal(kv, shape, dtype))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    got = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(1), t=32, d=8)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = full_attention(q, k, v, causal=causal)
+        return jnp.sum(o * o)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_cross_attention_rectangular():
+    """T_q != T_k (cross attention shape)."""
+    q, _, _ = _qkv(jax.random.PRNGKey(2), t=24)
+    _, k, v = _qkv(jax.random.PRNGKey(3), t=48)
+    got = flash_attention(q, k, v, block_q=8, block_k=16)
+    want = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_offsets_reproduce_sharded_causal_slice():
+    """flash on a q slice with q_offset equals the slice of full causal
+    attention — the sequence-sharding contract."""
+    q, k, v = _qkv(jax.random.PRNGKey(4), t=32)
+    want = full_attention(q, k, v, causal=True)
+    t_half = 16
+    got_hi = flash_attention(
+        q[:, t_half:], k, v, causal=True,
+        q_offset=t_half, k_offset=0, block_q=8, block_k=8,
+    )
+    np.testing.assert_allclose(np.asarray(got_hi), np.asarray(want[:, t_half:]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_traced_offsets():
+    """Offsets may be traced values (axis_index-style callers)."""
+    q, k, v = _qkv(jax.random.PRNGKey(5), t=16)
+
+    @jax.jit
+    def f(off):
+        return flash_attention(q[:, 8:], k, v, causal=True,
+                               q_offset=off, block_q=8, block_k=8)
+
+    got = f(jnp.int32(8))
+    want = full_attention(q, k, v, causal=True)[:, 8:]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fully_masked_rows_zero_grads():
+    """A q slice entirely BEFORE all keys (causal): output 0, grads 0 — the
+    -inf lse sentinel must not produce NaN/garbage in backward."""
+    q, k, v = _qkv(jax.random.PRNGKey(6), t=16)
+
+    def loss(k, v):
+        o = flash_attention(q, k, v, causal=True,
+                            q_offset=0, k_offset=100,  # all keys in future
+                            block_q=8, block_k=8)
+        return jnp.sum(o * o), o
+
+    (l, o), g = jax.value_and_grad(loss, argnums=(0, 1), has_aux=True)(k, v)
+    assert float(l) == 0.0
+    np.testing.assert_array_equal(np.asarray(o), 0.0)
+    for gi in g:
+        np.testing.assert_array_equal(np.asarray(gi), 0.0)
+
+
+def test_bfloat16_inputs():
+    q, k, v = _qkv(jax.random.PRNGKey(7), dtype=jnp.bfloat16)
+    got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    want = full_attention(q, k, v, causal=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
+def test_awkward_length_falls_back_to_xla():
+    """T prime and above the block size has no usable divisor (block would
+    degenerate to 1): the XLA fallback must engage (same numerics), and the
+    offset-causal case must raise clearly."""
+    q, k, v = _qkv(jax.random.PRNGKey(8), t=251)
+    got = flash_attention(q, k, v, causal=True)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="pad the sequence"):
+        flash_attention(q, k, v, causal=True, q_offset=13)
+
+
+def test_flash_kind_rejects_sharded_axis():
+    from chainermn_tpu.parallel.sequence import sequence_parallel_attention
+    with pytest.raises(ValueError, match="ring"):
+        sequence_parallel_attention("flash", "ranks")
