@@ -176,6 +176,11 @@ class KVStoreObjectComm:
     def _ack(self, round_key: str) -> None:
         self._client.key_value_set(f"{round_key}/ack/{self.rank}", "1")
 
+    def _count_acks(self, prefix: str) -> int:
+        """Transport hook (the native sidecar overrides it): how many ack
+        keys exist under ``prefix``."""
+        return len(self._client.key_value_dir_get(prefix))
+
     def _gc_pending(self, op: str) -> None:
         """Delete previously-written rounds of ``op`` whose readers have all
         acked. Every process calls this on every use of ``op`` (its pending
@@ -186,8 +191,7 @@ class KVStoreObjectComm:
         for rk, expected_acks in pend:
             done = False
             try:
-                acks = self._client.key_value_dir_get(f"{rk}/ack/")
-                done = len(acks) >= expected_acks
+                done = self._count_acks(f"{rk}/ack/") >= expected_acks
             except Exception:
                 done = False
             if done:

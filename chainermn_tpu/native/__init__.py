@@ -1,0 +1,8 @@
+"""Native (C++) runtime components.
+
+The reference's native surface is NCCL bindings + CUDA pack kernels
+(SURVEY.md S2.9); on TPU, XLA owns the device side, so the native layer here
+is host-side: the :mod:`objstore` TCP object-transport sidecar (DCN control
+plane). Everything degrades gracefully to pure-Python transports when the
+toolchain is unavailable.
+"""

@@ -95,7 +95,10 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
+        # explicit zero for masked entries: when a row is fully masked within
+        # a VISITED block, s == m_new == the sentinel and exp(s - m_new)
+        # would be 1, polluting l/acc with mean-of-V garbage
+        p = jnp.where(s <= _NEG_BIG / 2, 0.0, jnp.exp(s - m_new[:, None]))
         l = l * corr + jnp.sum(p, axis=-1)
         pv = jax.lax.dot_general(
             p, vb.astype(jnp.float32), (((1,), (0,)), ((), ())),

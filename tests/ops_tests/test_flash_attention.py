@@ -117,6 +117,24 @@ def test_bfloat16_inputs():
     )
 
 
+def test_partially_masked_block_rows_zero():
+    """Causal with k_offset not a multiple of block_q: rows 0..3 are fully
+    masked INSIDE a visited k-block. They must output exactly 0 (not
+    mean-of-V garbage from exp(sentinel - sentinel) == 1)."""
+    q, k, v = _qkv(jax.random.PRNGKey(9), t=8, h=1, d=4)
+    got = flash_attention(q, k, v, causal=True, q_offset=0, k_offset=4,
+                          block_q=8, block_k=8)
+    # reference with explicit global-position mask
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (4 ** -0.5)
+    mask = (jnp.arange(8)[:, None] >= (4 + jnp.arange(8))[None, :])
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jnp.where(mask[None, None], jax.nn.softmax(s, axis=-1), 0.0)
+    want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_array_equal(np.asarray(got[:, :4]), 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_awkward_length_falls_back_to_xla():
     """T prime and above the block size has no usable divisor (block would
     degenerate to 1): the XLA fallback must engage (same numerics), and the
