@@ -11,6 +11,7 @@ synthetic data — this measures the training step, not input pipelines.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -60,6 +61,21 @@ def main() -> None:
                 variables, opt_state, loss = jax.block_until_ready(
                     step(variables, opt_state, images, labels)
                 )
+            cs = {"total_bytes": 0}
+            # per-step comm traffic from the compiled HLO (stderr only);
+            # costs one extra XLA compile, so opt-in via env
+            if os.environ.get("CHAINERMN_TPU_BENCH_COMMSTATS"):
+                try:
+                    from chainermn_tpu.extensions import collective_stats
+
+                    cs = collective_stats(step, variables, opt_state, images, labels)
+                    detail = ", ".join(
+                        f"{k} x{v['count']} ({v['bytes'] / 1e6:.1f}MB)"
+                        for k, v in cs.items() if isinstance(v, dict)
+                    )
+                    log("collectives/step: " + (detail or "none"))
+                except Exception as e:
+                    log(f"collective_stats unavailable: {e}")
             n_steps = 10
             t0 = time.time()
             for _ in range(n_steps):
@@ -67,6 +83,10 @@ def main() -> None:
             jax.block_until_ready(loss)
             dt = time.time() - t0
             imgs_per_sec = batch * n_steps / dt
+            if cs.get("total_bytes"):
+                log(f"collective traffic: {cs['total_bytes'] / 1e6:.1f} MB/step "
+                    f"-> {cs['total_bytes'] * n_steps / dt / 1e9:.2f} GB/s "
+                    "effective")
             per_chip = imgs_per_sec / n_chips
             log(f"{n_steps} steps in {dt:.2f}s -> {imgs_per_sec:.1f} img/s total")
             print(json.dumps({

@@ -13,10 +13,22 @@ from chainermn_tpu.extensions.checkpoint import (
     create_multi_node_checkpointer,
 )
 from chainermn_tpu.extensions.observation_aggregator import ObservationAggregator
+from chainermn_tpu.extensions.profiling import (
+    StepTimer,
+    Watchdog,
+    collective_stats,
+    parse_hlo_collectives,
+    trace,
+)
 
 __all__ = [
     "AllreducePersistent",
     "MultiNodeCheckpointer",
     "create_multi_node_checkpointer",
     "ObservationAggregator",
+    "StepTimer",
+    "Watchdog",
+    "collective_stats",
+    "parse_hlo_collectives",
+    "trace",
 ]

@@ -1,0 +1,236 @@
+"""Observability: step timing, per-step collective-traffic stats, profiler
+trace helper, and a hang watchdog.
+
+The reference ships NO profiling of its own (SURVEY.md S5: users reach for
+Chainer hooks + nvprof; the paper profiles externally) and no hang
+detection (a lost collective blocks forever in NCCL/MPI). The TPU rebuild
+owes both: XLA gives tracing nearly free (``jax.profiler``), compiled
+programs make comm traffic *statically knowable* (read the collectives out
+of the lowered HLO instead of instrumenting a byte-mover), and XLA
+collectives hang exactly like NCCL ones, so a watchdog turns silent stalls
+into actionable failures (the same fail-fast stance as
+``global_except_hook``, SURVEY.md S3.5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+import sys
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+
+# `%name = TYPE op-name(` — TYPE is `f32[8,128]{...}` or a (tuple, of, them)
+_INSTR_RE = re.compile(
+    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)\s+([a-z0-9-]+)(?:\.[0-9]+)?\(")
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _type_bytes(type_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        if dtype not in _DTYPE_BYTES:
+            continue  # token types etc.
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def parse_hlo_collectives(hlo: str) -> dict[str, Any]:
+    """Count collectives + their output bytes in HLO text.
+
+    Post-optimization TPU/GPU HLO rewrites collectives into async
+    ``<op>-start`` / ``<op>-done`` pairs: the ``-start`` carries the payload
+    type and is counted under the base op name; ``-done`` is skipped so
+    pairs aren't double-counted.
+    """
+    stats: dict[str, Any] = {}
+    total = 0
+    for m in _INSTR_RE.finditer(hlo):
+        type_str, op = m.group(1), m.group(2)
+        if op.endswith("-done"):
+            continue
+        base = op[: -len("-start")] if op.endswith("-start") else op
+        if base not in _COLLECTIVES:
+            continue
+        nbytes = _type_bytes(type_str)
+        entry = stats.setdefault(base, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += nbytes
+        total += nbytes
+    stats["total_bytes"] = total
+    return stats
+
+
+def collective_stats(fn: Callable, *args, **kwargs) -> dict[str, Any]:
+    """Statically analyze one step's collective traffic from compiled HLO.
+
+    ``fn`` is a jitted (or jittable) function; ``args`` example inputs.
+    Returns ``{op: {"count": n, "bytes": output_bytes}, ...,
+    "total_bytes": N}`` — output-shape bytes per collective, the standard
+    proxy for wire traffic (all-gather output == gathered bytes, all-reduce
+    output ~= ring traffic x 2(n-1)/n).
+
+    This replaces instrumenting a hand-written byte-mover (the reference
+    would count what it memcpy'd): under XLA the program IS the ground
+    truth. Note the AOT ``lower().compile()`` here does not share the jit
+    executable cache — calling this costs one extra XLA compile of ``fn``.
+    """
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    hlo = jitted.lower(*args, **kwargs).compile().as_text()
+    return parse_hlo_collectives(hlo)
+
+
+class StepTimer:
+    """Wall-clock step statistics with warmup exclusion.
+
+    Use as a context manager around each step (or call ``tick()`` once per
+    step); ``report()`` returns mean/p50/p99 step time and items/sec. The
+    per-step comm-bytes x step-time pairing (SURVEY.md S5) comes from
+    combining this with :func:`collective_stats`.
+    """
+
+    def __init__(self, warmup: int = 2, items_per_step: int = 0) -> None:
+        self._warmup = warmup
+        self._items = items_per_step
+        self._times: list[float] = []
+        self._seen = 0
+        self._t0: Optional[float] = None
+        self._last: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._record(time.perf_counter() - self._t0)
+
+    def tick(self) -> None:
+        """Alternative to the context manager: call once per completed step
+        (the first call only arms the clock)."""
+        now = time.perf_counter()
+        if self._last is not None:
+            self._record(now - self._last)
+        self._last = now
+
+    def _record(self, dt: float) -> None:
+        self._seen += 1
+        if self._seen > self._warmup:
+            self._times.append(dt)
+
+    @property
+    def steps(self) -> int:
+        return len(self._times)
+
+    def report(self) -> dict[str, float]:
+        if not self._times:
+            return {"steps": 0}
+        t = np.asarray(self._times)
+        out = {
+            "steps": len(t),
+            "step_time_mean_s": float(t.mean()),
+            "step_time_p50_s": float(np.percentile(t, 50)),
+            "step_time_p99_s": float(np.percentile(t, 99)),
+        }
+        if self._items:
+            out["items_per_sec"] = self._items / float(t.mean())
+        return out
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """``jax.profiler`` trace around a code block; view in XProf/Perfetto.
+    (The reference points users at nvprof; this is the TPU equivalent.)"""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+class Watchdog:
+    """Deadlock watchdog: a hung step (lost collective peer, wedged host
+    callback) dumps every thread's stack and — by default — aborts the
+    process so the launcher can restart it, instead of hanging silently
+    forever the way a lost NCCL/XLA collective does.
+
+    Use around each step::
+
+        dog = Watchdog(timeout=300)
+        with dog.step():
+            train_step(...)
+
+    ``on_timeout='warn'`` only reports — re-armed each period, so a
+    multi-period hang keeps reporting instead of going quiet after one.
+    """
+
+    def __init__(self, timeout: float, on_timeout: str = "abort",
+                 _sink=None) -> None:
+        if on_timeout not in ("abort", "warn"):
+            raise ValueError(f"on_timeout must be abort|warn, got {on_timeout!r}")
+        self._timeout = timeout
+        self._mode = on_timeout
+        self._sink = _sink or sys.stderr
+        self._fired = threading.Event()
+        self._timer: Optional[threading.Timer] = None
+        self._armed = False
+
+    def _fire(self, where: str) -> None:
+        self._fired.set()
+        import faulthandler
+
+        print(
+            f"chainermn_tpu.Watchdog: step exceeded {self._timeout}s "
+            f"({where}) — a peer likely died inside a collective. "
+            "Thread stacks follow.",
+            file=self._sink, flush=True,
+        )
+        try:
+            faulthandler.dump_traceback(file=self._sink)
+        except Exception:
+            pass
+        if self._mode == "abort":
+            import os
+
+            os._exit(43)  # mirror global_except_hook: die loudly, not hang
+        if self._armed:  # warn mode: re-arm so long hangs keep reporting
+            self._start_timer(where)
+
+    def _start_timer(self, label: str) -> None:
+        self._timer = threading.Timer(self._timeout, self._fire, args=(label,))
+        self._timer.daemon = True
+        self._timer.start()
+
+    @property
+    def fired(self) -> bool:
+        """Whether any watched step has ever timed out (for tests/metrics)."""
+        return self._fired.is_set()
+
+    @contextlib.contextmanager
+    def step(self, label: str = "train step"):
+        self._armed = True
+        self._start_timer(label)
+        try:
+            yield
+        finally:
+            self._armed = False
+            if self._timer is not None:
+                self._timer.cancel()

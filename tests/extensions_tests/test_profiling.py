@@ -1,0 +1,140 @@
+"""Observability extensions: HLO collective stats, step timer, watchdog."""
+
+import io
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.extensions import StepTimer, Watchdog, collective_stats
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("tpu")
+
+
+def test_collective_stats_counts_psum(comm):
+    def body(x):
+        return comm.allreduce(x, "sum")
+
+    f = jax.jit(comm.shard_map(body, in_specs=comm.data_spec,
+                               out_specs=P()))
+    x = jnp.zeros((comm.size, 128), jnp.float32)
+    stats = collective_stats(f, x)
+    assert stats["all-reduce"]["count"] >= 1
+    # output is the reduced [128] f32 block on each shard
+    assert stats["all-reduce"]["bytes"] >= 128 * 4
+    assert stats["total_bytes"] >= stats["all-reduce"]["bytes"]
+
+
+def test_collective_stats_sees_ppermute_and_allgather(comm):
+    n = comm.size
+
+    def body(x):
+        y = comm.ppermute(x, [(i, (i + 1) % n) for i in range(n)])
+        return comm.allgather(y)
+
+    f = jax.jit(comm.shard_map(body, in_specs=comm.data_spec,
+                               out_specs=P(None, comm.axis_name)))
+    x = jnp.zeros((n, 64), jnp.bfloat16)
+    stats = collective_stats(f, x)
+    assert stats.get("collective-permute", {}).get("count", 0) >= 1
+    assert stats.get("all-gather", {}).get("count", 0) >= 1
+    # allgather output: n * 64 bf16 per shard
+    assert stats["all-gather"]["bytes"] >= n * 64 * 2
+
+
+def test_collective_stats_train_step_has_gradient_allreduce(comm):
+    """The canonical DP train step's HLO must contain the gradient mean —
+    the per-step comm-bytes report the reference never had (SURVEY.md S5)."""
+    import optax
+
+    from chainermn_tpu.models import MLP
+    from chainermn_tpu.training import jit_train_step
+
+    model = MLP(n_units=16, n_out=4)
+    images = jnp.zeros((2 * comm.size, 8))
+    labels = jnp.zeros((2 * comm.size,), jnp.int32)
+    variables = comm.bcast_data(model.init(jax.random.PRNGKey(0), images[:1]))
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    opt_state = jax.device_put(opt.init(variables["params"]),
+                               comm.named_sharding())
+    step = jit_train_step(model, opt, comm, donate=False)
+    stats = collective_stats(step, variables, opt_state, images, labels)
+    assert stats["all-reduce"]["count"] >= 1
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(variables))
+    assert stats["all-reduce"]["bytes"] >= n_params * 4
+
+
+def test_parse_hlo_async_collective_pairs():
+    """Post-optimization TPU HLO uses <op>-start/<op>-done pairs; the parser
+    must count the pair once, under the base op name."""
+    from chainermn_tpu.extensions import parse_hlo_collectives
+
+    hlo = """
+  %ar0 = f32[1024]{0} all-reduce-start(f32[1024]{0} %p0), replica_groups={}
+  %ar1 = f32[1024]{0} all-reduce-done(f32[1024]{0} %ar0)
+  %ag0 = (bf16[8,64]{1,0}, bf16[8,64]{1,0}) all-gather-start(bf16[8,64]{1,0} %p1)
+  %ag1 = bf16[8,64]{1,0} all-gather-done((bf16[8,64]{1,0}, bf16[8,64]{1,0}) %ag0)
+  %cp = f32[16]{0} collective-permute(f32[16]{0} %p2)
+  %mul = f32[16]{0} multiply(f32[16]{0} %cp, f32[16]{0} %cp)
+"""
+    stats = parse_hlo_collectives(hlo)
+    assert stats["all-reduce"] == {"count": 1, "bytes": 1024 * 4}
+    assert stats["all-gather"]["count"] == 1
+    assert stats["collective-permute"] == {"count": 1, "bytes": 16 * 4}
+    assert "multiply" not in stats
+
+
+def test_watchdog_warn_rearms_during_long_hang():
+    sink = io.StringIO()
+    dog = Watchdog(timeout=0.15, on_timeout="warn", _sink=sink)
+    with dog.step("long hang"):
+        time.sleep(0.5)
+    assert sink.getvalue().count("exceeded 0.15s") >= 2
+
+
+def test_step_timer_warmup_and_rates():
+    t = StepTimer(warmup=2, items_per_step=100)
+    for _ in range(5):
+        with t:
+            time.sleep(0.01)
+    rep = t.report()
+    assert rep["steps"] == 3  # 5 steps - 2 warmup
+    assert rep["step_time_mean_s"] >= 0.009
+    assert rep["items_per_sec"] == pytest.approx(100 / rep["step_time_mean_s"])
+    t2 = StepTimer(warmup=0)
+    for _ in range(3):
+        t2.tick()  # 3 ticks = 2 intervals
+    assert t2.report()["steps"] == 2
+
+
+def test_watchdog_fires_on_hang_and_dumps_stacks():
+    sink = io.StringIO()
+    dog = Watchdog(timeout=0.2, on_timeout="warn", _sink=sink)
+    with dog.step("hung collective"):
+        time.sleep(0.5)
+    assert dog.fired
+    out = sink.getvalue()
+    assert "exceeded 0.2s" in out
+    assert "hung collective" in out
+
+
+def test_watchdog_quiet_on_fast_steps():
+    sink = io.StringIO()
+    dog = Watchdog(timeout=5.0, on_timeout="warn", _sink=sink)
+    for _ in range(3):
+        with dog.step():
+            pass
+    assert not dog.fired
+    assert sink.getvalue() == ""
+
+
+def test_watchdog_rejects_bad_mode():
+    with pytest.raises(ValueError):
+        Watchdog(timeout=1, on_timeout="explode")
