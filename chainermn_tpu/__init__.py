@@ -30,7 +30,10 @@ from chainermn_tpu.links import (
     MultiNodeChainList,
     create_mnbn_model,
 )
-from chainermn_tpu.optimizers import create_multi_node_optimizer
+from chainermn_tpu.optimizers import (
+    create_multi_node_optimizer,
+    create_zero_optimizer,
+)
 from chainermn_tpu.communicators import (
     CommunicatorBase,
     FlatCommunicator,
@@ -56,6 +59,7 @@ __all__ = [
     "SingleNodeCommunicator",
     "create_communicator",
     "create_multi_node_optimizer",
+    "create_zero_optimizer",
     "create_multi_node_evaluator",
     "MultiNodeChainList",
     "MultiNodeBatchNormalization",

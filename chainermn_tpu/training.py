@@ -87,10 +87,12 @@ def jit_train_step(
     """
     body = make_classification_train_step(model, optimizer, comm, train_kwargs)
     data = comm.data_spec
+    # ZeRO-style optimizers shard their state over the mesh (rank-major)
+    opt_spec = getattr(optimizer, "state_spec", P())
     sm = comm.shard_map(
         body,
-        in_specs=(P(), P(), data, data),
-        out_specs=(P(), P(), P()),
+        in_specs=(P(), opt_spec, data, data),
+        out_specs=(P(), opt_spec, P()),
     )
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(sm, donate_argnums=donate_argnums)
@@ -150,10 +152,11 @@ def jit_lm_train_step(
         return params, new_opt_state, comm.allreduce(loss, "mean")
 
     data = P(None, comm.axis_name) if shard_sequence else comm.data_spec
+    opt_spec = getattr(optimizer, "state_spec", P())
     sm = comm.shard_map(
         body,
-        in_specs=(P(), P(), data, data),
-        out_specs=(P(), P(), P()),
+        in_specs=(P(), opt_spec, data, data),
+        out_specs=(P(), opt_spec, P()),
         # Pallas interpret mode can't thread varying-manner metadata through
         # kernel-internal literals (JAX suggests check_vma=False as the
         # workaround); semantics are unchanged, only the static check is off.
