@@ -6,22 +6,76 @@ ImageNet; the 15-min/1024-GPU run sustained ~125 images/sec/GPU on P100).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
 vs_baseline is images/sec/chip divided by the reference's 125 img/s/GPU.
 
+Resilience: TPU backend init can fail transiently (round 1 died with
+``UNAVAILABLE: TPU backend setup/compile error`` before any framework code
+ran), and JAX caches a failed backend for the life of the process — so the
+retry MUST be a fresh process. This script therefore runs as a parent that
+spawns itself with ``--child`` and retries with backoff on initialization
+errors. On final failure it still prints one parseable JSON line carrying the
+error class instead of a bare stack trace.
+
 Runs on whatever accelerator jax sees (the driver provides the real TPU);
 synthetic data — this measures the training step, not input pipelines.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 125.0  # BASELINE.md derived P100 number
+
+# bf16 peak FLOP/s per *jax device* by device_kind substring. v2/v3 expose one
+# core per device (peak is per-core); v4+ expose one chip (megacore).
+_CHIP_PEAK_FLOPS = [
+    ("v6", 918e12),  # Trillium
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 61.5e12),
+    ("v2", 22.5e12),
+]
+
+# Error signatures that mean "backend never came up" (retryable) rather than
+# "the benchmark itself is broken" (not retryable). NOTE: HBM OOM
+# (RESOURCE_EXHAUSTED) is deliberately NOT here — that is handled by the
+# batch-halving loop, not by retrying the same batch in a fresh process.
+_RETRYABLE = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "DEADLINE_EXCEEDED",
+    "failed to connect",
+    "Connection reset",
+    "Socket closed",
+)
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def _chip_peak(device_kind: str):
+    dk = device_kind.lower()
+    for key, peak in _CHIP_PEAK_FLOPS:
+        if key in dk:
+            return peak
+    return None
+
+
+def child_main() -> None:
     import jax
+
+    # Testing hook (the driver never sets this): force a platform. The
+    # config update is required — this container's sitecustomize
+    # force-registers the axon TPU platform and overrides JAX_PLATFORMS.
+    plat = os.environ.get("CHAINERMN_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
     import jax.numpy as jnp
     import optax
 
@@ -30,13 +84,13 @@ def main() -> None:
     from chainermn_tpu.training import jit_train_step
 
     devs = jax.devices()
-    log(f"devices: {devs}")
+    log(f"devices: {devs} (kind={devs[0].device_kind!r})")
     n_chips = len(devs)
 
     comm = chainermn_tpu.create_communicator("tpu", allreduce_grad_dtype="bfloat16")
     model = ResNet50(num_classes=1000)
 
-    batch = 128 * n_chips
+    batch = int(os.environ.get("CHAINERMN_TPU_BENCH_BATCH", "0")) or 128 * n_chips
     while batch >= 8:
         try:
             rng = jax.random.PRNGKey(0)
@@ -51,24 +105,41 @@ def main() -> None:
             opt_state = jax.device_put(opt.init(variables["params"]), comm.named_sharding())
             log(f"init done in {time.time() - t0:.1f}s; batch={batch}")
 
-            step = jit_train_step(model, opt, comm)
+            # One AOT compile serves both execution and the MFU estimate
+            # (a separate lower().compile() would not share the jit cache and
+            # would double the multi-minute ResNet compile).
+            jitted = jit_train_step(model, opt, comm)
+            t0 = time.time()
+            step = jitted.lower(variables, opt_state, images, labels).compile()
+            log(f"compile: {time.time() - t0:.1f}s")
+            # per-DEVICE per-step FLOPs from the compiled (post-SPMD-
+            # partitioning) module — already each chip's share, so the MFU
+            # math below must NOT divide by n_chips again.
+            step_flops = None
+            try:
+                ca = step.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                step_flops = float(ca.get("flops", 0.0)) or None
+            except Exception as e:
+                log(f"cost_analysis unavailable: {e}")
             t0 = time.time()
             variables, opt_state, loss = jax.block_until_ready(
                 step(variables, opt_state, images, labels)
             )
-            log(f"compile+first step: {time.time() - t0:.1f}s; loss={float(loss):.3f}")
+            log(f"first step: {time.time() - t0:.1f}s; loss={float(loss):.3f}")
             for _ in range(2):  # warmup
                 variables, opt_state, loss = jax.block_until_ready(
                     step(variables, opt_state, images, labels)
                 )
             cs = {"total_bytes": 0}
-            # per-step comm traffic from the compiled HLO (stderr only);
-            # costs one extra XLA compile, so opt-in via env
+            # per-step comm traffic read straight from the compiled HLO
+            # (stderr only; opt-in via env)
             if os.environ.get("CHAINERMN_TPU_BENCH_COMMSTATS"):
                 try:
-                    from chainermn_tpu.extensions import collective_stats
+                    from chainermn_tpu.extensions import parse_hlo_collectives
 
-                    cs = collective_stats(step, variables, opt_state, images, labels)
+                    cs = parse_hlo_collectives(step.as_text())
                     detail = ", ".join(
                         f"{k} x{v['count']} ({v['bytes'] / 1e6:.1f}MB)"
                         for k, v in cs.items() if isinstance(v, dict)
@@ -89,17 +160,116 @@ def main() -> None:
                     "effective")
             per_chip = imgs_per_sec / n_chips
             log(f"{n_steps} steps in {dt:.2f}s -> {imgs_per_sec:.1f} img/s total")
-            print(json.dumps({
+            record = {
                 "metric": "resnet50_imagenet_train_throughput",
                 "value": round(per_chip, 2),
                 "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / 125.0, 3),
-            }))
+                "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+            }
+            step_time = dt / n_steps
+            record["step_time_ms"] = round(step_time * 1e3, 2)
+            record["batch_per_chip"] = batch // n_chips
+            record["device_kind"] = devs[0].device_kind
+            if step_flops:
+                achieved = step_flops / step_time  # flops are per-device already
+                record["achieved_tflops_per_chip"] = round(achieved / 1e12, 2)
+                peak = _chip_peak(devs[0].device_kind)
+                if peak:
+                    record["mfu"] = round(achieved / peak, 4)
+                    log(f"MFU: {achieved / peak:.1%} of {peak / 1e12:.0f} TFLOP/s peak")
+            print(json.dumps(record))
             return
         except Exception as e:  # OOM or shape limits: halve and retry
-            log(f"batch {batch} failed: {type(e).__name__}: {str(e)[:200]}")
+            full_msg = f"{type(e).__name__}: {e}"
+            if any(s in full_msg for s in _RETRYABLE):
+                raise  # backend-level failure: let the parent retry a fresh process
+            log(f"batch {batch} failed: {full_msg[:300]}")
             batch //= 2
     raise SystemExit("benchmark could not run at any batch size")
+
+
+def parent_main() -> None:
+    attempts = int(os.environ.get("CHAINERMN_TPU_BENCH_ATTEMPTS", "5"))
+    delay = float(os.environ.get("CHAINERMN_TPU_BENCH_RETRY_DELAY", "10"))
+    # Backend init can HANG (tunnel down) rather than fail fast; a hung child
+    # would otherwise make the whole bench silently exceed the driver's
+    # budget with no JSON emitted. Timeout covers init + compile + 13 steps.
+    attempt_timeout = float(os.environ.get("CHAINERMN_TPU_BENCH_TIMEOUT", "900"))
+    last_tail = ""
+    for i in range(1, attempts + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                timeout=attempt_timeout,
+            )
+        except subprocess.TimeoutExpired as te:
+            log(f"bench attempt {i}/{attempts} timed out after {attempt_timeout:.0f}s")
+            stderr_txt, stdout_txt = te.stderr, te.stdout
+            if isinstance(stderr_txt, bytes):
+                stderr_txt = stderr_txt.decode(errors="replace")
+            if isinstance(stdout_txt, bytes):
+                stdout_txt = stdout_txt.decode(errors="replace")
+            if stderr_txt:
+                sys.stderr.write(stderr_txt)
+            # A child can emit its result and then hang in runtime teardown —
+            # a measurement in hand beats re-running the whole benchmark.
+            for line in reversed((stdout_txt or "").strip().splitlines()):
+                try:
+                    if json.loads(line).get("metric"):
+                        log("child hung after completing; using its result")
+                        print(line)
+                        return
+                except (json.JSONDecodeError, AttributeError):
+                    continue
+            last_tail = f"TimeoutExpired after {attempt_timeout:.0f}s (backend hang?)"
+            if i < attempts:
+                time.sleep(delay)
+                delay = min(delay * 2, 120.0)
+            continue
+        if proc.stderr:  # forward child diagnostics
+            sys.stderr.write(proc.stderr)
+            sys.stderr.flush()
+        out = (proc.stdout or "").strip()
+        if proc.returncode == 0 and out:
+            # forward the child's final JSON line untouched
+            print(out.splitlines()[-1])
+            return
+        last_tail = ((proc.stderr or "") + "\n" + out)[-3000:].strip()
+        retryable = proc.returncode != 0 and (
+            any(s in last_tail for s in _RETRYABLE) or not last_tail
+        )
+        log(f"bench attempt {i}/{attempts} failed (rc={proc.returncode}); "
+            f"{'retrying in %.0fs' % delay if retryable and i < attempts else 'giving up'}")
+        if not retryable:
+            break
+        if i < attempts:
+            time.sleep(delay)
+            delay = min(delay * 2, 120.0)
+    # Final failure: one parseable JSON record, not a stack trace.
+    err_class = next(
+        (s for s in _RETRYABLE + ("TimeoutExpired",) if s in last_tail), "unknown"
+    )
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": err_class,
+        "detail": last_tail[-500:],
+        "attempts": attempts,
+    }))
+    raise SystemExit(1)
+
+
+def main() -> None:
+    if "--child" in sys.argv:
+        # child stdout carries ONLY the JSON record; everything else is stderr
+        child_main()
+    else:
+        parent_main()
 
 
 if __name__ == "__main__":

@@ -108,8 +108,6 @@ class KVStoreObjectComm:
     _instance_counter = 0
 
     def __init__(self) -> None:
-        self.rank = jax.process_index()
-        self.size = jax.process_count()
         from jax._src import distributed  # KV store client (no public alias yet)
 
         client = distributed.global_state.client
@@ -120,6 +118,14 @@ class KVStoreObjectComm:
                 "mpiexec for the same reason)."
             )
         self._client = client
+        self._init_protocol_state(jax.process_index(), jax.process_count())
+
+    def _init_protocol_state(self, rank: int, size: int) -> None:
+        """Transport-independent sequencing/GC state. Subclasses that swap the
+        transport (``NativeObjectComm``) call this instead of ``__init__`` so
+        new protocol fields can never be silently missing there."""
+        self.rank = rank
+        self.size = size
         self._uid = KVStoreObjectComm._instance_counter
         KVStoreObjectComm._instance_counter += 1
         self._op_seq: dict[str, int] = {}

@@ -75,7 +75,15 @@ class CommunicatorBase(abc.ABC):
     @property
     @abc.abstractmethod
     def inter_size(self) -> int:
-        """Number of nodes (processes)."""
+        """Number of nodes (hosts)."""
+
+    @property
+    def process_size(self) -> int:
+        """Number of processes. Equals :attr:`inter_size` except on declared
+        multi-process-per-host launches (``CHAINERMN_TPU_PROCS_PER_HOST``).
+        Host-side data distribution — dataset scattering, per-rank
+        checkpoints, obj-comm worlds — shards over processes, not hosts."""
+        return self.inter_size
 
     @abc.abstractmethod
     def axis_index(self):

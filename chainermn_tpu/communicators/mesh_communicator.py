@@ -36,6 +36,18 @@ def _is_traced(x) -> bool:
     )
 
 
+def _leaf_vma(leaf):
+    """The mesh axes a traced value varies over (its varying manner), or
+    ``None`` when unavailable/untracked (e.g. ``check_vma=False`` tracing) —
+    callers must then assume fully varying, the conservative default for
+    gradient leaves."""
+    try:
+        vma = jax.typeof(leaf).vma
+        return vma if isinstance(vma, frozenset) else frozenset(vma)
+    except Exception:
+        return None
+
+
 class MeshCommunicator(CommunicatorBase):
     """Communicator over one flat mesh axis (or a tuple of axes treated as
     one flattened rank space — the hierarchical subclasses use that)."""
@@ -122,6 +134,10 @@ class MeshCommunicator(CommunicatorBase):
     def inter_size(self) -> int:
         return self._geom.inter_size
 
+    @property
+    def process_size(self) -> int:
+        return self._geom.process_size
+
     def axis_index(self):
         """Traced rank (group-local on split communicators)."""
         idx = lax.axis_index(self._axes)
@@ -159,6 +175,35 @@ class MeshCommunicator(CommunicatorBase):
             x, self._axes, axis_index_groups=self._groups, tiled=False
         )
 
+    def _grouped_sum(self, x):
+        """Group-scoped sum with ring-allreduce wire cost (~2x payload).
+
+        ``lax.psum(axis_index_groups=...)`` is NotImplemented under shard_map
+        in current JAX, but ``psum_scatter`` and ``all_gather`` both take
+        groups — so decompose the allreduce the way the ring algorithm does:
+        reduce-scatter a 1/n shard to each group member, then all-gather the
+        shards back. (The previous fallback all-gathered the full payload:
+        group_size x the bytes.)"""
+        n = self.size
+
+        def leaf(a):
+            flat = jnp.ravel(a)
+            pad = (-flat.size) % n
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            shard = lax.psum_scatter(
+                flat.reshape(n, -1), self._axes, scatter_dimension=0,
+                tiled=False, axis_index_groups=self._groups,
+            )
+            full = lax.all_gather(
+                shard, self._axes, axis_index_groups=self._groups, tiled=False
+            ).reshape(-1)
+            if pad:
+                full = full[: flat.size - pad]
+            return full.reshape(a.shape)
+
+        return jax.tree_util.tree_map(leaf, x)
+
     def _t_allreduce(self, x, op: ReduceOp):
         if self._groups is None:
             if op == "sum":
@@ -174,25 +219,36 @@ class MeshCommunicator(CommunicatorBase):
                     lambda g: jnp.prod(g, axis=0), self._gathered(x)
                 )
             raise ValueError(f"unknown reduce op {op!r}")
-        # Grouped: psum(axis_index_groups=...) is not implemented under
-        # shard_map in current JAX; pmax/pmin are. Emulate sum/mean/prod via
-        # grouped all_gather + local reduction (bytes moved are similar on a
-        # ring; revisit if XLA grows grouped psum here).
         if op == "max":
             return lax.pmax(x, self._axes, axis_index_groups=self._groups)
         if op == "min":
             return lax.pmin(x, self._axes, axis_index_groups=self._groups)
-        g = self._gathered(x)
-        reducer = {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod}.get(op)
-        if reducer is None:
-            raise ValueError(f"unknown reduce op {op!r}")
-        return jax.tree_util.tree_map(lambda a: reducer(a, axis=0), g)
+        if op == "sum":
+            return self._grouped_sum(x)
+        if op == "mean":
+            return jax.tree_util.tree_map(
+                lambda s: s / self.size, self._grouped_sum(x)
+            )
+        if op == "prod":  # no scatter-able primitive for prod: gather+reduce
+            return jax.tree_util.tree_map(
+                lambda a: jnp.prod(a, axis=0), self._gathered(x)
+            )
+        raise ValueError(f"unknown reduce op {op!r}")
 
     def _t_bcast(self, x, root: int):
+        # Masked sum: only root contributes, everyone ends with root's value.
+        # Ungrouped this is one psum (~2x-of-optimal ring traffic, payload-
+        # sized HLO output — independent of mesh size); grouped it rides the
+        # reduce-scatter/all-gather decomposition. (A true collective-
+        # broadcast would halve wire bytes, but JAX exposes neither
+        # collective-broadcast nor multi-destination ppermute.)
+        mask = self.axis_index() == root
+        masked = jax.tree_util.tree_map(
+            lambda a: jnp.where(mask, a, jnp.zeros_like(a)), x
+        )
         if self._groups is None:
-            mask = self.axis_index() == root
-            return lax.psum(jnp.where(mask, x, jnp.zeros_like(x)), self._axes)
-        return self._gathered(x)[root]
+            return lax.psum(masked, self._axes)
+        return self._grouped_sum(masked)
 
     def _t_gather(self, x, root: int):
         del root  # SPMD: the stack is global; "root-ness" is a sharding choice
@@ -202,8 +258,20 @@ class MeshCommunicator(CommunicatorBase):
         return self._gathered(x)
 
     def _t_scatter(self, x, root: int):
-        xroot = self._t_bcast(x, root)
-        return jnp.take(xroot, self.axis_index(), axis=0)
+        # Masked reduce-scatter: root's [size, ...] array is the only nonzero
+        # contribution, so the summed shard each rank receives IS its slice.
+        # O(payload) on the wire vs the previous bcast-the-whole-array+slice
+        # (which shipped size x the useful bytes); works grouped too.
+        if x.shape[0] != self.size:
+            raise ValueError(
+                f"scatter input leading axis {x.shape[0]} != comm size {self.size}"
+            )
+        mask = self.axis_index() == root
+        masked = jnp.where(mask, x, jnp.zeros_like(x))
+        return lax.psum_scatter(
+            masked, self._axes, scatter_dimension=0, tiled=False,
+            axis_index_groups=self._groups,
+        )
 
     def _t_alltoall(self, x):
         if x.shape[0] != self.size:
@@ -402,6 +470,44 @@ class MeshCommunicator(CommunicatorBase):
         if not leaves:
             return grads
         if _is_traced(grads):
+            # The contract is "mean of the per-rank gradients". Leaves that
+            # shard_map's replication tracking marks INVARIANT along a comm
+            # axis are already equal across that axis — their mean over it is
+            # the value itself, so that axis needs NO collective (running the
+            # strategy psum anyway would both waste wire bytes and, worse,
+            # SUM the equal copies into size x the mean). This matters
+            # because differentiating wrt replicated params with a
+            # cross-rank-reduced loss auto-psums the backward: the arriving
+            # grads are the correct global gradient, already invariant (see
+            # test_hand_written_step... in tests/test_training_step.py; our
+            # own step builders instead pcast params to varying so the
+            # strategy owns the collective). With check_vma=False, tracking
+            # is off and every value reports an empty vma — probe a
+            # known-varying value so untracked local grads still take the
+            # strategy path.
+            tracking = bool(_leaf_vma(lax.axis_index(self._axes)))
+            if self._groups is None and tracking:
+                axes = set(self._axes)
+                vmas = [_leaf_vma(l) for l in leaves]
+                pending = [
+                    i for i, v in enumerate(vmas)
+                    if v is not None and not axes.issubset(v)
+                ]
+                if pending:
+                    out = list(leaves)
+                    for i in pending:
+                        # pmean over the still-varying comm axes only;
+                        # fully-invariant leaves pass through untouched
+                        rest = tuple(a for a in self._axes if a in vmas[i])
+                        out[i] = lax.pmean(leaves[i], rest) if rest else leaves[i]
+                    varying = [i for i in range(len(leaves)) if i not in pending]
+                    if varying:
+                        meaned = self._mean_leaves_traced(
+                            [leaves[i] for i in varying]
+                        )
+                        for i, m in zip(varying, meaned):
+                            out[i] = m
+                    return jax.tree_util.tree_unflatten(treedef, out)
             return jax.tree_util.tree_unflatten(
                 treedef, self._mean_leaves_traced(leaves)
             )

@@ -49,6 +49,14 @@ class SubDataset:
         return self._indices
 
 
+def _n_processes(comm) -> int:
+    """Data distribution shards over *processes* (each feeds its devices).
+    ``process_size`` differs from ``inter_size`` only on declared
+    multi-process-per-host launches; the getattr keeps duck-typed comms
+    (host-only shims) working."""
+    return max(1, getattr(comm, "process_size", None) or comm.inter_size)
+
+
 def scatter_index(
     n_total: int, comm: CommunicatorBase, root: int = 0,
     *, n_shards: Optional[int] = None, shard_id: Optional[int] = None,
@@ -57,7 +65,7 @@ def scatter_index(
     this shard's ``(begin, end)``. Reference ``scatter_index``. The first
     ``n_total % n_shards`` shards get one extra element."""
     del root  # pure arithmetic: no transport needed for an index split
-    n = n_shards if n_shards is not None else max(1, comm.inter_size)
+    n = n_shards if n_shards is not None else _n_processes(comm)
     i = shard_id if shard_id is not None else comm.rank
     if not 0 <= i < n:
         raise ValueError(f"shard_id {i} out of range [0, {n})")
@@ -90,7 +98,7 @@ def scatter_dataset(
     tests to emulate N ranks in one process, and by hybrid-parallel setups
     that shard over a sub-axis).
     """
-    n = n_shards if n_shards is not None else max(1, comm.inter_size)
+    n = n_shards if n_shards is not None else _n_processes(comm)
     i = shard_id if shard_id is not None else comm.rank
     if comm.rank == root:
         n_total = len(dataset)
@@ -114,7 +122,7 @@ def scatter_dataset(
             payloads = [[dataset[int(j)] for j in idx] for idx in shards]
         else:
             payloads = None
-        n_proc = max(1, comm.inter_size)
+        n_proc = _n_processes(comm)
         if n == n_proc and shard_id is None:
             # aligned with process geometry: true scatter (each process
             # receives only its shard, the reference's transport pattern)

@@ -69,6 +69,14 @@ class MultiNodeCheckpointer:
                 except OSError:
                     pass
 
+    def _world_size(self) -> int:
+        """Per-rank snapshots exist per PROCESS; world agreement is over the
+        process count (== inter_size except on declared multi-process-per-
+        host launches)."""
+        return max(
+            1, getattr(self._comm, "process_size", None) or self._comm.inter_size
+        )
+
     # -- naming ---------------------------------------------------------- #
 
     def filename(self, iteration: int, rank: Optional[int] = None) -> str:
@@ -96,7 +104,7 @@ class MultiNodeCheckpointer:
         target = self.filename(iteration)
         tmp = target + ".tmp"
         payload = {
-            "world_size": max(1, self._comm.inter_size),
+            "world_size": self._world_size(),
             "state": jax.device_get(state),
         }
         with open(tmp, "wb") as f:
@@ -132,7 +140,7 @@ class MultiNodeCheckpointer:
         t0 = time.time()
         with open(self.filename(it), "rb") as f:
             payload = pickle.load(f)
-        world_now = max(1, self._comm.inter_size)
+        world_now = self._world_size()
         if payload["world_size"] != world_now:
             raise RuntimeError(
                 f"snapshot '{self.name}' iteration {it} was taken with "

@@ -40,8 +40,8 @@ _INSTR_RE = re.compile(
 _SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 
 
-def _type_bytes(type_str: str) -> int:
-    total = 0
+def _shape_bytes_list(type_str: str) -> list[int]:
+    out = []
     for dtype, dims in _SHAPE_RE.findall(type_str):
         if dtype not in _DTYPE_BYTES:
             continue  # token types etc.
@@ -49,8 +49,12 @@ def _type_bytes(type_str: str) -> int:
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES[dtype]
-    return total
+        out.append(n * _DTYPE_BYTES[dtype])
+    return out
+
+
+def _type_bytes(type_str: str) -> int:
+    return sum(_shape_bytes_list(type_str))
 
 
 def parse_hlo_collectives(hlo: str) -> dict[str, Any]:
@@ -67,10 +71,26 @@ def parse_hlo_collectives(hlo: str) -> dict[str, Any]:
         type_str, op = m.group(1), m.group(2)
         if op.endswith("-done"):
             continue
-        base = op[: -len("-start")] if op.endswith("-start") else op
+        is_start = op.endswith("-start")
+        base = op[: -len("-start")] if is_start else op
         if base not in _COLLECTIVES:
             continue
-        nbytes = _type_bytes(type_str)
+        if is_start and type_str.startswith("("):
+            els = _shape_bytes_list(type_str)
+            if base == "all-reduce":
+                # all-reduce-start's tuple members are all RESULTS (XLA's
+                # all-reduce combiner emits variadic ops): count every one.
+                nbytes = sum(els)
+            elif len(els) % 2 == 0:
+                # other async starts return (operands..., results...) pairs —
+                # count the result half, matching the op's sync form (sum
+                # would double-count; max picks the operand for
+                # reduce-scatter).
+                nbytes = sum(els[len(els) // 2 :])
+            else:
+                nbytes = max(els, default=0)
+        else:
+            nbytes = _type_bytes(type_str)
         entry = stats.setdefault(base, {"count": 0, "bytes": 0})
         entry["count"] += 1
         entry["bytes"] += nbytes
@@ -191,9 +211,18 @@ class Watchdog:
         self._sink = _sink or sys.stderr
         self._fired = threading.Event()
         self._timer: Optional[threading.Timer] = None
+        # Generation counter guards the warn-mode re-arm against racing a
+        # step() exit: each step entry/exit bumps the generation, and a timer
+        # carrying a stale generation discards itself instead of re-arming a
+        # watchdog for a step that already finished.
+        self._lock = threading.Lock()
+        self._gen = 0
         self._armed = False
 
-    def _fire(self, where: str) -> None:
+    def _fire(self, where: str, gen: int) -> None:
+        with self._lock:
+            if gen != self._gen or not self._armed:
+                return  # the watched step finished; stale timer, stand down
         self._fired.set()
         import faulthandler
 
@@ -211,11 +240,14 @@ class Watchdog:
             import os
 
             os._exit(43)  # mirror global_except_hook: die loudly, not hang
-        if self._armed:  # warn mode: re-arm so long hangs keep reporting
-            self._start_timer(where)
+        with self._lock:  # warn mode: re-arm so long hangs keep reporting
+            if self._armed and gen == self._gen:
+                self._start_timer_locked(where)
 
-    def _start_timer(self, label: str) -> None:
-        self._timer = threading.Timer(self._timeout, self._fire, args=(label,))
+    def _start_timer_locked(self, label: str) -> None:
+        self._timer = threading.Timer(
+            self._timeout, self._fire, args=(label, self._gen)
+        )
         self._timer.daemon = True
         self._timer.start()
 
@@ -226,11 +258,15 @@ class Watchdog:
 
     @contextlib.contextmanager
     def step(self, label: str = "train step"):
-        self._armed = True
-        self._start_timer(label)
+        with self._lock:
+            self._gen += 1
+            self._armed = True
+            self._start_timer_locked(label)
         try:
             yield
         finally:
-            self._armed = False
-            if self._timer is not None:
-                self._timer.cancel()
+            with self._lock:
+                self._gen += 1
+                self._armed = False
+                if self._timer is not None:
+                    self._timer.cancel()

@@ -107,7 +107,8 @@ class MultiNodeChainList:
         self._run(inputs, call)
         return variables
 
-    def apply(self, variables: Sequence[Any], *inputs, mutable=False):
+    def apply(self, variables: Sequence[Any], *inputs, mutable=False,
+              fused: bool = False):
         """Forward through all components with ICI transfers at boundaries.
 
         Differentiable: ``jax.grad`` of a loss of the output reaches every
@@ -116,6 +117,16 @@ class MultiNodeChainList:
         component's apply; when set, returns ``(output, updated_states)``
         with ``updated_states`` a per-component list ({} for stateless
         components) to merge back into ``variables``.
+
+        ``fused=True`` builds ONE jitted program over the whole chain
+        (forward AND, under ``jax.grad``, one backward program) instead of a
+        jit per stage: no per-stage Python dispatch, XLA schedules across
+        stage boundaries, numerics identical. The program runs replicated
+        over the communicator's mesh, so pass variables replicated (see
+        :meth:`replicate`) — the memory layout trades the default mode's
+        per-rank parameter placement for single-program dispatch. For
+        homogeneous chains that want true microbatch overlap, use
+        ``chainermn_tpu.ops.pipeline``.
         """
         if len(variables) != len(self._components):
             raise ValueError(
@@ -123,6 +134,8 @@ class MultiNodeChainList:
                 f"{len(self._components)} components"
             )
         mutable_key = tuple(mutable) if isinstance(mutable, (list, tuple)) else mutable
+        if fused:
+            return self._fused_apply(list(variables), inputs, mutable_key)
         updated: list[Any] = []
 
         def call(comp, idx, args):
@@ -144,6 +157,49 @@ class MultiNodeChainList:
         if mutable_key:
             return out, updated
         return out
+
+    def replicate(self, variables: Sequence[Any]):
+        """Re-place per-component variables replicated over the mesh — do
+        this once before training with ``apply(..., fused=True)``."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self._comm.mesh, P())
+        return [jax.device_put(v, sharding) for v in variables]
+
+    def _fused_apply(self, variables, inputs, mutable_key):
+        """One jitted program over the whole chain (see ``apply``).
+
+        Inside a single trace there are no device boundaries to cross — the
+        mailbox walk is ordinary data flow, and placement is carried by the
+        (replicated) input shardings.
+        """
+        cache_key = ("fused", mutable_key, len(variables))
+        fn = self._apply_cache.get(cache_key)
+        if fn is None:
+            def body(variables, inputs):
+                updated: list[Any] = []
+
+                def call(comp, idx, args):
+                    if mutable_key:
+                        y, upd = comp.link.apply(
+                            variables[idx], *args, mutable=mutable_key
+                        )
+                        updated.append(upd)
+                        return y
+                    return comp.link.apply(variables[idx], *args)
+
+                out = self._run_traced(inputs, call)
+                return (out, updated) if mutable_key else out
+
+            fn = jax.jit(body)
+            self._apply_cache[cache_key] = fn
+        return fn(variables, inputs)
+
+    def _run_traced(self, inputs, call):
+        """The mailbox walk without device_put hops (single-trace variant of
+        :meth:`_run` — used by the fused path where everything is one
+        program and placement is carried by the input shardings)."""
+        return self._run(inputs, call, place=lambda x, rank: x)
 
     def merge_updates(self, variables: Sequence[Any], updated: Sequence[Any]):
         """Merge ``apply(..., mutable=...)``'s updated state collections back

@@ -28,9 +28,11 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -107,17 +109,25 @@ struct Store {
   bool shutting_down = false;
 };
 
-void ServeConn(Store* s, int fd) {
-  int one = 1;
-  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+// The listener is unauthenticated; any stray connection (port scan, health
+// probe speaking HTTP) gets parsed as a frame header. Cap lengths BEFORE
+// allocating so garbage headers can't trigger multi-GB allocations, and
+// treat anything over the cap as an unrecoverable framing error (the stream
+// can't be resynced, so the connection is dropped).
+constexpr uint32_t kMaxKeyLen = 1u << 16;        // 64 KiB
+constexpr uint64_t kMaxValueLen = 1ull << 31;    // 2 GiB
+
+void ServeConnLoop(Store* s, int fd) {
   for (;;) {
     uint8_t op;
     uint32_t klen;
     uint64_t vlen;
     if (!ReadN(fd, &op, 1) || !ReadN(fd, &klen, 4)) break;
+    if (klen > kMaxKeyLen) break;
     std::string key(klen, '\0');
     if (klen && !ReadN(fd, key.data(), klen)) break;
     if (!ReadN(fd, &vlen, 8)) break;
+    if (vlen > kMaxValueLen) break;
     std::string value(vlen, '\0');
     if (vlen && !ReadN(fd, value.data(), vlen)) break;
     uint32_t crc;
@@ -142,7 +152,15 @@ void ServeConn(Store* s, int fd) {
       }
       case 2: {  // GET (blocking; value field = decimal timeout_ms)
         long timeout_ms = 600000;
-        if (!value.empty()) timeout_ms = std::stol(value);
+        if (!value.empty()) {
+          // strtol, not stol: non-numeric input from a stray connection must
+          // not throw. Garbage keeps the default timeout.
+          char* end = nullptr;
+          errno = 0;
+          long parsed = ::strtol(value.c_str(), &end, 10);
+          if (errno == 0 && end && *end == '\0' && parsed >= 0)
+            timeout_ms = parsed;
+        }
         std::unique_lock<std::mutex> lk(s->m);
         bool ok = s->cv.wait_for(
             lk, std::chrono::milliseconds(timeout_ms), [&] {
@@ -192,6 +210,31 @@ void ServeConn(Store* s, int fd) {
     }
   }
 done:
+  return;
+}
+
+void ServeConn(Store* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // A throwing handler (bad_alloc on a huge-but-under-cap value, etc.) must
+  // kill only this connection, never the store-host process — every rank's
+  // blocking gets hang if the store dies.
+  try {
+    ServeConnLoop(s, fd);
+  } catch (...) {
+  }
+  // Drop our fd from the shutdown list before closing it: the number can be
+  // recycled by the OS, and objstore_server_stop must not shutdown() an
+  // unrelated live socket (e.g. a jax.distributed connection).
+  {
+    std::lock_guard<std::mutex> lk(s->m);
+    for (auto it = s->conn_fds.begin(); it != s->conn_fds.end(); ++it) {
+      if (*it == fd) {
+        s->conn_fds.erase(it);
+        break;
+      }
+    }
+  }
   ::close(fd);
 }
 

@@ -131,6 +131,13 @@ class ObjStoreServer:
         self.stop()
 
 
+# Mirror objstore.cc's frame caps: the server drops a connection mid-stream
+# on oversize frames (it cannot resync), so the client must refuse first
+# with a diagnosable error.
+MAX_KEY_LEN = 1 << 16
+MAX_VALUE_LEN = 1 << 31
+
+
 class ObjStoreClient:
     """One TCP connection to the store (thread-safe; the C side serializes
     roundtrips per connection)."""
@@ -146,6 +153,12 @@ class ObjStoreClient:
 
     def put(self, key: str, value: bytes) -> None:
         kb = key.encode()
+        if len(kb) > MAX_KEY_LEN or len(value) > MAX_VALUE_LEN:
+            raise ValueError(
+                f"objstore frame too large (key {len(kb)}B, value "
+                f"{len(value)}B; caps {MAX_KEY_LEN}/{MAX_VALUE_LEN}) — "
+                "chunk the payload (NativeObjectComm does this automatically)"
+            )
         buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) if value else None
         rc = self._lib.objstore_put(self._h, kb, len(kb), buf, len(value))
         if rc != 0:
@@ -204,30 +217,44 @@ class NativeObjectComm(KVStoreObjectComm):
 
     Reuses the KV-store comm's sequencing + ack-GC protocol (the logic is
     transport-independent) with the raw-bytes TCP transport swapped in —
-    no base64, CRC-checked frames. Payloads live at ``<key>/raw`` so the
-    shared GC (which deletes the ``<key>/`` subtree) covers them.
+    no base64, CRC-checked frames. Payloads live under ``<key>/`` (chunked
+    ``c<i>`` frames + ``hdr``) so the shared GC (which deletes the
+    ``<key>/`` subtree) covers them.
     """
 
     def __init__(self, rank: Optional[int] = None, size: Optional[int] = None,
                  address: Optional[str] = None) -> None:
         import jax
 
-        self.rank = jax.process_index() if rank is None else rank
-        self.size = jax.process_count() if size is None else size
         address = address or os.environ["CHAINERMN_TPU_OBJSTORE"]
         host, port = address.rsplit(":", 1)
         self._store = ObjStoreClient(host, int(port))
-        self._uid = KVStoreObjectComm._instance_counter
-        KVStoreObjectComm._instance_counter += 1
-        self._op_seq = {}
-        self._p2p_seq = {}
-        self._pending = {}
+        self._init_protocol_state(
+            jax.process_index() if rank is None else rank,
+            jax.process_count() if size is None else size,
+        )
+
+    # Payloads above the wire-frame cap are split across numbered keys. The
+    # tiny header frame is always written LAST, and readers block only on it
+    # — its presence implies every data frame is already in the store.
+    _CHUNK = 256 << 20
 
     def _put(self, key: str, payload: bytes) -> None:
-        self._store.put(key + "/raw", payload)
+        n = -(-len(payload) // self._CHUNK) if payload else 1
+        for i in range(n):
+            self._store.put(
+                f"{key}/c{i}", payload[i * self._CHUNK : (i + 1) * self._CHUNK]
+            )
+        self._store.put(key + "/hdr", f"{len(payload)}:{n}".encode())
 
     def _get(self, key: str, timeout_ms: int = 600_000) -> bytes:
-        return self._store.get(key + "/raw", timeout_ms)
+        hdr = self._store.get(key + "/hdr", timeout_ms)
+        total, n = (int(v) for v in hdr.decode().split(":"))
+        payload = b"".join(
+            self._store.get(f"{key}/c{i}", timeout_ms) for i in range(n)
+        )
+        assert len(payload) == total
+        return payload
 
     def _delete_dir(self, key_prefix: str) -> None:
         try:

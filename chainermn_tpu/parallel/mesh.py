@@ -100,24 +100,50 @@ class RankGeometry:
 
     size: int            # total devices on the comm axis
     rank: int            # this process's rank (process space)
-    intra_rank: int      # this process's rank within its node
-    inter_rank: int      # this process's node index
-    intra_size: int      # devices per process (ICI-local)
-    inter_size: int      # number of processes
+    intra_rank: int      # device-space offset of this process within its node
+    inter_rank: int      # this process's node (host) index
+    intra_size: int      # devices per node; inter_size * intra_size == size
+    inter_size: int      # number of nodes (hosts)
+    process_size: int    # number of processes (== inter_size unless a
+    #                      multi-process-per-host launch is declared; the
+    #                      data path — dataset scattering, per-rank
+    #                      checkpoints — shards over THIS, not hosts)
     local_device_ranks: tuple[int, ...]  # device ranks this process controls
 
     @classmethod
     def from_mesh(cls, mesh: Mesh) -> "RankGeometry":
+        """Geometry for the calling process.
+
+        Supported launches run ONE jax process per host (the standard TPU
+        pattern), so ``intra_rank`` is 0 and ``inter_*`` is process-space.
+        Multi-process-per-host launches (e.g. one process per chip on a GPU-
+        style rig) must declare it via ``CHAINERMN_TPU_PROCS_PER_HOST=k`` —
+        jax exposes no portable physical-host identity, so this is an
+        explicit contract rather than a silent (and then wrong) assumption;
+        an undeclared mismatch raises instead of mis-numbering ranks.
+        """
+        import os
+
         devs = list(mesh.devices.flat)
         pidx = jax.process_index()
         procs = sorted({d.process_index for d in devs})
         local = tuple(i for i, d in enumerate(devs) if d.process_index == pidx)
+        n_proc = len(procs)
+        pph = int(os.environ.get("CHAINERMN_TPU_PROCS_PER_HOST", "1"))
+        if pph < 1 or (n_proc % pph and pidx in procs):
+            raise ValueError(
+                f"CHAINERMN_TPU_PROCS_PER_HOST={pph} does not divide the "
+                f"{n_proc} participating processes"
+            )
+        my = procs.index(pidx) if pidx in procs else 0
+        n_local = max(1, len(local))
         return cls(
             size=len(devs),
             rank=pidx,
-            intra_rank=0,  # one jax process per host in supported launches
-            inter_rank=procs.index(pidx) if pidx in procs else 0,
-            intra_size=max(1, len(local)),
-            inter_size=len(procs),
+            intra_rank=(my % pph) * n_local,
+            inter_rank=my // pph,
+            intra_size=n_local * pph,
+            inter_size=max(1, n_proc // pph),
+            process_size=max(1, n_proc),
             local_device_ranks=local,
         )

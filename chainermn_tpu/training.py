@@ -41,6 +41,17 @@ def make_classification_train_step(
         params = variables["params"]
         rest = {k: v for k, v in variables.items() if k != "params"}
         mutable = list(rest.keys())
+        # Differentiate wrt a VARYING view of the (replicated) params: under
+        # shard_map's replication-tracking semantics, grad-of-varying-loss
+        # wrt invariant params would insert an automatic cross-rank psum in
+        # the backward — the grads arriving at the optimizer would already be
+        # SUMMED (n x the mean, a silent lr scale) and the communicator
+        # strategy's own collective (packed buffers, wire dtype, two-level
+        # meshes) would be bypassed. pcast keeps the grads per-rank local so
+        # the multi-node optimizer owns the one true reduction.
+        params_v = jax.tree_util.tree_map(
+            lambda a: jax.lax.pcast(a, comm.axis_name, to="varying"), params
+        )
 
         def loss_fn(p):
             if mutable:
@@ -55,7 +66,7 @@ def make_classification_train_step(
             ).mean()
             return loss, updated
 
-        (loss, updated), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        (loss, updated), grads = jax.value_and_grad(loss_fn, has_aux=True)(params_v)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         # replica-consistent mutable state (BN running stats are tiny; one
@@ -93,6 +104,8 @@ def jit_train_step(
         body,
         in_specs=(P(), opt_spec, data, data),
         out_specs=(P(), opt_spec, P()),
+        # ZeRO's all_gather'd updates defeat static replication inference
+        check_vma=getattr(optimizer, "check_vma", True),
     )
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(sm, donate_argnums=donate_argnums)
@@ -139,6 +152,10 @@ def jit_lm_train_step(
     def body(params, opt_state, tokens, targets):
         t_local = tokens.shape[1]
         pos_offset = comm.axis_index() * t_local if shard_sequence else 0
+        # varying view for local grads — see make_classification_train_step
+        params_v = jax.tree_util.tree_map(
+            lambda a: jax.lax.pcast(a, comm.axis_name, to="varying"), params
+        )
 
         def loss_fn(p):
             logits = model.apply(p, tokens, pos_offset)
@@ -146,7 +163,7 @@ def jit_lm_train_step(
                 logits, targets
             ).mean()
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss, grads = jax.value_and_grad(loss_fn)(params_v)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_opt_state, comm.allreduce(loss, "mean")
@@ -161,7 +178,9 @@ def jit_lm_train_step(
         # kernel-internal literals (JAX suggests check_vma=False as the
         # workaround); semantics are unchanged, only the static check is off.
         # Compiled TPU kernels don't need the workaround — keep the check on.
-        check_vma=(attn != "flash" or jax.default_backend() == "tpu"),
+        # ZeRO's all_gather'd updates likewise defeat the static check.
+        check_vma=(attn != "flash" or jax.default_backend() == "tpu")
+        and getattr(optimizer, "check_vma", True),
     )
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(sm, donate_argnums=donate_argnums)

@@ -86,9 +86,28 @@ def test_parse_hlo_async_collective_pairs():
 """
     stats = parse_hlo_collectives(hlo)
     assert stats["all-reduce"] == {"count": 1, "bytes": 1024 * 4}
-    assert stats["all-gather"]["count"] == 1
+    # async all-gather tuple is (operand, result): payload counted ONCE
+    assert stats["all-gather"] == {"count": 1, "bytes": 8 * 64 * 2}
     assert stats["collective-permute"] == {"count": 1, "bytes": 16 * 4}
     assert "multiply" not in stats
+
+
+def test_parse_hlo_async_variadic_and_reduce_scatter():
+    """XLA's all-reduce combiner emits variadic all-reduce-start whose tuple
+    members are all results (count every one); reduce-scatter-start's tuple
+    is (operand, result) where the operand is N x the result (count only the
+    result, matching the sync form)."""
+    from chainermn_tpu.extensions import parse_hlo_collectives
+
+    hlo = """
+  %arv = (f32[1000]{0}, f32[10]{0}) all-reduce-start(f32[1000]{0} %a, f32[10]{0} %b)
+  %arvd = (f32[1000]{0}, f32[10]{0}) all-reduce-done((f32[1000]{0}, f32[10]{0}) %arv)
+  %rs = (f32[1024]{0}, f32[128]{0}) reduce-scatter-start(f32[1024]{0} %c)
+  %rsd = f32[128]{0} reduce-scatter-done((f32[1024]{0}, f32[128]{0}) %rs)
+"""
+    stats = parse_hlo_collectives(hlo)
+    assert stats["all-reduce"] == {"count": 1, "bytes": (1000 + 10) * 4}
+    assert stats["reduce-scatter"] == {"count": 1, "bytes": 128 * 4}
 
 
 def test_watchdog_warn_rearms_during_long_hang():

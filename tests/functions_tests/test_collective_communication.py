@@ -84,16 +84,30 @@ def test_bcast_backward_sums_at_root(comm, root):
 def test_scatter_gather_roundtrip_and_grad(comm):
     n = comm.size
 
-    def step(x):
-        y = F.scatter(x, comm, root=0)      # each rank gets its row
-        return F.gather(y, comm, root=0)    # stack them back
+    def roundtrip(x):
+        y = F.scatter(x, comm, root=0)      # each rank gets its row: [2]
+        return F.gather(y, comm, root=0)    # stack them back: [n, 2]
 
-    x = np.broadcast_to(
-        np.arange(n * 2, dtype=np.float32).reshape(n, 2), (n, n, 2)
-    ).copy()
-    f = jax.jit(comm.shard_map(step, in_specs=P(comm.axis_name), out_specs=P(comm.axis_name)))
-    y = np.asarray(f(x))
-    np.testing.assert_allclose(y[0], x[0])
+    x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+    # out_specs stacks every rank's copy (VMA can't statically infer the
+    # gather output as replicated): [n*n, 2], each block must equal x
+    f = jax.jit(comm.shard_map(roundtrip, in_specs=P(), out_specs=P(comm.axis_name)))
+    y = np.asarray(f(x)).reshape(n, n, 2)
+    for r in range(n):
+        np.testing.assert_allclose(y[r], x)
+
+    # backward of scatter gathers cotangents onto root: with a summed square
+    # loss every rank's row lands back at its slot of root's input
+    def loss(x):
+        y = F.scatter(x, comm, root=0)
+        return comm.allreduce((y * y).sum(), "sum")
+
+    g = jax.jit(
+        comm.shard_map(jax.grad(loss), in_specs=P(), out_specs=P(comm.axis_name))
+    )(x)
+    g = np.asarray(g).reshape(n, n, 2)
+    for r in range(n):
+        np.testing.assert_allclose(g[r], 2 * x, rtol=1e-6)
 
 
 def test_allreduce_function_grad(comm):

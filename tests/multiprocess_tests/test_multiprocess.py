@@ -1,0 +1,72 @@
+"""True multi-process tests: the DCN/object-comm path under real
+``jax.distributed`` processes (SURVEY.md S4 test-contract item (b) — the
+analog of the reference's ``mpiexec -n 2 pytest`` runs).
+
+Spawns N fresh Python processes (the in-process conftest already owns the
+jax runtime, so workers must be subprocesses), joins them through a local
+coordinator, and runs ``worker.py``'s scenario suite over the
+coordination-service KV store.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_world(size: int, tmpdir: str, timeout: float = 240.0):
+    port = _free_port()
+    env_base = {
+        k: v for k, v in os.environ.items()
+        # XLA_FLAGS: the conftest's forced 8-device flag is for THIS process;
+        # workers stay at 1 CPU device each so the geometry is process-shaped.
+        # CHAINERMN_TPU_OBJSTORE: these tests pin the KV-store transport —
+        # an ambient native-sidecar address must not redirect them.
+        if k not in ("XLA_FLAGS", "CHAINERMN_TPU_OBJSTORE")
+    }
+    procs = []
+    for r in range(size):
+        env = dict(
+            env_base,
+            MP_TEST_RANK=str(r),
+            MP_TEST_SIZE=str(size),
+            MP_TEST_PORT=str(port),
+            MP_TEST_TMPDIR=tmpdir,
+            PYTHONPATH=_REPO + os.pathsep + env_base.get("PYTHONPATH", ""),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_multiprocess_suite(size, tmp_path):
+    procs, outs = _launch_world(size, str(tmp_path))
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"rank {r} failed (rc={p.returncode}):\n{out[-4000:]}"
+        )
+        assert f"WORKER_OK {r}" in out, f"rank {r} did not finish:\n{out[-4000:]}"

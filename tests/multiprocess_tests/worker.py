@@ -1,0 +1,212 @@
+"""Subprocess body for the true multi-process (DCN-path) tests.
+
+The reference exercises its object comm under ``mpiexec -n 2`` (SURVEY.md
+S4); the TPU-rebuild analog is N processes joined through
+``jax.distributed.initialize`` whose coordination KV store carries
+``KVStoreObjectComm`` traffic. This worker runs the full host-side suite —
+obj collectives, typed-pytree p2p, ack-GC key deletion, ``scatter_dataset``
+with ``force_transport``, checkpointer agreement with a deliberately missing
+snapshot, and the multi-node/synchronized iterators — and prints
+``WORKER_OK <rank>`` only if every scenario passes.
+
+Run via ``test_multiprocess.py`` (spawns the processes), not directly.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+class HostComm:
+    """Minimal communicator facade over an object comm: exactly the surface
+    ``scatter_dataset`` / checkpointer / iterators need (``rank``,
+    ``inter_size``, ``*_obj``). A full ``MeshCommunicator`` would add device
+    collectives; host-side subsystems must work without them."""
+
+    def __init__(self, oc, rank, size):
+        self._oc = oc
+        self.rank = rank
+        self.size = size
+        self.inter_size = size
+        self.intra_rank = 0
+
+    def __getattr__(self, name):
+        if name.endswith("_obj") or name == "barrier":
+            return getattr(self._oc, name)
+        raise AttributeError(name)
+
+
+def check(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+
+
+def scenario_collectives(oc, rank, size):
+    # bcast: nested mixed payload
+    payload = {"a": np.arange(6, dtype=np.float32), "b": ("x", [1, 2, 3])}
+    got = oc.bcast_obj(payload if rank == 0 else None, root=0)
+    check(np.array_equal(got["a"], np.arange(6, dtype=np.float32)), "bcast a")
+    check(got["b"] == ("x", [1, 2, 3]), "bcast b")
+
+    # gather at a non-zero root (roots can rotate)
+    g = oc.gather_obj(rank * 10, root=size - 1)
+    if rank == size - 1:
+        check(g == [r * 10 for r in range(size)], f"gather: {g}")
+    else:
+        check(g is None, "gather non-root must get None")
+
+    # scatter
+    objs = [f"shard-{r}" for r in range(size)] if rank == 0 else None
+    got = oc.scatter_obj(objs, root=0)
+    check(got == f"shard-{rank}", f"scatter: {got}")
+
+    # allgather + allreduce
+    ag = oc.allgather_obj({"r": rank})
+    check([d["r"] for d in ag] == list(range(size)), f"allgather: {ag}")
+    s = oc.allreduce_obj(rank + 1)
+    check(s == sum(range(1, size + 1)), f"allreduce sum: {s}")
+
+    oc.barrier()
+
+
+def scenario_p2p(oc, rank, size):
+    # typed pytree both directions between 0 and 1 (the _MessageType parity
+    # payload: nested tuple of mixed-dtype ndarrays)
+    tree = (
+        np.arange(4, dtype=np.int32),
+        {"f": np.ones((2, 3), np.float16), "s": "tag"},
+        [np.float64(2.5)],
+    )
+    if rank == 0:
+        oc.send_obj(tree, dest=1, tag=7)
+        back = oc.recv_obj(source=1, tag=8)
+        check(np.array_equal(back[0], np.arange(4, dtype=np.int32) * 2), "p2p back")
+    elif rank == 1:
+        got = oc.recv_obj(source=0, tag=7)
+        check(np.array_equal(got[0], np.arange(4, dtype=np.int32)), "p2p fwd int32")
+        check(got[1]["f"].dtype == np.float16 and got[1]["s"] == "tag", "p2p fwd f16")
+        oc.send_obj((got[0] * 2,), dest=0, tag=8)
+    oc.barrier()
+
+
+def scenario_ack_gc(oc, rank, size):
+    # Round keys must actually get deleted once every reader acked. GC is
+    # lazy: round k's keys die when the writer's NEXT use of the op runs
+    # _gc_pending and sees all acks. Barriers make ack arrival deterministic.
+    import re
+
+    uid = oc._uid
+    prefix = f"chainermn_tpu/obj/{uid}/bcast/"
+    oc.bcast_obj("round0" if rank == 0 else None, root=0)
+    oc.barrier()  # all readers have acked round 0
+    oc.bcast_obj("round1" if rank == 0 else None, root=0)  # root GCs round 0
+    oc.barrier()
+    if rank == 0:
+        keys = oc._client.key_value_dir_get(prefix)
+        left = [k for k in keys if re.search(r"/bcast/0/", str(k))]
+        check(not left, f"ack-GC left round-0 keys: {left}")
+    oc.barrier()
+
+
+def scenario_scatter_dataset(comm, rank, size):
+    from chainermn_tpu.datasets import scatter_dataset
+
+    data = [(i, f"rec{i}") for i in range(23)]  # only root "can read" it
+    shard = scatter_dataset(
+        data if rank == 0 else None, comm, shuffle=True, seed=5,
+        force_transport=True,
+    )
+    local = list(shard)
+    counts = comm._oc.allgather_obj([rec[0] for rec in local])
+    flat = sorted(i for sub in counts for i in sub)
+    check(flat == list(range(23)), f"scatter_dataset not a partition: {flat}")
+    lo, hi = 23 // size, -(-23 // size)
+    check(all(lo <= len(s) <= hi for s in counts),
+          f"unbalanced: {[len(s) for s in counts]}")
+
+
+def scenario_checkpointer(comm, rank, size, tmpdir):
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+
+    cp = create_multi_node_checkpointer("mp", comm, path=tmpdir)
+    state = {"w": np.full((3,), float(rank)), "it": 0}
+    cp.save(state, iteration=1)
+    cp.save({**state, "it": 2}, iteration=2)
+    comm._oc.barrier()
+    if rank == 1:  # rank 1 "lost" its newest snapshot
+        os.remove(cp.filename(2))
+    comm._oc.barrier()
+    loaded, it = cp.maybe_load()
+    check(it == 1, f"agreement must fall back to newest COMMON iteration, got {it}")
+    check(float(loaded["w"][0]) == float(rank), "checkpoint rank-local state")
+    comm._oc.barrier()
+    cp.finalize()
+
+
+def scenario_iterators(comm, rank, size):
+    from chainermn_tpu.iterators import (
+        SerialIterator,
+        create_multi_node_iterator,
+        create_synchronized_iterator,
+    )
+
+    data = list(range(10))
+    base = SerialIterator(data, batch_size=3, repeat=False, shuffle=False) \
+        if rank == 0 else None
+    it = create_multi_node_iterator(base, comm, rank_master=0)
+    batches = []
+    try:
+        while True:
+            batches.append(next(it))
+    except StopIteration:
+        pass
+    all_b = comm._oc.allgather_obj(batches)
+    check(all(b == all_b[0] for b in all_b), f"multi-node iterator diverged: {all_b}")
+    check(sum(len(b) for b in all_b[0]) == 10, "iterator lost records")
+
+    sync = SerialIterator(data, batch_size=5, shuffle=True)
+    sync = create_synchronized_iterator(sync, comm)
+    first = next(sync)
+    orders = comm._oc.allgather_obj(first)
+    check(all(o == orders[0] for o in orders), f"synchronized iterator diverged: {orders}")
+
+
+def main():
+    rank = int(os.environ["MP_TEST_RANK"])
+    size = int(os.environ["MP_TEST_SIZE"])
+    port = os.environ["MP_TEST_PORT"]
+    tmpdir = os.environ["MP_TEST_TMPDIR"]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=size,
+        process_id=rank,
+    )
+    check(jax.process_index() == rank, "process_index mismatch")
+    check(jax.process_count() == size, "process_count mismatch")
+
+    from chainermn_tpu.communicators._object_comm import (
+        KVStoreObjectComm,
+        create_object_comm,
+    )
+
+    oc = create_object_comm()
+    check(isinstance(oc, KVStoreObjectComm), f"expected KV transport, got {type(oc)}")
+    comm = HostComm(oc, rank, size)
+
+    scenario_collectives(oc, rank, size)
+    scenario_p2p(oc, rank, size)
+    scenario_ack_gc(oc, rank, size)
+    scenario_scatter_dataset(comm, rank, size)
+    scenario_checkpointer(comm, rank, size, tmpdir)
+    scenario_iterators(comm, rank, size)
+
+    print(f"WORKER_OK {rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,6 +7,7 @@ store — the transport and protocol are exercised for real, only the process
 boundary is simulated."""
 
 import concurrent.futures as cf
+import re
 import zlib
 
 import numpy as np
@@ -175,6 +176,8 @@ def test_native_comm_repeated_rounds_gc(server):
     # 5 rounds happened; all but the last (acks checked lazily on the NEXT
     # round) should have been garbage-collected
     payload_keys = [k for k in live if "/bcast/" in k and "/payload/" in k]
-    assert len(payload_keys) == 1, (payload_keys, live)
-    assert payload_keys[0].endswith("/payload/raw")
+    rounds = {re.search(r"/bcast/(\d+)/", k).group(1) for k in payload_keys}
+    assert rounds == {"4"}, (payload_keys, live)
+    # a payload is hdr + >=1 chunk frames, all under the round's subtree
+    assert any(k.endswith("/payload/hdr") for k in payload_keys)
     probe.close()

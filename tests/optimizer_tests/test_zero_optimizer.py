@@ -19,7 +19,11 @@ def comm():
 
 
 def _setup(comm, optimizer):
-    model = MLP(n_units=16, n_out=4)
+    # f32 compute: the parity tests compare two independently-compiled
+    # trajectories, and bf16 rounding differs per compilation (check_vma
+    # changes fusion) — in bf16 a 1-ULP step-1 difference snowballs through
+    # momentum into O(1) loss divergence and the comparison is meaningless
+    model = MLP(n_units=16, n_out=4, compute_dtype=jnp.float32)
     rng = np.random.RandomState(0)
     images = jnp.asarray(rng.rand(4 * comm.size, 28, 28), jnp.float32)
     labels = jnp.asarray(rng.randint(0, 4, 4 * comm.size))
@@ -47,6 +51,8 @@ def test_zero_matches_unsharded(comm, inner):
     for _ in range(4):
         vars_r, st_r, loss_r = step_r(vars_r, st_r, images, labels)
         vars_z, st_z, loss_z = step_z(vars_z, st_z, images, labels)
+    # f32 compute keeps the two independently-compiled trajectories
+    # comparable to float noise (check_vma=False changes fusion slightly)
     np.testing.assert_allclose(float(loss_z), float(loss_r), rtol=1e-5)
     for lr, lz in zip(jax.tree_util.tree_leaves(vars_r["params"]),
                       jax.tree_util.tree_leaves(vars_z["params"])):
@@ -80,6 +86,35 @@ def test_zero_rejects_hierarchical_and_split(comm):
     sub = comm.split([r % 2 for r in range(comm.size)])
     with pytest.raises(ValueError, match="split"):
         chainermn_tpu.create_zero_optimizer(optax.adam(1e-3), sub)
+
+
+def test_zero_preserves_mixed_param_dtypes(comm):
+    """Moments run in f32 internally, but updates must come back in each
+    leaf's own dtype so bf16 params stay bf16 through apply_updates
+    (VERDICT r1 #10)."""
+    n = comm.size
+    params = {
+        "w16": jnp.full((n * 4,), 0.5, jnp.bfloat16),
+        "w32": jnp.full((3, 3), 0.5, jnp.float32),
+    }
+    zero_opt = chainermn_tpu.create_zero_optimizer(optax.adam(1e-2), comm)
+    state = jax.device_put(zero_opt.init(params),
+                           comm.named_sharding(*zero_opt.state_spec))
+
+    def body(params, state):
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        updates, state = zero_opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    step = jax.jit(comm.shard_map(
+        body, in_specs=(P(), zero_opt.state_spec),
+        out_specs=(P(), zero_opt.state_spec), check_vma=zero_opt.check_vma,
+    ))
+    new_params, _ = step(params, state)
+    assert new_params["w16"].dtype == jnp.bfloat16
+    assert new_params["w32"].dtype == jnp.float32
+    # and the update actually moved the params
+    assert float(np.asarray(new_params["w32"])[0, 0]) != 0.5
 
 
 def test_zero_learns(comm):

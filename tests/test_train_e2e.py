@@ -55,13 +55,17 @@ def test_data_parallel_training_e2e(comm):
 
     def loss_fn(p, xb, yb):
         logits = model.apply(p, xb)
-        return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+        local = optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+        # hand-written steps define the GLOBAL objective; the auto-psum'd
+        # backward then yields the exact global gradient (invariant), which
+        # multi_node_mean_grad passes through untouched
+        return comm.allreduce(local, "mean")
 
     def train_step(p, s, xb, yb):
         loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
         updates, s = opt.update(grads, s, p)
         p = optax.apply_updates(p, updates)
-        return p, s, comm.allreduce(loss, "mean")[None]
+        return p, s, loss[None]
 
     step = jax.jit(
         comm.shard_map(

@@ -48,3 +48,107 @@ def test_step_with_batch_stats(comm):
     old = jax.tree_util.tree_leaves(old)
     new = jax.tree_util.tree_leaves(v1["batch_stats"])
     assert any(not np.allclose(a, b) for a, b in zip(old, new))
+
+
+@pytest.mark.parametrize(
+    "strategy", ["tpu", "flat", "naive", "hierarchical", "two_dimensional",
+                 "single_node"]
+)
+def test_step_update_equals_global_batch_gradient(strategy):
+    """Every strategy's distributed step must produce the SAME first update
+    as a single-device step on the full global batch — i.e. it applies the
+    MEAN of per-rank grads, not the sum. Regression test for the shard_map
+    replication-tracking auto-psum: differentiating wrt invariant params
+    yields pre-summed grads, which double-counted with the communicator's
+    own mean and silently scaled the effective lr by comm.size (r2 fix in
+    training.py: pcast params to varying before the local grad)."""
+    import flax.linen as nn
+
+    comm = chainermn_tpu.create_communicator(strategy)
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4, use_bias=False,
+                            kernel_init=nn.initializers.zeros)(x)
+
+    model = Lin()
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(4 * comm.size, 3), jnp.float32)
+    labels = jnp.asarray(np.arange(4 * comm.size) % 4)
+    variables = model.init(jax.random.PRNGKey(0), images[:1])
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(1.0), comm)
+    st = jax.device_put(opt.init(variables["params"]), comm.named_sharding())
+    step = jit_train_step(model, opt, comm, donate=False)
+    v1, _, _ = step(variables, st, images, labels)
+
+    def global_loss(p):
+        logits = model.apply(p, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    g = jax.grad(global_loss)(variables)
+    truth = -1.0 * np.asarray(g["params"]["Dense_0"]["kernel"])
+    np.testing.assert_allclose(
+        np.asarray(v1["params"]["Dense_0"]["kernel"]), truth,
+        rtol=1e-5, atol=1e-7,
+    )
+
+
+def test_hand_written_step_global_mean_loss_is_exact(comm):
+    """The hand-written user recipe: define the GLOBAL objective
+    (``comm.allreduce(local_mean, "mean")``) and differentiate wrt the
+    replicated params — shard_map's replication tracking auto-psums the
+    backward, so the grads arriving at the optimizer are already the exact
+    global gradient, marked invariant. multi_node_mean_grad must pass those
+    through untouched (mean of equal copies == the value; the strategy psum
+    would sum them into size x the gradient)."""
+    import flax.linen as nn
+    from jax.sharding import PartitionSpec as P
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4, use_bias=False,
+                            kernel_init=nn.initializers.zeros)(x)
+
+    model = Lin()
+    rng = np.random.RandomState(1)
+    images = jnp.asarray(rng.randn(4 * comm.size, 3), jnp.float32)
+    labels = jnp.asarray(np.arange(4 * comm.size) % 4)
+    params = model.init(jax.random.PRNGKey(0), images[:1])
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(1.0), comm)
+
+    def train_step(p, s, xb, yb):
+        def loss_fn(p):
+            logits = model.apply(p, xb)
+            local = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb
+            ).mean()
+            return comm.allreduce(local, "mean")  # the global objective
+
+        grads = jax.grad(loss_fn)(p)  # auto-psummed: exact global gradient
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s
+
+    step = jax.jit(comm.shard_map(
+        train_step,
+        in_specs=(P(), P(), comm.data_spec, comm.data_spec),
+        out_specs=(P(), P()),
+    ))
+    p1, _ = step(params, opt.init(params["params"]), images, labels)
+
+    def global_loss(p):
+        logits = model.apply(p, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    truth = -1.0 * np.asarray(
+        jax.grad(global_loss)(params)["params"]["Dense_0"]["kernel"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(p1["params"]["Dense_0"]["kernel"]), truth,
+        rtol=1e-5, atol=1e-7,
+    )
