@@ -75,6 +75,10 @@ class MultiNodeChainList:
         self._comm = comm
         self._components: list[_Component] = []
         self._apply_cache: dict[int, Any] = {}
+        # Number of times the fused body was traced. Under jit (the only way
+        # the fused path runs it), staying at 1 across repeated same-shape
+        # calls means no retrace and hence no recompile — tests assert that.
+        self.fused_trace_count = 0
 
     # ------------------------------------------------------------------ #
 
@@ -177,6 +181,7 @@ class MultiNodeChainList:
         fn = self._apply_cache.get(cache_key)
         if fn is None:
             def body(variables, inputs):
+                self.fused_trace_count += 1
                 updated: list[Any] = []
 
                 def call(comp, idx, args):
@@ -210,17 +215,21 @@ class MultiNodeChainList:
 
     # ------------------------------------------------------------------ #
 
-    def _run(self, inputs, call):
+    def _run(self, inputs, call, place=None):
         """Forward walker. ``mailbox[(src_rank, dst_rank)]`` holds in-flight
         tensors — the single-controller descendant of the reference's
-        delegate queue."""
+        delegate queue. ``place(x, rank)`` moves a boundary tensor onto the
+        consumer rank; the default is a committed ``jax.device_put`` (an ICI
+        hop between stage devices), while the fused single-trace path passes
+        identity since there are no device boundaries inside one program."""
+        if place is None:
+            place = lambda x, rank: jax.device_put(x, self._device(rank))  # noqa: E731
         mailbox: dict[tuple[int, int], list[Any]] = {}
         outputs: list[Any] = []
         for idx, comp in enumerate(self._components):
-            dev = self._device(comp.rank)
             # gather inputs: model inputs, or queued sends from rank_in
             if not comp.rank_in:
-                args = [jax.device_put(x, dev) for x in inputs]
+                args = [place(x, comp.rank) for x in inputs]
             else:
                 args = []
                 for src in comp.rank_in:
@@ -231,7 +240,7 @@ class MultiNodeChainList:
                             f"input from rank {src}, but nothing was sent — "
                             "check add_link order and rank_in/rank_out wiring"
                         )
-                    args.append(jax.device_put(q.pop(0), dev))  # <- "recv"
+                    args.append(place(q.pop(0), comp.rank))  # <- "recv"
             y = call(comp, idx, args)
             # route outputs
             if not comp.rank_out:

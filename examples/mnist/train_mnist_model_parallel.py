@@ -67,6 +67,11 @@ def main() -> None:
     parser.add_argument("--data", type=str, default=None)
     parser.add_argument("--n-train", type=int, default=8000)
     parser.add_argument("--n-test", type=int, default=1000)
+    parser.add_argument(
+        "--fused", action="store_true",
+        help="one jitted program over the whole chain (replicated variables) "
+             "instead of a jit per stage",
+    )
     args = parser.parse_args()
 
     chainermn_tpu.add_global_except_hook()
@@ -87,13 +92,19 @@ def main() -> None:
     it = chainermn_tpu.SerialIterator(train, args.batchsize, shuffle=True, seed=1)
 
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)))
-    # One optimizer per stage, exactly like the reference (each rank owns its
-    # stage's optimizer state, co-located with the stage's parameters).
+    if args.fused:
+        # Fused mode trades per-rank placement for a single compiled
+        # program: variables are replicated over the mesh and the whole
+        # chain (and its backward) is one XLA program.
+        variables = model.replicate(variables)
+    # One optimizer per stage, exactly like the reference. In the default
+    # mode each stage's optimizer state is co-located with its parameters on
+    # the owning rank; under --fused it follows the replicated placement.
     optimizer = optax.adam(1e-3)
     opt_states = [optimizer.init(v) for v in variables]
 
     def loss_fn(variables, images, labels):
-        logits = model.apply(variables, images)
+        logits = model.apply(variables, images, fused=args.fused)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, labels
         ).mean()
@@ -117,7 +128,7 @@ def main() -> None:
             test, args.batchsize, repeat=False, shuffle=False
         ):
             images, labels = collate(batch)
-            logits = model.apply(variables, images)
+            logits = model.apply(variables, images, fused=args.fused)
             correct += int((np.argmax(np.asarray(logits), -1) == labels).sum())
             n += len(labels)
         return {"validation/main/accuracy": correct / max(n, 1)}

@@ -52,6 +52,13 @@ def test_train_mnist_model_parallel():
     assert "epoch   1" in proc.stdout
 
 
+def test_train_mnist_model_parallel_fused():
+    proc = run_example(
+        "mnist/train_mnist_model_parallel.py", TINY_MNIST + ["--fused"]
+    )
+    assert "epoch   1" in proc.stdout
+
+
 def test_train_mnist_checkpoint_crash_resume(tmp_path):
     args = ["--epoch", "2", "--n-train", "512", "--unit", "32",
             "--batchsize", "32", "--frequency", "2", "--out", str(tmp_path)]
