@@ -164,6 +164,7 @@ class ZeroOptimizer(NamedTuple):
 def create_zero_optimizer(
     actual_optimizer: optax.GradientTransformation,
     communicator: CommunicatorBase,
+    wire_dtype: Optional[Any] = None,
 ) -> ZeroOptimizer:
     """ZeRO-1: shard optimizer state over the data-parallel axis.
 
@@ -171,13 +172,22 @@ def create_zero_optimizer(
     sharded optimizer states absent upstream: grads and moments are
     replicated there). Per step, inside the traced program:
 
-    1. local gradients are flattened and ``psum_scatter``'d — each rank
-       receives the cross-rank MEAN of its own 1/n slice of the parameter
-       vector (same wire bytes as one allreduce's reduce half);
-    2. the inner optimizer updates only that slice, with its moments stored
-       rank-major ``[n, shard]`` and sharded over the mesh — per-device
-       optimizer memory is ``full/n`` (the ZeRO-1 saving);
-    3. the updates are ``all_gather``'d back so parameters stay replicated.
+    1. local gradients are flattened — in the **wire dtype** — and
+       ``psum_scatter``'d: each rank receives the cross-rank MEAN of its own
+       1/n slice of the parameter vector (same wire bytes as one allreduce's
+       reduce half);
+    2. the inner optimizer updates only that slice **in f32** (moments are
+       always f32 regardless of wire dtype), stored rank-major ``[n, shard]``
+       and sharded over the mesh — per-device optimizer memory is ``full/n``
+       (the ZeRO-1 saving);
+    3. the update shards are cast back to the wire dtype and ``all_gather``'d
+       so parameters stay replicated.
+
+    The wire dtype — the dtype both collectives move — resolves as:
+    explicit ``wire_dtype`` arg > the communicator's ``allreduce_grad_dtype``
+    (the reference's compressed-allreduce knob) > the common dtype of the
+    gradient leaves (``jnp.result_type``), so all-bf16 gradients ride the
+    wire in bf16 (half the bytes) without any flag.
 
     Constraints: the inner optimizer must be *elementwise* (sgd, momentum,
     adam(w), rmsprop... — anything whose update for parameter i depends only
@@ -203,10 +213,20 @@ def create_zero_optimizer(
         raise ValueError("create_zero_optimizer does not support split() "
                          "sub-communicators")
     n = communicator.size
+    if wire_dtype is None:
+        wire_dtype = getattr(communicator, "allreduce_grad_dtype", None)
+    if wire_dtype is not None:
+        wire_dtype = jnp.dtype(wire_dtype)
 
-    def _flatten(tree):
+    def _wire(tree):
+        """The dtype the collectives move for this gradient tree."""
+        if wire_dtype is not None:
+            return wire_dtype
+        return jnp.result_type(*jax.tree_util.tree_leaves(tree))
+
+    def _flatten(tree, dtype):
         leaves = jax.tree_util.tree_leaves(tree)
-        flat = jnp.concatenate([l.ravel().astype(jnp.float32) for l in leaves])
+        flat = jnp.concatenate([l.ravel().astype(dtype) for l in leaves])
         pad = (-flat.size) % n
         if pad:
             flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
@@ -222,9 +242,10 @@ def create_zero_optimizer(
 
     def init(params):
         """Host-side: inner state over the rank-major [n, shard] gradient
-        layout; every leaf is given a leading rank axis so ONE spec shards
-        the whole state."""
-        flat = _flatten(params)
+        layout (f32 — moments stay full precision whatever the wire dtype);
+        every leaf is given a leading rank axis so ONE spec shards the whole
+        state."""
+        flat = _flatten(params, jnp.float32)
         shards = flat.reshape(n, flat.size // n)
         inner = actual_optimizer.init(shards)
         return jax.tree_util.tree_map(
@@ -234,25 +255,31 @@ def create_zero_optimizer(
         )
 
     def update(grads, state, params=None):
-        flat_g = _flatten(grads)
+        wire = _wire(grads)
+        flat_g = _flatten(grads, wire)
         shard_len = flat_g.size // n
-        # cross-rank mean of MY slice only (reduce half of an allreduce)
+        # cross-rank mean of MY slice only (reduce half of an allreduce),
+        # moved in the wire dtype — bf16 grads pay bf16 bytes
         g_shard = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
                                    tiled=True) / n
         idx = communicator.axis_index()
         p_shard = None
         if params is not None:
-            p_shard = lax.dynamic_slice(_flatten(params), (idx * shard_len,),
-                                        (shard_len,))
-        # local view of the sharded state: [1, ...] -> drop the rank axis
+            p_shard = lax.dynamic_slice(
+                _flatten(params, jnp.float32), (idx * shard_len,), (shard_len,)
+            )
+        # local view of the sharded state: [1, ...] -> drop the rank axis;
+        # the inner optimizer runs in f32 whatever the wire dtype
         local = jax.tree_util.tree_map(lambda l: l[0], state)
-        upd_shard, new_local = actual_optimizer.update(g_shard, local, p_shard)
+        upd_shard, new_local = actual_optimizer.update(
+            g_shard.astype(jnp.float32), local, p_shard
+        )
         new_state = jax.tree_util.tree_map(lambda l: l[None], new_local)
         # gather the disjoint update shards back so params stay replicated —
-        # a true all_gather (1x param bytes on the wire; see check_vma note
-        # on ZeroOptimizer for why the step runs with the static replication
-        # check off)
-        flat_u = lax.all_gather(upd_shard, axis, tiled=True)
+        # a true all_gather in the wire dtype (1x wire-dtype param bytes; see
+        # check_vma note on ZeroOptimizer for why the step runs with the
+        # static replication check off)
+        flat_u = lax.all_gather(upd_shard.astype(wire), axis, tiled=True)
         return _unflatten(flat_u, grads), new_state
 
     from jax.sharding import PartitionSpec as P

@@ -117,6 +117,67 @@ def test_zero_preserves_mixed_param_dtypes(comm):
     assert float(np.asarray(new_params["w32"])[0, 0]) != 0.5
 
 
+def test_zero_wire_dtype_halves_bytes(comm):
+    """bf16 gradients must ride the wire in bf16: the ZeRO step's collective
+    bytes (psum_scatter + all_gather) halve versus f32 gradients (VERDICT r2
+    #7). Bytes are read via parse_hlo_collectives from the PRE-optimization
+    HLO: XLA:CPU legalizes bf16 collectives to f32 (a test-backend artifact
+    — TPU moves bf16 natively), so the compiled text would hide the wire
+    dtype the program actually requests."""
+    from chainermn_tpu.extensions import parse_hlo_collectives
+
+    n = comm.size
+    zero_opt = chainermn_tpu.create_zero_optimizer(optax.adam(1e-2), comm)
+
+    def hlo_bytes(dtype):
+        params = {"w": jnp.zeros((n * 256,), dtype)}
+        state = jax.device_put(zero_opt.init(params),
+                               comm.named_sharding(*zero_opt.state_spec))
+
+        def body(params, state):
+            grads = jax.tree_util.tree_map(jnp.ones_like, params)
+            updates, state = zero_opt.update(grads, state, params)
+            return optax.apply_updates(params, updates), state
+
+        step = jax.jit(comm.shard_map(
+            body, in_specs=(P(), zero_opt.state_spec),
+            out_specs=(P(), zero_opt.state_spec), check_vma=zero_opt.check_vma,
+        ))
+        hlo = step.lower(params, state).as_text(dialect="hlo")
+        return parse_hlo_collectives(hlo)["total_bytes"]
+
+    b32 = hlo_bytes(jnp.float32)
+    b16 = hlo_bytes(jnp.bfloat16)
+    assert b16 <= 0.55 * b32, (b16, b32)
+
+
+def test_zero_explicit_wire_dtype_overrides(comm):
+    """An explicit wire_dtype (or the communicator's allreduce_grad_dtype)
+    compresses even f32 gradients, mirroring the reference's fp16 allreduce
+    knob; the trajectory still tracks the uncompressed one loosely."""
+    n = comm.size
+    opt_c = chainermn_tpu.create_zero_optimizer(
+        optax.sgd(0.1), comm, wire_dtype=jnp.bfloat16
+    )
+    params = {"w": jnp.full((n * 8,), 0.5, jnp.float32)}
+    state = jax.device_put(opt_c.init(params),
+                           comm.named_sharding(*opt_c.state_spec))
+
+    def body(params, state):
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        updates, state = opt_c.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    step = jax.jit(comm.shard_map(
+        body, in_specs=(P(), opt_c.state_spec),
+        out_specs=(P(), opt_c.state_spec), check_vma=opt_c.check_vma,
+    ))
+    new_params, _ = step(params, state)
+    # sgd(0.1) on grad=1 from 0.5 -> 0.4 (exactly representable in bf16)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 0.4, rtol=1e-2)
+    assert new_params["w"].dtype == jnp.float32  # leaf dtype restored
+
+
 def test_zero_learns(comm):
     zero_opt = chainermn_tpu.create_zero_optimizer(optax.adam(2e-3), comm)
     step, variables, opt_state, images, labels = _setup(comm, zero_opt)
