@@ -17,7 +17,7 @@ rides the process-space object comm (``_object_comm.py``).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -48,9 +48,28 @@ def _leaf_vma(leaf):
         return None
 
 
+class _MessageType(NamedTuple):
+    """Typed p2p header: structure + per-leaf metadata, sent before the raw
+    buffers — the descendant of the reference's ``_MessageType`` (shape/
+    dtype/tuple-structure of ndarray trees, ``[U] .../mpi_communicator_base
+    .py`` SURVEY.md S2.2). Dtypes are carried as ``np.dtype`` objects so
+    extended dtypes (bfloat16 via ml_dtypes) round-trip exactly."""
+
+    treedef: Any
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[np.dtype, ...]
+
+
 class MeshCommunicator(CommunicatorBase):
     """Communicator over one flat mesh axis (or a tuple of axes treated as
     one flattened rank space — the hierarchical subclasses use that)."""
+
+    # Whether steps traced over this communicator can keep shard_map's static
+    # replication (VMA) check on. Strategies whose lowering contains an
+    # all_gather that is provably-but-not-statically replicated (currently
+    # TwoDimensionalCommunicator) set this False; comm.shard_map and the
+    # training-step builders read it.
+    check_vma = True
 
     def __init__(
         self,
@@ -157,8 +176,12 @@ class MeshCommunicator(CommunicatorBase):
         """PartitionSpec sharding a leading batch axis over the comm axis."""
         return P(self._axes if len(self._axes) > 1 else self._axes[0])
 
-    def shard_map(self, f, in_specs, out_specs, check_vma: bool = True):
-        """``jax.shard_map`` bound to this communicator's mesh."""
+    def shard_map(self, f, in_specs, out_specs, check_vma: bool | None = None):
+        """``jax.shard_map`` bound to this communicator's mesh. ``check_vma``
+        defaults to the communicator's own :attr:`check_vma` (strategies with
+        statically-unprovable replication turn the check off)."""
+        if check_vma is None:
+            check_vma = self.check_vma
         return jax.shard_map(
             f, mesh=self._mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=check_vma,
@@ -392,25 +415,64 @@ class MeshCommunicator(CommunicatorBase):
             )
 
     def send(self, x, dest: int, tag: int = 0) -> None:
+        """Typed p2p send of an **array pytree** (single arrays included):
+        a ``_MessageType`` header (treedef, shapes, dtypes) goes first, then
+        one raw buffer per leaf — the reference's ndarray-tree ``send``
+        protocol, re-hosted on the object transport. ``recv`` reconstructs
+        the exact structure and dtypes."""
         if _is_traced(x):
             raise RuntimeError(
                 "comm.send inside traced code: use chainermn_tpu.functions."
                 "send (differentiable, ppermute-based) for in-step p2p."
             )
         self._check_process_rank("dest", dest)
+        leaves, treedef = jax.tree_util.tree_flatten(x)
+        arrays = [np.asarray(l) for l in leaves]
+        header = _MessageType(
+            treedef,
+            tuple(a.shape for a in arrays),
+            tuple(a.dtype for a in arrays),
+        )
         if dest == self.rank:
-            self._mailbox.setdefault(tag, []).append(np.asarray(x))
+            # copy: the remote path hands the receiver fresh buffers, so the
+            # self-send path must too (no sender/receiver aliasing)
+            self._mailbox.setdefault(tag, []).append(
+                (header, [np.array(a) for a in arrays])
+            )
         else:
-            self._obj.send_obj(np.asarray(x), dest, tag)
+            self._obj.send_obj(header, dest, tag)
+            for a in arrays:
+                self._obj.send_obj(np.ascontiguousarray(a).tobytes(), dest, tag)
 
     def recv(self, source: int, tag: int = 0):
+        """Receive an array pytree sent by :meth:`send`: header first, then
+        the leaf buffers, reassembled to the sent structure (a bare array in
+        comes back as a bare array). Leaves come back as **numpy** arrays
+        with the exact sent dtypes (f64 included — ``jnp.asarray`` would
+        silently downcast without x64 mode); pass them straight into jitted
+        code or ``device_put`` as needed."""
         self._check_process_rank("source", source)
         if source == self.rank:
             q = self._mailbox.get(tag)
             if not q:
                 raise RuntimeError(f"recv(source={source}, tag={tag}): nothing sent")
-            return jnp.asarray(q.pop(0))
-        return jnp.asarray(self._obj.recv_obj(source, tag))
+            header, arrays = q.pop(0)
+        else:
+            header = self._obj.recv_obj(source, tag)
+            if not isinstance(header, _MessageType):
+                raise RuntimeError(
+                    f"recv(source={source}, tag={tag}): expected a "
+                    f"_MessageType header, got {type(header).__name__} — "
+                    "pair comm.recv with comm.send (use recv_obj for "
+                    "send_obj traffic)"
+                )
+            arrays = [
+                np.frombuffer(
+                    self._obj.recv_obj(source, tag), dtype=dt
+                ).reshape(shape)
+                for shape, dt in zip(header.shapes, header.dtypes)
+            ]
+        return jax.tree_util.tree_unflatten(header.treedef, list(arrays))
 
     # ------------------------------------------------------------------ #
     # Object communication (delegates to process-space transport)         #

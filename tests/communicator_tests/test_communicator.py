@@ -195,6 +195,42 @@ def test_host_send_recv(comm):
     np.testing.assert_allclose(np.asarray(y), x)
 
 
+def test_host_send_recv_typed_pytree(comm):
+    """Typed p2p ships whole array pytrees — the reference's _MessageType
+    protocol (tuples/trees of ndarrays through send/recv, SURVEY.md S2.2):
+    nested structure, mixed dtypes (incl. bf16), exact reconstruction."""
+    tree = {
+        "a": np.arange(6, dtype=np.int32).reshape(2, 3),
+        "nested": (
+            jnp.full((4,), 1.5, jnp.bfloat16),
+            [np.float64(2.5), np.ones((1, 2), np.float16)],
+        ),
+    }
+    comm.send(tree, dest=comm.rank, tag=3)
+    out = comm.recv(source=comm.rank, tag=3)
+    assert set(out.keys()) == {"a", "nested"}
+    np.testing.assert_array_equal(np.asarray(out["a"]), tree["a"])
+    assert out["a"].dtype == np.int32
+    b, (c, d) = out["nested"][0], (out["nested"][1][0], out["nested"][1][1])
+    assert b.dtype == jnp.bfloat16 and float(b[0]) == 1.5
+    assert c.dtype == np.float64 and float(c) == 2.5  # f64 survives exactly
+    assert d.dtype == np.float16 and d.shape == (1, 2)
+    # no sender/receiver aliasing on the self-send path (remote recv hands
+    # out fresh buffers; local must match)
+    src = np.zeros((3,), np.float32)
+    comm.send(src, dest=comm.rank, tag=8)
+    got = comm.recv(source=comm.rank, tag=8)
+    got += 1.0
+    assert float(src.sum()) == 0.0
+    # ordering: two in-flight messages on one tag stay FIFO
+    comm.send(np.zeros(2), dest=comm.rank, tag=9)
+    comm.send(np.ones(2), dest=comm.rank, tag=9)
+    first = comm.recv(source=comm.rank, tag=9)
+    second = comm.recv(source=comm.rank, tag=9)
+    assert float(np.asarray(first).sum()) == 0.0
+    assert float(np.asarray(second).sum()) == 2.0
+
+
 def test_host_send_rejects_device_rank(comm):
     """Host p2p is process-space; device ranks belong to functions.send."""
     if comm.size > 1:

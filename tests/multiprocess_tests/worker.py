@@ -90,6 +90,44 @@ def scenario_p2p(oc, rank, size):
     oc.barrier()
 
 
+def scenario_array_p2p(comm2, rank, size):
+    """The ARRAY p2p API (MeshCommunicator.send/recv, typed _MessageType
+    header + raw buffers) across real processes — distinct from the obj
+    pickle path in scenario_p2p (VERDICT r2 #5)."""
+    import jax.numpy as jnp
+
+    tree = {
+        "i": np.arange(5, dtype=np.int32) + rank,
+        "pair": (np.full((2, 2), 1.5, np.float32),
+                 jnp.full((3,), 0.25, jnp.bfloat16)),
+    }
+    if rank == 0:
+        comm2.send(tree, dest=1, tag=11)
+        back = comm2.recv(source=1, tag=12)
+        check(np.array_equal(np.asarray(back["i"]),
+                             np.arange(5, dtype=np.int32) * 3),
+              "array p2p round trip values")
+        check(back["pair"][1].dtype == jnp.bfloat16, "array p2p bf16 dtype")
+    elif rank == 1:
+        got = comm2.recv(source=0, tag=11)
+        check(np.asarray(got["i"]).dtype == np.int32, "array p2p int32")
+        check(got["pair"][1].dtype == jnp.bfloat16, "array p2p bf16 fwd")
+        reply = {
+            "i": np.asarray(got["i"]) * 3,
+            "pair": (np.asarray(got["pair"][0]),
+                     jnp.asarray(got["pair"][1])),
+        }
+        comm2.send(reply, dest=0, tag=12)
+    comm2._obj.barrier()
+
+
+def _list_keys(oc, prefix):
+    """Transport-agnostic key listing (KV store vs native sidecar)."""
+    if hasattr(oc, "_store"):
+        return oc._store.list_prefix(prefix)
+    return oc._client.key_value_dir_get(prefix)
+
+
 def scenario_ack_gc(oc, rank, size):
     # Round keys must actually get deleted once every reader acked. GC is
     # lazy: round k's keys die when the writer's NEXT use of the op runs
@@ -103,7 +141,7 @@ def scenario_ack_gc(oc, rank, size):
     oc.bcast_obj("round1" if rank == 0 else None, root=0)  # root GCs round 0
     oc.barrier()
     if rank == 0:
-        keys = oc._client.key_value_dir_get(prefix)
+        keys = _list_keys(oc, prefix)
         left = [k for k in keys if re.search(r"/bcast/0/", str(k))]
         check(not left, f"ack-GC left round-0 keys: {left}")
     oc.barrier()
@@ -194,12 +232,26 @@ def main():
         create_object_comm,
     )
 
+    transport = os.environ.get("MP_TEST_TRANSPORT", "kv")
     oc = create_object_comm()
-    check(isinstance(oc, KVStoreObjectComm), f"expected KV transport, got {type(oc)}")
+    if transport == "native":
+        check(type(oc).__name__ == "NativeObjectComm",
+              f"expected native transport, got {type(oc)}")
+    else:
+        check(type(oc) is KVStoreObjectComm,
+              f"expected KV transport, got {type(oc)}")
     comm = HostComm(oc, rank, size)
 
     scenario_collectives(oc, rank, size)
     scenario_p2p(oc, rank, size)
+
+    # Real MeshCommunicator for the typed ARRAY p2p path (its send/recv ride
+    # the same object transport but speak the _MessageType protocol).
+    import chainermn_tpu
+
+    comm_mesh = chainermn_tpu.create_communicator("naive")
+    scenario_array_p2p(comm_mesh, rank, size)
+
     scenario_ack_gc(oc, rank, size)
     scenario_scatter_dataset(comm, rank, size)
     scenario_checkpointer(comm, rank, size, tmpdir)
