@@ -64,6 +64,18 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
     through the slow leg — the bandwidth-optimal decomposition the reference's
     two-dimensional strategy approximates."""
 
+    # The gather leg is a true all_gather whose output JAX's static
+    # replication (VMA) tracking cannot prove replicated over the intra axis
+    # (all_gather output is conservatively 'varying'), so steps built on this
+    # strategy must run with the replication check off — same trade ZeRO-1
+    # made for its update gather (optimizers.ZeroOptimizer.check_vma). The
+    # library's own step builders and comm.shard_map read this attribute;
+    # semantics are unchanged, only the static check is disabled. The win
+    # over the previous one-hot-psum formulation: an all_gather of B bytes
+    # moves ~B on the wire where a ring all-reduce of the B-sized slab moved
+    # ~2B — the gather leg's traffic halves.
+    check_vma = False
+
     def _mean_leaves_traced(self, leaves):
         if self._groups is not None:
             return MeshCommunicator._mean_leaves_traced(self, leaves)
@@ -79,20 +91,7 @@ class TwoDimensionalCommunicator(HierarchicalCommunicator):
                 buf = jnp.concatenate([buf, jnp.zeros((pad,), buf.dtype)])
             shard = lax.psum_scatter(buf, intra, scatter_dimension=0, tiled=True)
             shard = lax.psum(shard, inter)
-            # Final all-gather leg, expressed as a one-hot psum. Why not
-            # lax.all_gather: JAX's VMA analysis does not mark all_gather
-            # output replicated over the gathered axis, which would force
-            # check_vma=False (or 'reduced'-annotated out_specs) onto every
-            # user's shard_map. The trade: the slab is a full-buffer-sized
-            # temporary (mostly zeros) and a ring psum over it moves ~2x the
-            # bytes of the all_gather it replaces — acceptable for a parity
-            # strategy whose slow leg is DCN anyway; switch to
-            # all_gather(..., to='reduced') once reduced out_specs are
-            # plumbed through the public API.
-            idx = lax.axis_index(intra)
-            slab = jnp.zeros((n_intra, shard.shape[0]), shard.dtype)
-            slab = lax.dynamic_update_index_in_dim(slab, shard, idx, 0)
-            full = lax.psum(slab, intra).reshape(-1)
+            full = lax.all_gather(shard, intra, tiled=True)
             out.append(full[:n] * scale)
         return _memory_utility.unpack_leaves(out, metas)
 

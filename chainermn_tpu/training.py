@@ -25,6 +25,7 @@ def make_classification_train_step(
     optimizer: optax.GradientTransformation,
     comm: CommunicatorBase,
     train_kwargs: Optional[dict] = None,
+    label_smoothing: float = 0.0,
 ) -> Callable:
     """Build the per-rank step body (to be wrapped by :func:`jit_train_step`).
 
@@ -61,9 +62,15 @@ def make_classification_train_step(
             else:
                 logits = model.apply({"params": p}, images, **train_kwargs)
                 updated = {}
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, labels
-            ).mean()
+            if label_smoothing:
+                targets = optax.smooth_labels(
+                    jax.nn.one_hot(labels, logits.shape[-1]), label_smoothing
+                )
+                loss = optax.softmax_cross_entropy(logits, targets).mean()
+            else:
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean()
             return loss, updated
 
         (loss, updated), grads = jax.value_and_grad(loss_fn, has_aux=True)(params_v)
@@ -87,6 +94,7 @@ def jit_train_step(
     comm: CommunicatorBase,
     donate: bool = True,
     train_kwargs: Optional[dict] = None,
+    label_smoothing: float = 0.0,
 ) -> Callable:
     """The full jitted SPMD train step over the communicator's mesh.
 
@@ -96,7 +104,9 @@ def jit_train_step(
     updates in-place on HBM (the reference's grow-only arenas play this role,
     SURVEY.md S2.9).
     """
-    body = make_classification_train_step(model, optimizer, comm, train_kwargs)
+    body = make_classification_train_step(
+        model, optimizer, comm, train_kwargs, label_smoothing
+    )
     data = comm.data_spec
     # ZeRO-style optimizers shard their state over the mesh (rank-major)
     opt_spec = getattr(optimizer, "state_spec", P())
@@ -104,8 +114,10 @@ def jit_train_step(
         body,
         in_specs=(P(), opt_spec, data, data),
         out_specs=(P(), opt_spec, P()),
-        # ZeRO's all_gather'd updates defeat static replication inference
-        check_vma=getattr(optimizer, "check_vma", True),
+        # ZeRO's all_gather'd updates and the 2D strategy's all_gather leg
+        # both defeat static replication inference
+        check_vma=getattr(optimizer, "check_vma", True)
+        and getattr(comm, "check_vma", True),
     )
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(sm, donate_argnums=donate_argnums)
@@ -180,7 +192,8 @@ def jit_lm_train_step(
         # Compiled TPU kernels don't need the workaround — keep the check on.
         # ZeRO's all_gather'd updates likewise defeat the static check.
         check_vma=(attn != "flash" or jax.default_backend() == "tpu")
-        and getattr(optimizer, "check_vma", True),
+        and getattr(optimizer, "check_vma", True)
+        and getattr(comm, "check_vma", True),
     )
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(sm, donate_argnums=donate_argnums)
