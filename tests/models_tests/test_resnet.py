@@ -41,6 +41,32 @@ def test_tiny_resnet_forward_backward():
     assert all(jnp.all(jnp.isfinite(l)) for l in jax.tree_util.tree_leaves(g))
 
 
+def test_space_to_depth_stem():
+    """The s2d stem must keep the downstream shapes identical to the conv7
+    stem (2x spatial reduction before the maxpool) and train end-to-end."""
+    kw = dict(stage_sizes=[1, 1], width=8, num_classes=5,
+              compute_dtype=jnp.float32)
+    std = ResNet(**kw)
+    s2d = ResNet(**kw, stem="space_to_depth")
+    x = jnp.ones((2, 32, 32, 3))
+    v_std = std.init(jax.random.PRNGKey(0), x, train=True)
+    v_s2d = s2d.init(jax.random.PRNGKey(0), x, train=True)
+    y_std, _ = std.apply(v_std, x, train=True, mutable=["batch_stats"])
+    y_s2d, _ = s2d.apply(v_s2d, x, train=True, mutable=["batch_stats"])
+    assert y_s2d.shape == y_std.shape
+    # stem kernel is (4, 4, 4*3, width) instead of (7, 7, 3, width)
+    assert v_s2d["params"]["stem_conv"]["kernel"].shape == (4, 4, 12, 8)
+    g = jax.grad(
+        lambda p: s2d.apply(
+            {"params": p, **{k: v for k, v in v_s2d.items() if k != "params"}},
+            x, train=True, mutable=["batch_stats"],
+        )[0].sum()
+    )(v_s2d["params"])
+    assert all(jnp.all(jnp.isfinite(l)) for l in jax.tree_util.tree_leaves(g))
+    with pytest.raises(ValueError, match="even"):
+        s2d.init(jax.random.PRNGKey(0), jnp.ones((1, 31, 32, 3)), train=True)
+
+
 def test_resnet_eval_mode_uses_running_stats():
     model = ResNet(stage_sizes=[1, 1], width=8, num_classes=5,
                    compute_dtype=jnp.float32)
