@@ -1,10 +1,22 @@
 #!/usr/bin/env python
-"""Benchmark: ResNet-50 ImageNet-shape training throughput (images/sec/chip).
+"""Benchmark: ResNet-50 ImageNet-shape training throughput (images/sec/chip)
+plus the communicator-strategy x wire-dtype x double-buffering sweep.
 
 Mirrors the reference's headline workload (BASELINE.md: ChainerMN ResNet-50
 ImageNet; the 15-min/1024-GPU run sustained ~125 images/sec/GPU on P100).
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline is images/sec/chip divided by the reference's 125 img/s/GPU.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
+"sweep": [...], "allreduce_gbps": N} where vs_baseline is images/sec/chip
+divided by the reference's 125 img/s/GPU, and "sweep" carries one record per
+{tpu-f32, tpu-bf16, flat, hierarchical, two_dimensional} x {double buffering
+on/off} configuration with its step time and HLO-derived per-step collective
+traffic (SURVEY.md S6/S7 hard-part 4: does double buffering still win when
+XLA already overlaps?).
+
+NOTE on single-chip runs: with one device the mesh collectives are identity
+and per-step collective bytes are ~0 — the sweep then measures strategy
+*overhead* (it should be ~zero) and the record says "n_chips": 1 so the
+numbers aren't over-read. On a real multi-chip slice the same harness
+produces true allreduce bandwidth.
 
 Resilience: TPU backend init can fail transiently (round 1 died with
 ``UNAVAILABLE: TPU backend setup/compile error`` before any framework code
@@ -66,6 +78,95 @@ def _chip_peak(device_kind: str):
     return None
 
 
+def _measure(model, comm, batch, *, double_buffering, n_steps, warmup=3,
+             commstats=True, image_size=224):
+    """Compile + time one configuration; returns a result dict.
+
+    Shared by the headline measurement and the sweep so every number comes
+    from the same code path."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.training import jit_train_step
+
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(
+        rng, (batch, image_size, image_size, 3), jnp.bfloat16
+    )
+    labels = jnp.zeros((batch,), jnp.int32)
+    variables = comm.bcast_data(model.init(rng, images[:2], train=True))
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm, double_buffering=double_buffering
+    )
+    opt_state = jax.device_put(opt.init(variables["params"]), comm.named_sharding())
+    jitted = jit_train_step(model, opt, comm)
+    # One AOT compile serves execution, the MFU estimate, and commstats (a
+    # separate lower().compile() would not share the jit cache and would
+    # double the multi-minute ResNet compile).
+    t0 = time.time()
+    step = jitted.lower(variables, opt_state, images, labels).compile()
+    compile_s = time.time() - t0
+    step_flops = None
+    try:
+        ca = step.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        # per-DEVICE per-step FLOPs from the compiled (post-SPMD-partitioning)
+        # module — already each chip's share; don't divide by n_chips again.
+        step_flops = float(ca.get("flops", 0.0)) or None
+    except Exception as e:
+        log(f"cost_analysis unavailable: {e}")
+    cs = {"total_bytes": 0}
+    if commstats:
+        try:
+            from chainermn_tpu.extensions import parse_hlo_collectives
+
+            cs = parse_hlo_collectives(step.as_text())
+        except Exception as e:
+            log(f"collective_stats unavailable: {e}")
+    # Timing closes with a device->host FETCH of the loss, not
+    # block_until_ready: through the axon tunnel block_until_ready can
+    # return on the relay's ack before the device finishes (observed: 50
+    # ResNet-50 steps "completing" in 87ms = 925 TFLOP/s on a 197-peak
+    # chip), while a value fetch cannot resolve early. The fetch adds one
+    # RTT, amortized over n_steps.
+    for _ in range(warmup):
+        variables, opt_state, loss = step(variables, opt_state, images, labels)
+        float(loss)
+    t0 = time.time()
+    for _ in range(n_steps):
+        variables, opt_state, loss = step(variables, opt_state, images, labels)
+    loss_val = float(loss)
+    dt = time.time() - t0
+    step_time = dt / n_steps
+    return {
+        "loss": loss_val,
+        "compile_s": round(compile_s, 1),
+        "step_time_ms": round(step_time * 1e3, 2),
+        "img_per_sec": batch * n_steps / dt,
+        "step_flops_per_device": step_flops,
+        "collective_bytes_per_step": int(cs.get("total_bytes", 0)),
+        # effective collective bandwidth: HLO bytes/step over measured step
+        # time (0 on a single chip — collectives are identity there)
+        "allreduce_gbps": round(
+            cs.get("total_bytes", 0) / step_time / 1e9, 3
+        ),
+    }
+
+
+# The sweep grid: reference strategy names x double buffering. tpu-bf16 is
+# the flagship (reference pure_nccl + fp16 allreduce analog).
+_SWEEP_GRID = [
+    ("tpu_f32", "tpu", {}),
+    ("tpu_bf16", "tpu", {"allreduce_grad_dtype": "bfloat16"}),
+    ("flat", "flat", {}),
+    ("hierarchical", "hierarchical", {}),
+    ("two_dimensional", "two_dimensional", {}),
+]
+
+
 def child_main() -> None:
     import jax
 
@@ -76,116 +177,143 @@ def child_main() -> None:
     if plat:
         jax.config.update("jax_platforms", plat)
 
-    import jax.numpy as jnp
-    import optax
-
     import chainermn_tpu
     from chainermn_tpu.models import ResNet50
-    from chainermn_tpu.training import jit_train_step
 
     devs = jax.devices()
     log(f"devices: {devs} (kind={devs[0].device_kind!r})")
     n_chips = len(devs)
 
-    comm = chainermn_tpu.create_communicator("tpu", allreduce_grad_dtype="bfloat16")
-    model = ResNet50(num_classes=1000)
+    stem = os.environ.get("CHAINERMN_TPU_BENCH_STEM", "conv7")
+    # Smoke-test hook (CI only; the driver never sets it): a tiny model +
+    # small images exercise the whole harness — retry parent, sweep,
+    # commstats — in seconds on CPU.
+    tiny = bool(os.environ.get("CHAINERMN_TPU_BENCH_TINY"))
+    image_size = 32 if tiny else 224
+    if tiny:
+        from chainermn_tpu.models import ResNet
 
+        model = ResNet(stage_sizes=[1, 1], width=8, num_classes=10, stem=stem)
+    else:
+        model = ResNet50(num_classes=1000, stem=stem)
+    n_steps = int(os.environ.get("CHAINERMN_TPU_BENCH_STEPS", "50"))
+    sweep_steps = int(os.environ.get("CHAINERMN_TPU_BENCH_SWEEP_STEPS", "20"))
+    comm = chainermn_tpu.create_communicator("tpu", allreduce_grad_dtype="bfloat16")
+
+    deadline = time.time() + float(
+        os.environ.get("CHAINERMN_TPU_BENCH_CHILD_BUDGET", "1200")
+    )
     batch = int(os.environ.get("CHAINERMN_TPU_BENCH_BATCH", "0")) or 128 * n_chips
+    headline = None
     while batch >= 8:
         try:
-            rng = jax.random.PRNGKey(0)
-            images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.bfloat16)
-            labels = jnp.zeros((batch,), jnp.int32)
             t0 = time.time()
-            variables = model.init(rng, images[:2], train=True)
-            variables = comm.bcast_data(variables)
-            opt = chainermn_tpu.create_multi_node_optimizer(
-                optax.sgd(0.1, momentum=0.9), comm
+            headline = _measure(
+                model, comm, batch, double_buffering=False, n_steps=n_steps,
+                image_size=image_size,
             )
-            opt_state = jax.device_put(opt.init(variables["params"]), comm.named_sharding())
-            log(f"init done in {time.time() - t0:.1f}s; batch={batch}")
-
-            # One AOT compile serves both execution and the MFU estimate
-            # (a separate lower().compile() would not share the jit cache and
-            # would double the multi-minute ResNet compile).
-            jitted = jit_train_step(model, opt, comm)
-            t0 = time.time()
-            step = jitted.lower(variables, opt_state, images, labels).compile()
-            log(f"compile: {time.time() - t0:.1f}s")
-            # per-DEVICE per-step FLOPs from the compiled (post-SPMD-
-            # partitioning) module — already each chip's share, so the MFU
-            # math below must NOT divide by n_chips again.
-            step_flops = None
-            try:
-                ca = step.cost_analysis()
-                if isinstance(ca, (list, tuple)):
-                    ca = ca[0] if ca else {}
-                step_flops = float(ca.get("flops", 0.0)) or None
-            except Exception as e:
-                log(f"cost_analysis unavailable: {e}")
-            t0 = time.time()
-            variables, opt_state, loss = jax.block_until_ready(
-                step(variables, opt_state, images, labels)
-            )
-            log(f"first step: {time.time() - t0:.1f}s; loss={float(loss):.3f}")
-            for _ in range(2):  # warmup
-                variables, opt_state, loss = jax.block_until_ready(
-                    step(variables, opt_state, images, labels)
-                )
-            cs = {"total_bytes": 0}
-            # per-step comm traffic read straight from the compiled HLO
-            # (stderr only; opt-in via env)
-            if os.environ.get("CHAINERMN_TPU_BENCH_COMMSTATS"):
-                try:
-                    from chainermn_tpu.extensions import parse_hlo_collectives
-
-                    cs = parse_hlo_collectives(step.as_text())
-                    detail = ", ".join(
-                        f"{k} x{v['count']} ({v['bytes'] / 1e6:.1f}MB)"
-                        for k, v in cs.items() if isinstance(v, dict)
-                    )
-                    log("collectives/step: " + (detail or "none"))
-                except Exception as e:
-                    log(f"collective_stats unavailable: {e}")
-            n_steps = 10
-            t0 = time.time()
-            for _ in range(n_steps):
-                variables, opt_state, loss = step(variables, opt_state, images, labels)
-            jax.block_until_ready(loss)
-            dt = time.time() - t0
-            imgs_per_sec = batch * n_steps / dt
-            if cs.get("total_bytes"):
-                log(f"collective traffic: {cs['total_bytes'] / 1e6:.1f} MB/step "
-                    f"-> {cs['total_bytes'] * n_steps / dt / 1e9:.2f} GB/s "
-                    "effective")
-            per_chip = imgs_per_sec / n_chips
-            log(f"{n_steps} steps in {dt:.2f}s -> {imgs_per_sec:.1f} img/s total")
-            record = {
-                "metric": "resnet50_imagenet_train_throughput",
-                "value": round(per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
-            }
-            step_time = dt / n_steps
-            record["step_time_ms"] = round(step_time * 1e3, 2)
-            record["batch_per_chip"] = batch // n_chips
-            record["device_kind"] = devs[0].device_kind
-            if step_flops:
-                achieved = step_flops / step_time  # flops are per-device already
-                record["achieved_tflops_per_chip"] = round(achieved / 1e12, 2)
-                peak = _chip_peak(devs[0].device_kind)
-                if peak:
-                    record["mfu"] = round(achieved / peak, 4)
-                    log(f"MFU: {achieved / peak:.1%} of {peak / 1e12:.0f} TFLOP/s peak")
-            print(json.dumps(record))
-            return
+            log(f"headline: batch={batch} "
+                f"step={headline['step_time_ms']}ms "
+                f"{headline['img_per_sec']:.0f} img/s "
+                f"(compile {headline['compile_s']}s, "
+                f"total {time.time() - t0:.0f}s)")
+            break
         except Exception as e:  # OOM or shape limits: halve and retry
             full_msg = f"{type(e).__name__}: {e}"
             if any(s in full_msg for s in _RETRYABLE):
-                raise  # backend-level failure: let the parent retry a fresh process
+                raise  # backend-level failure: let the parent retry fresh
             log(f"batch {batch} failed: {full_msg[:300]}")
             batch //= 2
-    raise SystemExit("benchmark could not run at any batch size")
+    if headline is None:
+        raise SystemExit("benchmark could not run at any batch size")
+
+    per_chip = headline["img_per_sec"] / n_chips
+    record = {
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+        "step_time_ms": headline["step_time_ms"],
+        "batch_per_chip": batch // n_chips,
+        "n_chips": n_chips,
+        "stem": stem,
+        "device_kind": devs[0].device_kind,
+        "collective_bytes_per_step": headline["collective_bytes_per_step"],
+        "allreduce_gbps": headline["allreduce_gbps"],
+    }
+    if tiny:
+        record["tiny"] = True  # CI smoke run, not a real measurement
+    if headline["step_flops_per_device"]:
+        achieved = headline["step_flops_per_device"] / (
+            headline["step_time_ms"] / 1e3
+        )
+        record["achieved_tflops_per_chip"] = round(achieved / 1e12, 2)
+        peak = _chip_peak(devs[0].device_kind)
+        if peak:
+            record["mfu"] = round(achieved / peak, 4)
+            log(f"MFU: {achieved / peak:.1%} of {peak / 1e12:.0f} TFLOP/s peak")
+
+    # A measurement in hand must survive a sweep overrun: emit the headline
+    # record NOW (the parent salvages the last parseable line on child
+    # timeout), then again with the sweep attached on normal completion.
+    print(json.dumps(record), flush=True)
+
+    # ---- strategy x double-buffering sweep (BASELINE.md metric 2) -------- #
+    sweep = []
+    if os.environ.get("CHAINERMN_TPU_BENCH_SWEEP", "1") != "0":
+        for name, strategy, kwargs in _SWEEP_GRID:
+            for db in (False, True):
+                label = f"{name}{'+db' if db else ''}"
+                if name == "tpu_bf16" and not db:
+                    # exactly the headline configuration — reuse its numbers
+                    # instead of burning a second multi-minute compile
+                    sweep.append({
+                        "config": label,
+                        "step_time_ms": headline["step_time_ms"],
+                        "img_per_sec_per_chip": round(per_chip, 1),
+                        "collective_bytes_per_step":
+                            headline["collective_bytes_per_step"],
+                        "allreduce_gbps": headline["allreduce_gbps"],
+                        "from_headline": True,
+                    })
+                    continue
+                if time.time() > deadline:
+                    sweep.append({"config": label, "skipped": "time budget"})
+                    continue
+                try:
+                    c = chainermn_tpu.create_communicator(strategy, **kwargs)
+                    r = _measure(model, c, batch, double_buffering=db,
+                                 n_steps=sweep_steps, image_size=image_size)
+                    sweep.append({
+                        "config": label,
+                        "step_time_ms": r["step_time_ms"],
+                        "img_per_sec_per_chip": round(
+                            r["img_per_sec"] / n_chips, 1
+                        ),
+                        "collective_bytes_per_step":
+                            r["collective_bytes_per_step"],
+                        "allreduce_gbps": r["allreduce_gbps"],
+                    })
+                    log(f"sweep {label}: {r['step_time_ms']}ms/step, "
+                        f"{r['collective_bytes_per_step'] / 1e6:.1f} MB/step, "
+                        f"{r['allreduce_gbps']} GB/s")
+                except Exception as e:
+                    sweep.append({
+                        "config": label,
+                        "error": f"{type(e).__name__}: {e}"[:200],
+                    })
+                    log(f"sweep {label} failed: {type(e).__name__}: {e}")
+        record["sweep"] = sweep
+        db_pairs = {
+            s["config"]: s["step_time_ms"] for s in sweep
+            if "step_time_ms" in s
+        }
+        base, db = db_pairs.get("tpu_bf16"), db_pairs.get("tpu_bf16+db")
+        if base and db:
+            # the SURVEY S7 hard-part-4 answer, as data
+            record["double_buffering_speedup"] = round(base / db, 4)
+
+    print(json.dumps(record))
 
 
 def parent_main() -> None:
@@ -193,8 +321,9 @@ def parent_main() -> None:
     delay = float(os.environ.get("CHAINERMN_TPU_BENCH_RETRY_DELAY", "10"))
     # Backend init can HANG (tunnel down) rather than fail fast; a hung child
     # would otherwise make the whole bench silently exceed the driver's
-    # budget with no JSON emitted. Timeout covers init + compile + 13 steps.
-    attempt_timeout = float(os.environ.get("CHAINERMN_TPU_BENCH_TIMEOUT", "900"))
+    # budget with no JSON emitted. Timeout covers init + compiles + steps
+    # (the sweep's per-child budget is CHAINERMN_TPU_BENCH_CHILD_BUDGET).
+    attempt_timeout = float(os.environ.get("CHAINERMN_TPU_BENCH_TIMEOUT", "1800"))
     last_tail = ""
     for i in range(1, attempts + 1):
         try:
@@ -260,6 +389,7 @@ def parent_main() -> None:
         "error": err_class,
         "detail": last_tail[-500:],
         "attempts": attempts,
+        "device_kind": None,
     }))
     raise SystemExit(1)
 

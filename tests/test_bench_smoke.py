@@ -1,0 +1,53 @@
+"""bench.py harness smoke test: the retry parent + headline + strategy/db
+sweep must produce one parseable JSON record (tiny model, CPU, 8 devices).
+
+The real benchmark runs on the driver's TPU; this pins the harness logic —
+JSON shape, sweep table, bandwidth fields — so a bench-side regression is
+caught in CI instead of burning a round's real-chip run."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_tiny_cpu():
+    env = dict(
+        os.environ,
+        CHAINERMN_TPU_BENCH_PLATFORM="cpu",
+        CHAINERMN_TPU_BENCH_TINY="1",
+        CHAINERMN_TPU_BENCH_BATCH="16",
+        CHAINERMN_TPU_BENCH_STEPS="2",
+        CHAINERMN_TPU_BENCH_SWEEP_STEPS="2",
+        CHAINERMN_TPU_BENCH_ATTEMPTS="1",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=540, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "resnet50_imagenet_train_throughput"
+    assert rec["tiny"] is True
+    assert rec["value"] and rec["value"] > 0
+    assert rec["n_chips"] == 8
+    assert "allreduce_gbps" in rec
+    # sweep table: 5 strategies x {off, on} = 10 rows, none errored
+    sweep = rec["sweep"]
+    assert len(sweep) == 10, [s.get("config") for s in sweep]
+    errs = [s for s in sweep if "error" in s]
+    assert not errs, errs
+    configs = {s["config"] for s in sweep}
+    assert configs == {
+        "tpu_f32", "tpu_f32+db", "tpu_bf16", "tpu_bf16+db",
+        "flat", "flat+db", "hierarchical", "hierarchical+db",
+        "two_dimensional", "two_dimensional+db",
+    }
+    # on 8 real (virtual) devices every strategy must move bytes
+    for s in sweep:
+        if "skipped" not in s:
+            assert s["collective_bytes_per_step"] > 0, s
+    assert "double_buffering_speedup" in rec
