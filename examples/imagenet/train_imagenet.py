@@ -14,6 +14,14 @@ Run (throughput mode, single host)::
 
     python examples/imagenet/train_imagenet.py --arch resnet50 \
         --batchsize 128 --iterations 50 --dtype bfloat16 --double-buffering
+
+Run (the "15-minute ImageNet" TRAINING RECIPE, arXiv:1711.04325 — linearly
+scaled LR ``0.1 x global_batch/256`` with warmup, label smoothing 0.1, top-1
+eval on a held-out shard through the multi-node evaluator)::
+
+    python examples/imagenet/train_imagenet.py --arch resnet50 \
+        --batchsize 128 --epoch 90 --dtype bfloat16 --double-buffering \
+        --recipe --train-npz /data/imagenet_train.npz
 """
 
 from __future__ import annotations
@@ -103,8 +111,35 @@ def main() -> None:
     parser.add_argument("--n-synthetic", type=int, default=100000)
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--classes", type=int, default=1000)
-    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--lr", type=float, default=0.1,
+                        help="base LR; under --recipe this is the per-256 "
+                             "base of the linear scaling rule")
+    parser.add_argument(
+        "--recipe", action="store_true",
+        help="the 15-minute-run training recipe (arXiv:1711.04325): "
+             "LR = lr x global_batch/256 with linear warmup then cosine "
+             "decay, label smoothing 0.1, per-epoch top-1 eval on a "
+             "held-out shard via the multi-node evaluator",
+    )
+    parser.add_argument("--warmup-epochs", type=float, default=None,
+                        help="LR warmup span (recipe default: 5)")
+    parser.add_argument("--label-smoothing", type=float, default=None,
+                        help="(recipe default: 0.1)")
+    parser.add_argument("--val-frac", type=float, default=None,
+                        help="held-out fraction for top-1 eval "
+                             "(recipe default: 0.02)")
     args = parser.parse_args()
+
+    if args.recipe:
+        if args.warmup_epochs is None:
+            args.warmup_epochs = 5.0
+        if args.label_smoothing is None:
+            args.label_smoothing = 0.1
+        if args.val_frac is None:
+            args.val_frac = 0.02
+    args.warmup_epochs = args.warmup_epochs or 0.0
+    args.label_smoothing = args.label_smoothing or 0.0
+    args.val_frac = args.val_frac or 0.0
 
     chainermn_tpu.add_global_except_hook()
     # a non-float32 wire dtype is only meaningful for the tpu/pure_nccl
@@ -121,7 +156,18 @@ def main() -> None:
 
     dataset = (NpzImageNet(args.train_npz) if args.train_npz
                else SyntheticImageNet(args.n_synthetic, args.image_size, args.classes))
+    val = None
+    if args.val_frac:
+        # hold out the tail as the eval shard (deterministic split so every
+        # process agrees before scattering)
+        from chainermn_tpu.datasets import SubDataset
+
+        n_val = max(1, int(len(dataset) * args.val_frac))
+        val = SubDataset(dataset, range(len(dataset) - n_val, len(dataset)))
+        dataset = SubDataset(dataset, range(len(dataset) - n_val))
     train = chainermn_tpu.scatter_dataset(dataset, comm, shuffle=True, seed=0)
+    val_shard = (chainermn_tpu.scatter_dataset(val, comm, shuffle=False)
+                 if val is not None else None)
 
     model_fn = ARCHS[args.arch]
     model = model_fn(args.classes)
@@ -147,18 +193,67 @@ def main() -> None:
     variables = comm.bcast_data(
         model.init(jax.random.PRNGKey(0), sample, train=True)
     )
+    steps_per_epoch = max(1, (len(train) * comm.process_size) // global_batch)
+    if args.warmup_epochs:
+        # linear scaling rule + warmup (arXiv:1711.04325): ramp to
+        # lr x global_batch/256 over the warmup span, cosine-decay to 0.
+        # The x global_batch/256 multiplier applies only under --recipe —
+        # a bare --warmup-epochs must not silently rescale the user's --lr.
+        scaled_lr = (args.lr * global_batch / 256.0 if args.recipe
+                     else args.lr)
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=scaled_lr,
+            warmup_steps=max(1, int(args.warmup_epochs * steps_per_epoch)),
+            decay_steps=max(2, args.epoch * steps_per_epoch),
+        )
+    else:
+        lr = args.lr
     optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(args.lr, momentum=0.9), comm,
+        optax.sgd(lr, momentum=0.9), comm,
         double_buffering=args.double_buffering,
     )
     opt_state = jax.device_put(
         optimizer.init(variables["params"]), comm.named_sharding()
     )
-    step = jit_train_step(model, optimizer, comm, train_kwargs={"train": True})
+    step = jit_train_step(
+        model, optimizer, comm, train_kwargs={"train": True},
+        label_smoothing=args.label_smoothing,
+    )
 
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(variables["params"]))
     if comm.rank == 0:
         print(f"{n_params / 1e6:.1f}M params, global batch {global_batch}")
+
+    evaluate = None
+    if val_shard is not None:
+        from jax.sharding import PartitionSpec as P
+
+        eval_forward = jax.jit(comm.shard_map(
+            lambda v, x: model.apply(v, x, train=False),
+            in_specs=(P(), comm.data_spec), out_specs=comm.data_spec,
+        ))
+
+        def _local_eval():
+            # top-1 over this process's held-out shard; the multi-node
+            # evaluator averages the dicts across processes (SURVEY.md S2.14)
+            correct = n = 0
+            for batch in chainermn_tpu.SerialIterator(
+                val_shard, global_batch, repeat=False, shuffle=False
+            ):
+                x, y = collate(batch, np.float32)
+                if len(y) < global_batch:  # pad ragged tail to jitted shape
+                    pad = global_batch - len(y)
+                    x = np.concatenate(
+                        [x, np.zeros((pad,) + x.shape[1:], x.dtype)]
+                    )
+                logits = np.asarray(eval_forward(variables, x))
+                pred = logits[: len(y)].argmax(-1)
+                correct += int((pred == y).sum())
+                n += len(y)
+            return {"validation/main/accuracy": correct / max(n, 1)}
+
+        evaluate = chainermn_tpu.create_multi_node_evaluator(_local_eval, comm)
 
     iteration = 0
     t0 = time.time()
@@ -166,23 +261,32 @@ def main() -> None:
     loss = jnp.float32(0)  # stays 0 if every batch is a ragged tail
     while it.epoch < args.epoch:
         images, labels = collate(next(it), np.float32)
-        if len(labels) < global_batch:
-            continue
-        variables, opt_state, loss = step(variables, opt_state, images, labels)
-        iteration += 1
-        imgs += global_batch
-        if iteration == 1:
-            jax.block_until_ready(loss)
-            t0, imgs = time.time(), 0  # exclude compile from throughput
+        if len(labels) == global_batch:  # ragged tails skip the jitted step
+            variables, opt_state, loss = step(variables, opt_state, images, labels)
+            iteration += 1
+            imgs += global_batch
+            if iteration == 1:
+                jax.block_until_ready(loss)
+                t0, imgs = time.time(), 0  # exclude compile from throughput
+                if comm.rank == 0:
+                    print(f"compiled; first loss {float(loss):.3f}")
+            elif iteration % 20 == 0 and comm.rank == 0:
+                dt = time.time() - t0
+                print(f"iter {iteration:5d}  loss {float(loss):.3f}  "
+                      f"{imgs / dt:.1f} img/s ({imgs / dt / comm.size:.1f}/chip)")
+        if it.is_new_epoch and evaluate is not None:
+            metrics = evaluate()
             if comm.rank == 0:
-                print(f"compiled; first loss {float(loss):.3f}")
-        elif iteration % 20 == 0 and comm.rank == 0:
-            dt = time.time() - t0
-            print(f"iter {iteration:5d}  loss {float(loss):.3f}  "
-                  f"{imgs / dt:.1f} img/s ({imgs / dt / comm.size:.1f}/chip)")
+                print(f"epoch {it.epoch:3d}  "
+                      f"top-1 {metrics['validation/main/accuracy']:.4f}")
         if args.iterations and iteration >= args.iterations:
             break
     jax.block_until_ready(loss)
+    if evaluate is not None and not it.is_new_epoch:
+        # exited mid-epoch (--iterations): still report a final top-1
+        metrics = evaluate()
+        if comm.rank == 0:
+            print(f"final top-1 {metrics['validation/main/accuracy']:.4f}")
     if comm.rank == 0 and imgs:
         dt = time.time() - t0
         print(f"done: {iteration} iterations, {imgs / dt:.1f} img/s "

@@ -106,6 +106,20 @@ def test_train_imagenet():
     assert "done: 2 iterations" in proc.stdout
 
 
+def test_train_imagenet_recipe():
+    """The 15-minute-run recipe end-to-end on synthetic data: warmup +
+    scaled-LR schedule, label smoothing, top-1 eval through the multi-node
+    evaluator on a held-out shard (SURVEY.md S6; arXiv:1711.04325)."""
+    proc = run_example(
+        "imagenet/train_imagenet.py",
+        ["--arch", "resnet18", "--batchsize", "4", "--epoch", "2",
+         "--image-size", "32", "--classes", "10", "--n-synthetic", "256",
+         "--recipe", "--warmup-epochs", "1"],
+    )
+    assert "top-1" in proc.stdout
+    assert "epoch   2" in proc.stdout
+
+
 def test_train_imagenet_mnbn_double_buffering():
     proc = run_example(
         "imagenet/train_imagenet.py",
