@@ -6,6 +6,7 @@ from chainermn_tpu.parallel.mesh import (
     make_hierarchical_mesh,
     make_mesh,
 )
+from chainermn_tpu.parallel.moe import ExpertParallelMLP
 from chainermn_tpu.parallel.sequence import (
     full_attention,
     ring_attention,
@@ -20,6 +21,7 @@ __all__ = [
     "RankGeometry",
     "make_mesh",
     "make_hierarchical_mesh",
+    "ExpertParallelMLP",
     "full_attention",
     "ring_attention",
     "ulysses_attention",

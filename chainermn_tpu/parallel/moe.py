@@ -1,0 +1,160 @@
+"""Expert parallelism (MoE) over a mesh axis — TPU extension.
+
+SURVEY.md S2.16 marks EP **absent** in the reference (a 2017 framework);
+this module adds it the TPU-idiomatic way: experts are sharded over the
+communicator's mesh axis, tokens are routed with a top-1 gate and moved to
+their expert's rank by ONE ``all_to_all`` each way (the same collective
+shape as the reference's channel-parallel convolution and Ulysses attention
+— ``lax.all_to_all`` inside ``shard_map``), and every shape is static
+(capacity-bounded dispatch) so the whole layer compiles into the step.
+
+Design notes:
+- **Capacity + drop**: each expert processes at most
+  ``capacity = ceil(tokens_per_rank / n_experts) * capacity_factor`` tokens
+  per sending rank. Overflow tokens are dropped (standard Switch-style
+  routing; the residual path carries them unchanged). This keeps the
+  dispatch tensor static-shaped — data-dependent shapes would break XLA.
+- **Combine weights**: the gate probability scales the expert output
+  (straight-through for dropped tokens), so the layer is differentiable
+  end-to-end; gradients flow through the same all_to_alls transposed.
+- **Load-balance loss**: ``aux_loss`` (Switch Transformer form: n_e *
+  dot(fraction_routed, mean_gate_prob)) is returned for the trainer to add.
+
+Usage (inside a step traced over ``comm``'s mesh)::
+
+    layer = ExpertParallelMLP(n_experts=comm.size, d_model=64, d_ff=256,
+                              axis_name=comm.axis_name)
+    params = layer.init(key, tokens)          # tokens: [B_local, T, D]
+    y, aux = layer.apply(params, tokens)
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class ExpertParallelMLP(nn.Module):
+    """Top-1-routed MoE FFN with experts sharded over ``axis_name``.
+
+    ``n_experts`` must be divisible by the axis size; each rank owns
+    ``n_experts / axis_size`` experts. Call with ``[B, T, D]`` (per-rank
+    local batch); returns ``(out [B, T, D], aux_loss scalar)``.
+    """
+
+    n_experts: int
+    d_model: int
+    d_ff: int
+    axis_name: str
+    capacity_factor: float = 1.25
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        if d != self.d_model:
+            raise ValueError(f"input dim {d} != d_model {self.d_model}")
+        n_ranks = lax.psum(1, self.axis_name)
+        if self.n_experts % n_ranks:
+            raise ValueError(
+                f"n_experts={self.n_experts} not divisible by axis size {n_ranks}"
+            )
+        local_e = self.n_experts // n_ranks
+        tokens = x.reshape(b * t, d).astype(self.compute_dtype)
+        n_tok = b * t
+
+        # --- gate: top-1 expert per token ------------------------------ #
+        gate_logits = nn.Dense(self.n_experts, dtype=self.compute_dtype,
+                               name="gate")(tokens)
+        gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+        expert_idx = jnp.argmax(gate_probs, axis=-1)            # [n_tok]
+        gate_val = jnp.take_along_axis(
+            gate_probs, expert_idx[:, None], axis=-1
+        )[:, 0]                                                  # [n_tok]
+
+        # Switch-style load-balance aux loss (computed over the LOCAL shard;
+        # the trainer's loss mean over ranks makes it global)
+        frac_routed = jnp.mean(
+            jax.nn.one_hot(expert_idx, self.n_experts, dtype=jnp.float32), axis=0
+        )
+        mean_prob = jnp.mean(gate_probs, axis=0)
+        aux_loss = self.n_experts * jnp.sum(frac_routed * mean_prob)
+
+        # --- capacity-bounded dispatch --------------------------------- #
+        capacity = int(max(1, (n_tok + self.n_experts - 1) // self.n_experts
+                           * self.capacity_factor))
+        # position of each token within its expert's queue
+        one_hot = jax.nn.one_hot(expert_idx, self.n_experts,
+                                 dtype=jnp.int32)                # [n_tok, E]
+        pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1) * one_hot
+        pos = jnp.sum(pos_in_expert, axis=-1)                    # [n_tok]
+        keep = pos < capacity                                    # overflow drop
+
+        # dispatch[e, c, d]: token payload bound for expert e at slot c.
+        # Dropped tokens scatter to index == size: genuinely out of bounds,
+        # so mode="drop" discards them (-1 would WRAP to the last slot).
+        n_slots = self.n_experts * capacity
+        dispatch = jnp.zeros((n_slots, d), tokens.dtype)
+        scatter_idx = jnp.where(keep, expert_idx * capacity + pos, n_slots)
+        dispatch = dispatch.at[scatter_idx].set(tokens, mode="drop")
+        dispatch = dispatch.reshape(self.n_experts, capacity, d)
+
+        # --- move tokens to their expert's rank ------------------------ #
+        # [n_ranks, local_e, C, D] --all_to_all(split 0, concat 1)-->
+        # [local_e, n_ranks, C, D]: rank r receives, for each local expert,
+        # every source rank's capacity block (the EP analog of the
+        # parallel-conv alltoall).
+        shaped = dispatch.reshape(n_ranks, local_e, capacity, d)
+        recv = lax.all_to_all(shaped, self.axis_name, split_axis=0,
+                              concat_axis=1, tiled=False)
+        recv = recv.reshape(local_e, n_ranks * capacity, d)
+
+        # --- per-expert FFN (batched einsum: one MXU-friendly matmul) -- #
+        # Expert weights are declared GLOBAL [n_experts, ...] and each rank
+        # slices its local block by axis index: init stays ordinary flax
+        # (replicated params), and a step builder that wants ZeRO-style
+        # expert-weight sharding can pass these leaves in with a P(axis)
+        # in_spec instead — the slice below then becomes the identity.
+        # batch_axis=0: each expert inits as an independent (in, out) matrix
+        # — a plain lecun_normal would fold n_experts into fan_in and shrink
+        # the per-expert std by sqrt(n_experts)
+        expert_init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", batch_axis=(0,)
+        )
+        w1 = self.param("w1", expert_init,
+                        (self.n_experts, d, self.d_ff), self.compute_dtype)
+        b1 = self.param("b1", nn.initializers.zeros,
+                        (self.n_experts, 1, self.d_ff), self.compute_dtype)
+        w2 = self.param("w2", expert_init,
+                        (self.n_experts, self.d_ff, d), self.compute_dtype)
+        b2 = self.param("b2", nn.initializers.zeros,
+                        (self.n_experts, 1, d), self.compute_dtype)
+        r = lax.axis_index(self.axis_name)
+
+        def local(p):
+            if p.shape[0] == local_e:  # already sharded by the step's in_spec
+                return p
+            return lax.dynamic_slice_in_dim(p, r * local_e, local_e, 0)
+
+        h = nn.relu(jnp.einsum("ecd,edf->ecf", recv, local(w1)) + local(b1))
+        out = jnp.einsum("ecf,efd->ecd", h, local(w2)) + local(b2)
+
+        # --- route results back (transposed all_to_all) ----------------- #
+        # [local_e, n_ranks, C, D] --all_to_all(split 1, concat 0)-->
+        # [n_ranks, local_e, C, D]: back on the sender, expert-major order
+        # (n_ranks * local_e == E) matches the dispatch layout exactly.
+        out = out.reshape(local_e, n_ranks, capacity, d)
+        back = lax.all_to_all(out, self.axis_name, split_axis=1,
+                              concat_axis=0, tiled=False)
+        back = back.reshape(n_slots, d)
+
+        # gather each token's slot; dropped tokens read index n_slots ->
+        # fill 0 (identity through the residual path)
+        combined = back.at[scatter_idx].get(mode="fill", fill_value=0.0)
+        y = combined * gate_val[:, None].astype(combined.dtype)
+        return y.reshape(b, t, d).astype(x.dtype), aux_loss
+
+
+__all__ = ["ExpertParallelMLP"]

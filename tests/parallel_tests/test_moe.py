@@ -1,0 +1,128 @@
+"""Expert parallelism: dispatch exactness vs a dense reference, gradient
+flow, capacity drops, and multi-expert-per-rank layouts (TPU extension —
+SURVEY.md S2.16 marks EP absent upstream)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.parallel.moe import ExpertParallelMLP
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("tpu")
+
+
+def _run(comm, layer, x_global, params=None):
+    """init (if needed) and apply under the comm's mesh; x is rank-major
+    [n, B, T, D]."""
+    if params is None:
+        params = jax.jit(comm.shard_map(
+            lambda xb: layer.init(jax.random.PRNGKey(0), xb[0]),
+            in_specs=comm.data_spec, out_specs=P(),
+        ))(x_global)
+    y, aux = jax.jit(comm.shard_map(
+        # aux is a per-rank statistic: average it across ranks for the test
+        lambda p, xb: (lambda o: (o[0][None],
+                                  comm.allreduce(o[1], "mean")))(
+            layer.apply(p, xb[0])),
+        in_specs=(P(), comm.data_spec), out_specs=(comm.data_spec, P()),
+    ))(params, x_global)
+    return params, y, aux
+
+
+def _dense_reference(params, x, n_experts):
+    """Per-token dense MoE: route each token to its argmax expert, scale by
+    the gate probability."""
+    gate_k = np.asarray(params["params"]["gate"]["kernel"])
+    gate_b = np.asarray(params["params"]["gate"]["bias"])
+    w1 = np.asarray(params["params"]["w1"])
+    b1 = np.asarray(params["params"]["b1"])
+    w2 = np.asarray(params["params"]["w2"])
+    b2 = np.asarray(params["params"]["b2"])
+    toks = x.reshape(-1, x.shape[-1])
+    logits = toks @ gate_k + gate_b
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    eidx = probs.argmax(-1)
+    out = np.zeros_like(toks)
+    for i, (tok, e) in enumerate(zip(toks, eidx)):
+        h = np.maximum(tok @ w1[e] + b1[e][0], 0.0)
+        out[i] = (h @ w2[e] + b2[e][0]) * probs[i, e]
+    return out.reshape(x.shape)
+
+
+def test_matches_dense_reference_no_drops(comm):
+    """With ample capacity, EP output must equal the dense per-token MoE."""
+    n = comm.size
+    layer = ExpertParallelMLP(n_experts=n, d_model=8, d_ff=16,
+                              axis_name=comm.axis_name, capacity_factor=8.0)
+    x = np.random.RandomState(0).randn(n, 2, 3, 8).astype(np.float32)
+    params, y, aux = _run(comm, layer, x)
+    ref = _dense_reference(params, x, n)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+    assert float(aux) >= 0.99  # Switch aux loss is ~1 at its optimum
+
+
+def test_multiple_experts_per_rank(comm):
+    """n_experts = 2x ranks: each rank owns 2 experts; still exact."""
+    n = comm.size
+    layer = ExpertParallelMLP(n_experts=2 * n, d_model=8, d_ff=16,
+                              axis_name=comm.axis_name, capacity_factor=8.0)
+    x = np.random.RandomState(1).randn(n, 2, 4, 8).astype(np.float32)
+    params, y, aux = _run(comm, layer, x)
+    ref = _dense_reference(params, x, 2 * n)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_capacity_drops_are_zero_not_garbage(comm):
+    """capacity_factor ~ 0: (almost) every token drops; output must be 0
+    (the residual path carries dropped tokens), never NaN/garbage."""
+    n = comm.size
+    layer = ExpertParallelMLP(n_experts=n, d_model=8, d_ff=16,
+                              axis_name=comm.axis_name, capacity_factor=1e-9)
+    x = np.random.RandomState(2).randn(n, 2, 3, 8).astype(np.float32)
+    params, y, aux = _run(comm, layer, x)
+    y = np.asarray(y)
+    assert np.isfinite(y).all()
+    # capacity floor is 1 slot/expert, so at most E tokens per rank survive
+    nonzero_tokens = (np.abs(y.reshape(-1, 8)).sum(-1) > 0).sum()
+    assert nonzero_tokens <= n * n, nonzero_tokens
+
+
+def test_gradients_flow_through_dispatch(comm):
+    n = comm.size
+    layer = ExpertParallelMLP(n_experts=n, d_model=8, d_ff=16,
+                              axis_name=comm.axis_name, capacity_factor=4.0)
+    x = np.random.RandomState(3).randn(n, 2, 3, 8).astype(np.float32)
+    params, _, _ = _run(comm, layer, x)
+
+    def loss(p, xb):
+        y, aux = layer.apply(p, xb[0])
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    g = jax.jit(comm.shard_map(
+        jax.grad(lambda p, xb: comm.allreduce(loss(p, xb), "mean")),
+        in_specs=(P(), comm.data_spec), out_specs=P(),
+    ))(params, x)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    # expert and gate weights both receive signal
+    assert float(jnp.abs(g["params"]["w1"]).sum()) > 0
+    assert float(jnp.abs(g["params"]["gate"]["kernel"]).sum()) > 0
+
+
+def test_rejects_bad_config(comm):
+    n = comm.size
+    layer = ExpertParallelMLP(n_experts=n + 1, d_model=8, d_ff=16,
+                              axis_name=comm.axis_name)
+    x = np.zeros((n, 1, 2, 8), np.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(comm.shard_map(
+            lambda xb: layer.init(jax.random.PRNGKey(0), xb[0]),
+            in_specs=comm.data_spec, out_specs=P(),
+        ))(x)
