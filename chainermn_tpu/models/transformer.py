@@ -21,6 +21,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from chainermn_tpu.parallel.moe import ExpertParallelMLP
 from chainermn_tpu.parallel.sequence import sequence_parallel_attention
 
 
@@ -31,6 +32,13 @@ class TransformerBlock(nn.Module):
     attention: str = "full"
     sequence_axis: Optional[str] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # moe_experts > 0 replaces this block's dense FFN with an expert-parallel
+    # routed MLP over ``moe_axis`` (see parallel.moe); the block THEN returns
+    # ``(x, aux_loss)`` instead of ``x`` — dense blocks keep the original
+    # single-array contract so existing callers are unaffected.
+    moe_experts: int = 0
+    moe_axis: Optional[str] = None
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, pos_offset=0):
@@ -47,10 +55,17 @@ class TransformerBlock(nn.Module):
         x = x + nn.DenseGeneral(self.d_model, axis=(-2, -1), dtype=dt, name="proj")(o)
 
         h = nn.LayerNorm(dtype=dt)(x)
+        if self.moe_experts:
+            y, aux = ExpertParallelMLP(
+                n_experts=self.moe_experts, d_model=self.d_model,
+                d_ff=self.d_ff, axis_name=self.moe_axis,
+                capacity_factor=self.moe_capacity_factor,
+                compute_dtype=dt, name="moe",
+            )(h)
+            return x + y, aux
         h = nn.Dense(self.d_ff, dtype=dt)(h)
         h = nn.gelu(h)
-        x = x + nn.Dense(self.d_model, dtype=dt)(h)
-        return x
+        return x + nn.Dense(self.d_model, dtype=dt)(h)
 
 
 class TransformerLM(nn.Module):
@@ -68,22 +83,40 @@ class TransformerLM(nn.Module):
     attention: str = "full"
     sequence_axis: Optional[str] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # MoE: every ``moe_every``-th block routes its FFN over ``moe_axis``
+    # experts (0 = dense everywhere). Train with return_aux=True and add
+    # the aux loss (jit_lm_train_step does this automatically).
+    moe_experts: int = 0
+    moe_axis: Optional[str] = None
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0):
+    def __call__(self, tokens, pos_offset=0, return_aux: bool = False):
         d_ff = self.d_ff or 4 * self.d_model
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.d_model,
                          dtype=self.compute_dtype, name="pos_embed")(pos)[None]
+        aux_total = jnp.float32(0.0)
         for i in range(self.n_layers):
-            x = TransformerBlock(
+            is_moe = self.moe_experts and (i % self.moe_every == self.moe_every - 1)
+            out = TransformerBlock(
                 self.d_model, self.n_heads, d_ff,
                 attention=self.attention, sequence_axis=self.sequence_axis,
-                compute_dtype=self.compute_dtype, name=f"block_{i}",
+                compute_dtype=self.compute_dtype,
+                moe_experts=self.moe_experts if is_moe else 0,
+                moe_axis=self.moe_axis,
+                moe_capacity_factor=self.moe_capacity_factor,
+                name=f"block_{i}",
             )(x)
+            x, aux = out if is_moe else (out, 0.0)
+            aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype,
                           name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if return_aux:
+            return logits, aux_total
+        return logits

@@ -129,6 +129,7 @@ def jit_lm_train_step(
     comm: CommunicatorBase,
     shard_sequence: bool = False,
     donate: bool = True,
+    moe_aux_weight: float = 0.01,
 ) -> Callable:
     """Jitted next-token-prediction step for :class:`TransformerLM`-shaped
     models. Call as ``step(params, opt_state, tokens, targets)``.
@@ -145,6 +146,13 @@ def jit_lm_train_step(
     # attention (the axis IS bound inside shard_map either way) — reject.
     attn = getattr(model, "attention", None)
     seq_axis = getattr(model, "sequence_axis", None)
+    moe_experts = getattr(model, "moe_experts", 0)
+    if moe_experts and getattr(model, "moe_axis", None) != comm.axis_name:
+        raise ValueError(
+            f"MoE model must be built with moe_axis={comm.axis_name!r} "
+            f"(got {getattr(model, 'moe_axis', None)!r}) so experts shard "
+            "over the step's mesh axis"
+        )
     if attn is not None:
         if shard_sequence:
             if attn not in ("ring", "ulysses") or seq_axis != comm.axis_name:
@@ -170,10 +178,14 @@ def jit_lm_train_step(
         )
 
         def loss_fn(p):
-            logits = model.apply(p, tokens, pos_offset)
-            return optax.softmax_cross_entropy_with_integer_labels(
+            if moe_experts:
+                logits, aux = model.apply(p, tokens, pos_offset, return_aux=True)
+            else:
+                logits, aux = model.apply(p, tokens, pos_offset), 0.0
+            ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets
             ).mean()
+            return ce + moe_aux_weight * aux
 
         loss, grads = jax.value_and_grad(loss_fn)(params_v)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)

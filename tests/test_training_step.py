@@ -30,6 +30,32 @@ def test_step_without_mutable_collections(comm):
     assert np.isfinite(float(loss1)) and float(loss2) < float(loss1)
 
 
+def test_label_smoothing_step(comm):
+    """label_smoothing=s must reproduce optax.softmax_cross_entropy on
+    smoothed one-hot targets exactly (recipe ingredient, arXiv:1711.04325
+    era); smoothing raises the optimal-fit loss floor above the hard-label
+    one."""
+    model = MLP(n_units=16, n_out=4, compute_dtype=jnp.float32)
+    rng = np.random.RandomState(3)
+    imgs = jnp.asarray(rng.randn(16, 8), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 4, 16), jnp.int32)
+    variables = comm.bcast_data(model.init(jax.random.PRNGKey(0), imgs[:1]))
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.0), comm)
+    opt_state = jax.device_put(opt.init(variables["params"]), comm.named_sharding())
+
+    step_s = jit_train_step(model, opt, comm, donate=False, label_smoothing=0.1)
+    _, _, loss_s = step_s(variables, opt_state, imgs, labels)
+    # host reference on the same (lr=0 -> unchanged) params
+    logits = model.apply(variables, imgs)
+    targets = optax.smooth_labels(jax.nn.one_hot(labels, 4), 0.1)
+    want = float(optax.softmax_cross_entropy(jnp.asarray(logits), targets).mean())
+    np.testing.assert_allclose(float(loss_s), want, rtol=1e-6)
+
+    step_h = jit_train_step(model, opt, comm, donate=False)
+    _, _, loss_h = step_h(variables, opt_state, imgs, labels)
+    assert float(loss_s) != float(loss_h)
+
+
 def test_step_with_batch_stats(comm):
     model = ResNet(stage_sizes=[1, 1], width=4, num_classes=4,
                    compute_dtype=jnp.float32)
