@@ -97,6 +97,24 @@ def test_parallel_convolution():
     assert "epoch   2" in proc.stdout
 
 
+def test_train_lm_moe():
+    proc = run_example(
+        "lm/train_lm.py",
+        ["--iterations", "25", "--moe-experts", "2", "--seq-len", "32",
+         "--d-model", "32", "--n-tokens", "20000"],
+    )
+    assert "done: 25 iterations" in proc.stdout
+
+
+def test_train_lm_sequence_parallel():
+    proc = run_example(
+        "lm/train_lm.py",
+        ["--iterations", "25", "--seq-parallel", "--attention", "ring",
+         "--seq-len", "64", "--d-model", "32", "--n-tokens", "20000"],
+    )
+    assert "done: 25 iterations" in proc.stdout
+
+
 def test_train_imagenet():
     proc = run_example(
         "imagenet/train_imagenet.py",
