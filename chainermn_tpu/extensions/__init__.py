@@ -20,12 +20,14 @@ from chainermn_tpu.extensions.profiling import (
     parse_hlo_collectives,
     trace,
 )
+from chainermn_tpu.extensions.sharded_checkpoint import ShardedCheckpointer
 
 __all__ = [
     "AllreducePersistent",
     "MultiNodeCheckpointer",
     "create_multi_node_checkpointer",
     "ObservationAggregator",
+    "ShardedCheckpointer",
     "StepTimer",
     "Watchdog",
     "collective_stats",

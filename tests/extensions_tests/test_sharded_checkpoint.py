@@ -1,0 +1,57 @@
+"""ShardedCheckpointer: jax.Array pytrees round-trip with their shardings
+(ZeRO-sharded optimizer state included) — TPU extension beyond the
+reference checkpointer (SURVEY.md S5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.extensions import ShardedCheckpointer
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("tpu")
+
+
+def test_roundtrip_preserves_values_and_shardings(comm, tmp_path):
+    n = comm.size
+    params = {"w": jnp.arange(n * 12, dtype=jnp.float32).reshape(n * 12)}
+    zopt = chainermn_tpu.create_zero_optimizer(optax.adam(1e-3), comm)
+    state = jax.device_put(zopt.init(params),
+                           comm.named_sharding(*zopt.state_spec))
+    replicated = jax.device_put({"p": params}, comm.named_sharding())
+    tree = {"opt": state, "model": replicated}
+
+    with ShardedCheckpointer(str(tmp_path / "ckpt"), keep=2) as cp:
+        cp.save(1, tree)
+        cp.save(5, tree)
+        assert cp.all_steps() == [1, 5]
+        restored, step = cp.maybe_restore(tree)
+    assert step == 5
+    for want, got in zip(jax.tree_util.tree_leaves(tree),
+                         jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+        assert got.sharding.is_equivalent_to(want.sharding, want.ndim), (
+            want.sharding, got.sharding)
+    # the rank-sharded moment leaf really is sharded after restore
+    mu = restored["opt"][0].mu
+    assert mu.sharding.shard_shape(mu.shape)[0] == 1
+
+
+def test_gc_keeps_newest(comm, tmp_path):
+    x = jax.device_put({"a": jnp.ones((4,))}, comm.named_sharding())
+    with ShardedCheckpointer(str(tmp_path / "c"), keep=2) as cp:
+        for s in (1, 2, 3, 4):
+            cp.save(s, x)
+        assert cp.all_steps() == [3, 4]
+
+
+def test_empty_dir_restores_none(comm, tmp_path):
+    x = jax.device_put({"a": jnp.ones((4,))}, comm.named_sharding())
+    with ShardedCheckpointer(str(tmp_path / "none")) as cp:
+        restored, step = cp.maybe_restore(x)
+    assert restored is None and step is None
