@@ -322,7 +322,19 @@ class MeshCommunicator(CommunicatorBase):
         pytree whose every leaf has leading axis == global size."""
         leaves, treedef = jax.tree_util.tree_flatten(args)
         gsize = self._global_size
-        leaves = [jnp.asarray(l) for l in leaves]
+        multiproc = jax.process_count() > 1
+        if multiproc:
+            # Multi-controller: every process passes the same rank-major host
+            # array; ONE device_put with the global sharding moves just this
+            # process's addressable shards. (A jnp.asarray commit first would
+            # pay a full-array transfer before resharding.) Outputs are
+            # global jax.Arrays — read your shard via .addressable_data(0).
+            sharding = NamedSharding(self._mesh, self.data_spec)
+            leaves = [
+                jax.device_put(np.asarray(l), sharding) for l in leaves
+            ]
+        else:
+            leaves = [jnp.asarray(l) for l in leaves]
         for l in leaves:
             if l.ndim < 1 or l.shape[0] != gsize:
                 raise ValueError(
