@@ -324,8 +324,23 @@ def parent_main() -> None:
     # budget with no JSON emitted. Timeout covers init + compiles + steps
     # (the sweep's per-child budget is CHAINERMN_TPU_BENCH_CHILD_BUDGET).
     attempt_timeout = float(os.environ.get("CHAINERMN_TPU_BENCH_TIMEOUT", "1800"))
+    # And a TOTAL cap: a wedged single-tenant tunnel (PERF.md hazard #2)
+    # hangs every attempt — 5 x 1800s of retries would outlive any driver
+    # budget and still emit nothing. Stop retrying once the cumulative spend
+    # passes the total budget and emit the failure record instead.
+    total_budget = float(os.environ.get("CHAINERMN_TPU_BENCH_TOTAL_BUDGET", "3600"))
+    t_start = time.time()
     last_tail = ""
+    attempts_run = 0
     for i in range(1, attempts + 1):
+        remaining = total_budget - (time.time() - t_start)
+        if remaining <= 60:
+            log(f"bench total budget ({total_budget:.0f}s) exhausted after "
+                f"{i - 1} attempts; giving up")
+            last_tail = last_tail or "total budget exhausted (tunnel wedged?)"
+            break
+        attempt_timeout = min(attempt_timeout, remaining)
+        attempts_run = i
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
@@ -354,7 +369,7 @@ def parent_main() -> None:
                 except (json.JSONDecodeError, AttributeError):
                     continue
             last_tail = f"TimeoutExpired after {attempt_timeout:.0f}s (backend hang?)"
-            if i < attempts:
+            if i < attempts and total_budget - (time.time() - t_start) > 60:
                 time.sleep(delay)
                 delay = min(delay * 2, 120.0)
             continue
@@ -370,11 +385,13 @@ def parent_main() -> None:
         retryable = proc.returncode != 0 and (
             any(s in last_tail for s in _RETRYABLE) or not last_tail
         )
+        budget_left = total_budget - (time.time() - t_start) > 60
+        will_retry = retryable and i < attempts and budget_left
         log(f"bench attempt {i}/{attempts} failed (rc={proc.returncode}); "
-            f"{'retrying in %.0fs' % delay if retryable and i < attempts else 'giving up'}")
+            f"{'retrying in %.0fs' % delay if will_retry else 'giving up'}")
         if not retryable:
             break
-        if i < attempts:
+        if will_retry:
             time.sleep(delay)
             delay = min(delay * 2, 120.0)
     # Final failure: one parseable JSON record, not a stack trace.
@@ -388,7 +405,7 @@ def parent_main() -> None:
         "vs_baseline": None,
         "error": err_class,
         "detail": last_tail[-500:],
-        "attempts": attempts,
+        "attempts": attempts_run,
         "device_kind": None,
     }))
     raise SystemExit(1)
