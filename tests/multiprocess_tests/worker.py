@@ -119,6 +119,17 @@ def scenario_array_p2p(comm2, rank, size):
         }
         comm2.send(reply, dest=0, tag=12)
     comm2._obj.barrier()
+    # protocol mismatch: comm.recv on send_obj traffic must fail loudly,
+    # not reinterpret the pickle as a header
+    if rank == 0:
+        comm2.send_obj("plain-object", dest=1, tag=13)
+    elif rank == 1:
+        try:
+            comm2.recv(source=0, tag=13)
+            check(False, "recv accepted send_obj traffic")
+        except RuntimeError as e:
+            check("_MessageType" in str(e), f"wrong mismatch error: {e}")
+    comm2._obj.barrier()
 
 
 def scenario_eager_device_collective(comm2, rank, size):
