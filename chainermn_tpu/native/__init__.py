@@ -3,6 +3,7 @@
 The reference's native surface is NCCL bindings + CUDA pack kernels
 (SURVEY.md S2.9); on TPU, XLA owns the device side, so the native layer here
 is host-side: the :mod:`objstore` TCP object-transport sidecar (DCN control
-plane). Everything degrades gracefully to pure-Python transports when the
-toolchain is unavailable.
+plane) and the :mod:`dataloader` batch-assembly/prefetch loader (input
+pipeline — the reference's MultiprocessIterator slot). Everything degrades
+gracefully to pure-Python paths when the toolchain is unavailable.
 """

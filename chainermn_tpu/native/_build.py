@@ -1,0 +1,41 @@
+"""Shared build-on-demand machinery for the native (C++) components.
+
+Each component is a single .cc compiled with the system g++ into a cached
+.so next to the source (no pybind11 — C ABI + ctypes keeps the binding
+dependency-free). Builds are serialized with an flock so concurrent
+processes don't race the compiler; a stale .so (older than its source) is
+rebuilt. Callers catch the RuntimeError and fall back to pure Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_and_load(src_basename: str, stem: str) -> ctypes.CDLL:
+    """Compile ``<native>/<src_basename>`` (if needed) and dlopen it."""
+    src = os.path.join(_DIR, src_basename)
+    lib_path = os.path.join(
+        _DIR, f"_{stem}_py{sys.version_info[0]}{sys.version_info[1]}.so"
+    )
+    if not (os.path.exists(lib_path)
+            and os.path.getmtime(lib_path) >= os.path.getmtime(src)):
+        lock_path = lib_path + ".lock"
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not (os.path.exists(lib_path)
+                    and os.path.getmtime(lib_path) >= os.path.getmtime(src)):
+                tmp = lib_path + ".tmp"
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     "-pthread", src, "-o", tmp],
+                    check=True, capture_output=True, text=True,
+                )
+                os.replace(tmp, lib_path)
+    return ctypes.CDLL(lib_path)

@@ -1,0 +1,228 @@
+"""Prefetching native batch loader (ctypes over dataloader.cc).
+
+The reference's ImageNet example feeds data through Chainer's
+MultiprocessIterator — worker processes doing decode + batch assembly
+(``[U] examples/imagenet/train_imagenet.py``, SURVEY.md S2.15 — unverified
+cite). The TPU rebuild's input path re-designs that as:
+
+- **batch assembly in C++** (``dl_gather_f32``): gather the sampled records
+  from a contiguous uint8 array and fuse the uint8 -> float32
+  ``(x/255 - mean) / std`` normalize, multithreaded, GIL released for the
+  whole call;
+- **one-batch-ahead prefetch** on a Python thread: while the training step
+  runs, the next batch is being assembled — the loop's input cost is
+  max(0, assembly - step) instead of assembly + step.
+
+Falls back to a numpy implementation when the g++ toolchain is missing
+(``native_available()`` tells you which path you got — same posture as the
+objstore sidecar).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_lib = None
+_lib_error: Optional[str] = None
+
+# The ImageNet per-channel normalization the reference's example applies via
+# a mean image; shared so every input path normalizes identically.
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def _load():
+    global _lib, _lib_error
+    if _lib is not None:
+        return _lib
+    if _lib_error is not None:
+        raise RuntimeError(f"dataloader library unavailable: {_lib_error}")
+    try:
+        from chainermn_tpu.native._build import build_and_load
+
+        lib = build_and_load("dataloader.cc", "dataloader")
+    except Exception as e:
+        _lib_error = f"{type(e).__name__}: {e}"
+        raise RuntimeError(f"dataloader library unavailable: {_lib_error}")
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.dl_gather_f32.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64,
+                                  i64p, ctypes.c_uint64, f32p, f32p, f32p,
+                                  ctypes.c_int]
+    lib.dl_gather_u8.argtypes = [u8p, ctypes.c_uint64, i64p,
+                                 ctypes.c_uint64, u8p, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+class NativeBatchLoader:
+    """Iterate normalized float32 batches over ``(images_u8, labels)``.
+
+    ``images_u8``: contiguous ``[N, ...]`` uint8 array whose trailing axis is
+    channels (NHWC); ``labels``: per-SAMPLE ints. ``rows`` (optional) maps
+    each sample to its row in ``images_u8`` — samples may alias base rows
+    (e.g. a small synthetic pool) or be a shard's subset, with no copy of
+    the base array. Yields ``(batch_f32 [B, ...], labels [B])`` forever
+    (``repeat=True``) or for one epoch. Shuffles with a per-epoch seeded
+    permutation — every process of an SPMD launch constructs the same
+    order, matching the synchronized-iterator posture of the host
+    framework.
+    """
+
+    def __init__(
+        self,
+        images_u8: np.ndarray,
+        labels: Sequence[int],
+        batch_size: int,
+        *,
+        rows: Optional[Sequence[int]] = None,
+        mean: Sequence[float] = IMAGENET_MEAN,
+        std: Sequence[float] = IMAGENET_STD,
+        shuffle: bool = True,
+        repeat: bool = True,
+        seed: int = 0,
+        n_threads: Optional[int] = None,
+        prefetch: bool = True,
+    ) -> None:
+        self._x = np.ascontiguousarray(images_u8)
+        if self._x.dtype != np.uint8:
+            raise TypeError(f"images must be uint8, got {self._x.dtype}")
+        self._y = np.asarray(labels, np.int32)
+        self._rows = (np.arange(len(self._x), dtype=np.int64) if rows is None
+                      else np.asarray(rows, np.int64))
+        if len(self._rows) != len(self._y):
+            raise ValueError(f"{len(self._rows)} rows vs {len(self._y)} labels")
+        if len(self._rows) and (self._rows.min() < 0
+                                or self._rows.max() >= len(self._x)):
+            raise ValueError(
+                f"rows reference [{self._rows.min()}, {self._rows.max()}] "
+                f"outside the base array's {len(self._x)} rows"
+            )
+        if batch_size > len(self._rows):
+            raise ValueError(
+                f"batch_size {batch_size} > dataset size {len(self._rows)}"
+            )
+        self._batch = batch_size
+        self._channels = int(self._x.shape[-1])
+        self._rec_elems = int(np.prod(self._x.shape[1:]))
+        self._mean = np.asarray(mean, np.float32)
+        self._stdinv = (1.0 / np.asarray(std, np.float32)).astype(np.float32)
+        if len(self._mean) != self._channels or len(self._stdinv) != self._channels:
+            raise ValueError(
+                f"{len(self._mean)} mean / {len(self._stdinv)} std values "
+                f"for {self._channels} channels"
+            )
+        self._shuffle = shuffle
+        self._repeat = repeat
+        self._seed = seed
+        self._n_threads = n_threads or min(8, os.cpu_count() or 1)
+        self._native = native_available()
+        self._prefetch = prefetch
+        self.epoch = 0
+        self.is_new_epoch = False
+
+    # -- batch assembly ------------------------------------------------- #
+
+    def _assemble(self, row_idx: np.ndarray) -> np.ndarray:
+        """Gather base rows -> normalized float32 images."""
+        out = np.empty((len(row_idx),) + self._x.shape[1:], np.float32)
+        if self._native:
+            lib = _load()
+            idx64 = np.ascontiguousarray(row_idx, np.int64)
+            lib.dl_gather_f32(
+                self._x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                self._rec_elems, self._channels,
+                idx64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(idx64),
+                self._mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self._stdinv.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self._n_threads,
+            )
+        else:  # pure-python fallback: same math
+            gathered = self._x[row_idx].astype(np.float32) / 255.0
+            out[:] = (gathered - self._mean) * self._stdinv
+        return out
+
+    # -- iteration with one-batch-ahead prefetch ------------------------ #
+
+    def _index_batches(self):
+        n = len(self._rows)
+        epoch = 0
+        while True:
+            order = (np.random.RandomState(self._seed + epoch).permutation(n)
+                     if self._shuffle else np.arange(n))
+            n_full = n // self._batch
+            for i in range(n_full):
+                last = i == n_full - 1
+                sel = order[i * self._batch:(i + 1) * self._batch]
+                yield sel, last
+            epoch += 1
+            if not self._repeat:
+                return
+
+    def __iter__(self):
+        if not self._prefetch:
+            for sel, last in self._index_batches():
+                self.is_new_epoch = last
+                if last:
+                    self.epoch += 1
+                yield self._assemble_sel(sel)
+            return
+        # per-iterator state: multiple live iterators (or a closed earlier
+        # one) must not stop each other's producer
+        q: queue.Queue = queue.Queue(maxsize=2)
+        stop = threading.Event()
+
+        def producer():
+            for sel, last in self._index_batches():
+                if stop.is_set():
+                    return
+                q.put((self._assemble_sel(sel), last))
+            q.put(None)
+
+        worker = threading.Thread(target=producer, daemon=True)
+        worker.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                batch, last = item
+                self.is_new_epoch = last
+                if last:
+                    self.epoch += 1
+                yield batch
+        finally:
+            stop.set()
+            # unblock a producer waiting on a full queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+
+    def _assemble_sel(self, sel: np.ndarray):
+        """Sample positions -> (normalized images, labels)."""
+        return self._assemble(self._rows[sel]), self._y[sel]
+
+    def __len__(self) -> int:
+        return len(self._rows) // self._batch
+
+
+__all__ = ["NativeBatchLoader", "native_available",
+           "IMAGENET_MEAN", "IMAGENET_STD"]

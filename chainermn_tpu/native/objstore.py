@@ -16,41 +16,13 @@ process calls ``ObjStoreServer()``), and every process gets
 from __future__ import annotations
 
 import ctypes
-import fcntl
 import os
-import subprocess
-import sys
 from typing import Optional
 
 from chainermn_tpu.communicators._object_comm import KVStoreObjectComm
 
-_DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "objstore.cc")
-_LIB_PATH = os.path.join(_DIR, f"_objstore_py{sys.version_info[0]}{sys.version_info[1]}.so")
-
 _lib: Optional[ctypes.CDLL] = None
 _lib_error: Optional[str] = None
-
-
-def _build() -> str:
-    """Compile the sidecar if the cached .so is missing or stale."""
-    if (os.path.exists(_LIB_PATH)
-            and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)):
-        return _LIB_PATH
-    lock_path = _LIB_PATH + ".lock"
-    with open(lock_path, "w") as lock:
-        fcntl.flock(lock, fcntl.LOCK_EX)
-        if (os.path.exists(_LIB_PATH)
-                and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)):
-            return _LIB_PATH  # another process built it while we waited
-        tmp = _LIB_PATH + ".tmp"
-        subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-             _SRC, "-o", tmp],
-            check=True, capture_output=True, text=True,
-        )
-        os.replace(tmp, _LIB_PATH)
-    return _LIB_PATH
 
 
 def _load() -> ctypes.CDLL:
@@ -60,7 +32,9 @@ def _load() -> ctypes.CDLL:
     if _lib_error is not None:
         raise RuntimeError(f"objstore library unavailable: {_lib_error}")
     try:
-        lib = ctypes.CDLL(_build())
+        from chainermn_tpu.native._build import build_and_load
+
+        lib = build_and_load("objstore.cc", "objstore")
     except Exception as e:  # missing g++, sandboxed fs, ...
         _lib_error = f"{type(e).__name__}: {e}"
         raise RuntimeError(f"objstore library unavailable: {_lib_error}")

@@ -83,11 +83,36 @@ class NpzImageNet:
 
 
 def collate(batch, dtype):
+    from chainermn_tpu.native.dataloader import IMAGENET_MEAN, IMAGENET_STD
+
     xs, ys = zip(*batch)
     x = np.stack(xs).astype(np.float32) / 255.0
-    # per-channel ImageNet normalization (reference subtracts a mean image)
-    x = (x - np.array([0.485, 0.456, 0.406])) / np.array([0.229, 0.224, 0.225])
+    # per-channel ImageNet normalization (reference subtracts a mean image);
+    # constants shared with NativeBatchLoader so both input paths normalize
+    # identically
+    x = (x - np.array(IMAGENET_MEAN)) / np.array(IMAGENET_STD)
     return x.astype(dtype), np.asarray(ys, np.int32)
+
+
+def record_source(ds):
+    """(base_u8, rows, labels) view of a dataset for zero-copy native
+    loading: ``rows[i]`` is sample i's row in ``base_u8`` (SyntheticImageNet
+    aliases its small pool; SubDataset shards compose indices)."""
+    from chainermn_tpu.datasets import SubDataset
+
+    if isinstance(ds, SubDataset):
+        base, rows, labels = record_source(ds._dataset)
+        idx = np.asarray(ds.indices)
+        return base, rows[idx], labels[idx]
+    if isinstance(ds, SyntheticImageNet):
+        rows = np.arange(len(ds), dtype=np.int64) % len(ds._pool)
+        return ds._pool, rows, ds._labels
+    if isinstance(ds, NpzImageNet):
+        return ds.x, np.arange(len(ds), dtype=np.int64), ds.y
+    raise TypeError(
+        f"--native-loader supports the synthetic/npz datasets, got "
+        f"{type(ds).__name__}"
+    )
 
 
 def main() -> None:
@@ -128,6 +153,10 @@ def main() -> None:
     parser.add_argument("--val-frac", type=float, default=None,
                         help="held-out fraction for top-1 eval "
                              "(recipe default: 0.02)")
+    parser.add_argument("--native-loader", action="store_true",
+                        help="C++ batch assembly (gather + fused uint8->f32 "
+                             "normalize, GIL-free threads) with one-batch "
+                             "prefetch — the MultiprocessIterator slot")
     args = parser.parse_args()
 
     if args.recipe:
@@ -187,7 +216,17 @@ def main() -> None:
 
     global_batch = args.batchsize * comm.size
     ensure_batch_fits(train, global_batch, comm.size)
-    it = chainermn_tpu.SerialIterator(train, global_batch, shuffle=True, seed=1)
+    if args.native_loader:
+        from chainermn_tpu.native.dataloader import NativeBatchLoader
+
+        # zero-copy view of the shard: the C++ path gathers rows from the
+        # base array, fuses the normalize, and prefetches one batch ahead
+        base, rows, ys = record_source(train)
+        it = NativeBatchLoader(base, ys, global_batch, rows=rows,
+                               shuffle=True, seed=1)
+        batches = iter(it)
+    else:
+        it = chainermn_tpu.SerialIterator(train, global_batch, shuffle=True, seed=1)
 
     sample = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.bfloat16)
     variables = comm.bcast_data(
@@ -260,7 +299,10 @@ def main() -> None:
     imgs = 0
     loss = jnp.float32(0)  # stays 0 if every batch is a ragged tail
     while it.epoch < args.epoch:
-        images, labels = collate(next(it), np.float32)
+        if args.native_loader:
+            images, labels = next(batches)  # pre-normalized, never ragged
+        else:
+            images, labels = collate(next(it), np.float32)
         if len(labels) == global_batch:  # ragged tails skip the jitted step
             variables, opt_state, loss = step(variables, opt_state, images, labels)
             iteration += 1

@@ -146,3 +146,13 @@ def test_train_imagenet_mnbn_double_buffering():
          "--mnbn", "--double-buffering"],
     )
     assert "done: 2 iterations" in proc.stdout
+
+
+def test_train_imagenet_native_loader():
+    proc = run_example(
+        "imagenet/train_imagenet.py",
+        ["--arch", "resnet18", "--batchsize", "2", "--iterations", "3",
+         "--image-size", "32", "--classes", "10", "--n-synthetic", "64",
+         "--native-loader"],
+    )
+    assert "done: 3 iterations" in proc.stdout

@@ -1,0 +1,129 @@
+"""Native batch loader: C++ gather+normalize exactness vs numpy, prefetch
+iteration semantics, epoch shuffling, and the pure-python fallback."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.native import dataloader
+from chainermn_tpu.native.dataloader import NativeBatchLoader
+
+
+def _data(n=40, h=8, w=8, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randint(0, 256, (n, h, w, c), np.uint8),
+            rng.randint(0, 10, n).astype(np.int32))
+
+
+def _reference(x, idx, mean, std):
+    g = x[idx].astype(np.float32) / 255.0
+    return (g - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+
+
+def test_native_gather_matches_numpy():
+    if not dataloader.native_available():
+        pytest.skip("g++ toolchain unavailable")
+    x, y = _data()
+    mean, std = (0.4, 0.5, 0.6), (0.2, 0.25, 0.3)
+    loader = NativeBatchLoader(x, y, 8, mean=mean, std=std, shuffle=False,
+                               repeat=False, prefetch=False)
+    batch, labels = next(iter(loader))
+    np.testing.assert_allclose(
+        batch, _reference(x, np.arange(8), mean, std), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_array_equal(labels, y[:8])
+    assert batch.dtype == np.float32
+
+
+def test_fallback_matches_native():
+    x, y = _data(seed=1)
+    kw = dict(batch_size=8, shuffle=False, repeat=False, prefetch=False)
+    a = NativeBatchLoader(x, y, **kw)
+    b = NativeBatchLoader(x, y, **kw)
+    b._native = False  # force the numpy path
+    for (ba, la), (bb, lb) in zip(iter(a), iter(b)):
+        np.testing.assert_allclose(ba, bb, rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_one_epoch_covers_every_full_batch():
+    x, y = _data(n=37)
+    loader = NativeBatchLoader(x, y, 8, shuffle=True, repeat=False, seed=3)
+    seen = []
+    for batch, labels in loader:
+        assert batch.shape == (8, 8, 8, 3)
+        seen.extend(labels.tolist())
+    assert len(seen) == (37 // 8) * 8  # ragged tail dropped
+    assert loader.epoch == 1
+
+
+def test_epochs_reshuffle():
+    x, y = _data(n=32, seed=2)
+    loader = NativeBatchLoader(x, y, 16, shuffle=True, repeat=True, seed=0)
+    it = iter(loader)
+    epoch1 = [next(it)[1].tolist() for _ in range(2)]
+    epoch2 = [next(it)[1].tolist() for _ in range(2)]
+    flat1 = [v for b in epoch1 for v in b]
+    flat2 = [v for b in epoch2 for v in b]
+    assert sorted(map(tuple, [flat1])) != []  # sanity
+    assert flat1 != flat2  # different order across epochs
+
+
+def test_prefetch_yields_same_as_sync():
+    x, y = _data(n=48, seed=4)
+    kw = dict(batch_size=8, shuffle=True, repeat=False, seed=7)
+    sync = list(NativeBatchLoader(x, y, prefetch=False, **kw))
+    pre = list(NativeBatchLoader(x, y, prefetch=True, **kw))
+    assert len(sync) == len(pre) == 6
+    for (bs, ls), (bp, lp) in zip(sync, pre):
+        np.testing.assert_array_equal(ls, lp)
+        np.testing.assert_allclose(bs, bp)
+
+
+def test_validation_errors():
+    x, y = _data()
+    with pytest.raises(TypeError, match="uint8"):
+        NativeBatchLoader(x.astype(np.float32), y, 8)
+    with pytest.raises(ValueError, match="labels"):
+        NativeBatchLoader(x, y[:-1], 8)
+    with pytest.raises(ValueError, match="batch_size"):
+        NativeBatchLoader(x, y, len(x) + 1)
+    with pytest.raises(ValueError, match="channels"):
+        NativeBatchLoader(x, y, 8, mean=(0.5,), std=(0.5,))
+
+
+def test_rows_alias_small_pool():
+    """rows= lets samples alias a small base pool (SyntheticImageNet shape)
+    with no materialization: sample i reads base[rows[i]]."""
+    base, _ = _data(n=4, seed=5)
+    rows = np.array([0, 1, 2, 3, 0, 1, 2, 3], np.int64)
+    labels = np.arange(8, dtype=np.int32)
+    loader = NativeBatchLoader(base, labels, 4, rows=rows, shuffle=False,
+                               repeat=False, prefetch=False)
+    batches = list(loader)
+    assert len(batches) == 2
+    b0, l0 = batches[0]
+    np.testing.assert_allclose(
+        b0, _reference(base, rows[:4], (0.485, 0.456, 0.406),
+                       (0.229, 0.224, 0.225)), rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(l0, labels[:4])
+    with pytest.raises(ValueError, match="outside"):
+        NativeBatchLoader(base, labels, 4, rows=rows + 10)
+
+
+def test_std_length_validated():
+    x, y = _data()
+    with pytest.raises(ValueError, match="std"):
+        NativeBatchLoader(x, y, 8, mean=(0.5, 0.5, 0.5), std=(0.5,))
+
+
+def test_independent_iterators():
+    """Closing one iterator must not kill another's producer."""
+    x, y = _data(n=64, seed=6)
+    loader = NativeBatchLoader(x, y, 8, shuffle=False, repeat=False)
+    it1 = iter(loader)
+    next(it1)
+    it2 = iter(loader)
+    next(it2)
+    it1.close()
+    rest = sum(1 for _ in it2)
+    assert rest == 7  # it2 finished its epoch despite it1's close
