@@ -226,3 +226,32 @@ class NativeBatchLoader:
 
 __all__ = ["NativeBatchLoader", "native_available",
            "IMAGENET_MEAN", "IMAGENET_STD"]
+
+
+def _bench(batch=128, size=224, n=20) -> None:
+    """`python -m chainermn_tpu.native.dataloader`: native vs numpy batch
+    assembly on an ImageNet-shaped batch."""
+    import time
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 256, (max(512, batch), size, size, 3), np.uint8)
+    y = rng.randint(0, 1000, len(x)).astype(np.int32)
+    if not native_available():
+        print(f"WARNING: native library unavailable ({_lib_error}); "
+              "both rows below are the numpy fallback")
+    for native in (True, False):
+        loader = NativeBatchLoader(x, y, batch, prefetch=False, shuffle=True)
+        loader._native = native and native_available()
+        it = iter(loader)
+        next(it)  # warm (build/load the library)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            next(it)
+        ms = (time.perf_counter() - t0) / n * 1e3
+        label = "native" if loader._native else "numpy "
+        print(f"{label}: {ms:6.1f} ms/batch "
+              f"({batch * size * size * 3 / ms / 1e6:.2f} GB/s)")
+
+
+if __name__ == "__main__":
+    _bench()
