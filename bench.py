@@ -168,6 +168,16 @@ _SWEEP_GRID = [
 
 
 def child_main() -> None:
+    import signal
+
+    # Python's default SIGTERM disposition is immediate kernel termination —
+    # no stack unwind, no PJRT client teardown, so the parent's TERM-first
+    # escalation would release nothing. Raise SystemExit instead so the
+    # interpreter unwinds and the device grant is returned. (Best effort: if
+    # the main thread is blocked inside a C extension call — e.g. a remote
+    # compile — the handler only runs when that call returns.)
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
     import jax
 
     # Testing hook (the driver never sets this): force a platform. The
@@ -341,21 +351,40 @@ def parent_main() -> None:
             break
         attempt_timeout = min(attempt_timeout, remaining)
         attempts_run = i
+        popen = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-                timeout=attempt_timeout,
+            stdout_txt, stderr_txt = popen.communicate(timeout=attempt_timeout)
+            proc = subprocess.CompletedProcess(
+                popen.args, popen.returncode, stdout_txt, stderr_txt
             )
-        except subprocess.TimeoutExpired as te:
+        except subprocess.TimeoutExpired:
+            # TERM first, KILL only as a last resort: a SIGKILLed child
+            # cannot run its PJRT teardown, and a lease dying un-released
+            # wedges the single-tenant tunnel for every later process
+            # (PERF.md hazard #2 — observed: one mid-compile SIGKILL took
+            # the chip out for hours). SIGTERM lets Python unwind and the
+            # client release the device grant.
             log(f"bench attempt {i}/{attempts} timed out after {attempt_timeout:.0f}s")
-            stderr_txt, stdout_txt = te.stderr, te.stdout
-            if isinstance(stderr_txt, bytes):
-                stderr_txt = stderr_txt.decode(errors="replace")
-            if isinstance(stdout_txt, bytes):
-                stdout_txt = stdout_txt.decode(errors="replace")
+            popen.terminate()
+            try:
+                stdout_txt, stderr_txt = popen.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                log("child ignored SIGTERM for 60s; escalating to SIGKILL")
+                popen.kill()
+                try:
+                    # even SIGKILL may not reap a child stuck in
+                    # uninterruptible device I/O — bound the wait and abandon
+                    # the pipes rather than hang past the total budget with
+                    # no failure record emitted
+                    stdout_txt, stderr_txt = popen.communicate(timeout=60)
+                except subprocess.TimeoutExpired:
+                    log("child unreaped after SIGKILL (D-state?); abandoning")
+                    stdout_txt, stderr_txt = "", ""
             if stderr_txt:
                 sys.stderr.write(stderr_txt)
             # A child can emit its result and then hang in runtime teardown —

@@ -6,6 +6,11 @@ from chainermn_tpu.parallel.mesh import (
     make_hierarchical_mesh,
     make_mesh,
 )
+from chainermn_tpu.parallel.fsdp import (
+    fsdp_shard,
+    fsdp_spec,
+    jit_fsdp_train_step,
+)
 from chainermn_tpu.parallel.moe import ExpertParallelMLP
 from chainermn_tpu.parallel.sequence import (
     full_attention,
@@ -22,6 +27,9 @@ __all__ = [
     "make_mesh",
     "make_hierarchical_mesh",
     "ExpertParallelMLP",
+    "fsdp_shard",
+    "fsdp_spec",
+    "jit_fsdp_train_step",
     "full_attention",
     "ring_attention",
     "ulysses_attention",

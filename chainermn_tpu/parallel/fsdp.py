@@ -1,0 +1,179 @@
+"""FSDP (ZeRO-3): parameters, gradients, and optimizer state sharded at rest.
+
+TPU-idiomatic extension BEYOND the reference (SURVEY.md S2.16 marks every
+form of sharded-state data parallelism absent upstream — params and moments
+are fully replicated there, and ZeRO-1 is this repo's `create_zero_optimizer`).
+
+On TPU, FSDP is not a wrapper object that moves bytes on a side channel the
+way GPU implementations shuttle flat buffers around NCCL process groups — it
+is a *layout*. Parameters live scattered over the data-parallel mesh axis;
+the training step is ONE global jitted program whose batch axis is sharded
+over the same mesh axis; and XLA's SPMD partitioner materializes each weight
+where it is used (all_gather on use, forward and backward — the "unshard on
+demand" half of ZeRO-3) and scatters the gradients back (reduce_scatter — the
+"shard the reduction" half), scheduling both behind adjacent compute. The
+optimizer update then runs entirely on 1/n-sized shards, so per-device bytes
+for params + grads + moments are ``full/n`` plus one transiently-gathered
+layer — the ZeRO-3 memory profile, with the collective schedule chosen by the
+compiler instead of hand-written bucketing code.
+
+Sharding rule: each leaf is split along its LARGEST axis divisible by the
+mesh size (ties -> the earlier axis); leaves with no divisible axis stay
+replicated (biases, scalars, odd shapes — a few KB). The rule is a pure
+function of the leaf's *shape*, so the same rule applied to the optimizer
+state automatically co-shards every moment with its parameter (``mu``/``nu``
+have the parameter's shape) and replicates step counters.
+
+Usage::
+
+    comm = chainermn_tpu.create_communicator("tpu")
+    variables = fsdp_shard(model.init(key, x), comm)       # scatter at rest
+    opt_state = fsdp_shard(jax.jit(opt.init)(variables["params"]), comm)
+    step = jit_fsdp_train_step(model, opt, comm)
+    variables, opt_state, loss = step(variables, opt_state, images, labels)
+
+Note the plain optax optimizer: there is NO multi-node wrapper here. The loss
+is the global-batch mean of one global program, so the cross-rank gradient
+mean is not an explicit collective we insert — it falls out of
+differentiating a global mean wrt scattered parameters (XLA emits the
+reduce_scatter). BatchNorm under this step likewise computes *global* batch
+statistics — sync-BN semantics with no MNBN machinery. That also means BN
+models are NOT numerically identical across layouts: the shard_map DP step
+normalizes each rank's local batch, this one the global batch. BN-free
+models (the parity test's subject) match exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+
+
+def _check_flat(comm: CommunicatorBase) -> str:
+    axis = comm.axis_name
+    if not isinstance(axis, str):
+        raise ValueError(
+            f"FSDP needs a flat single-axis communicator (got axes {axis!r}); "
+            "hierarchical meshes have no single data axis to shard over"
+        )
+    if getattr(comm, "_groups", None) is not None:
+        raise ValueError("FSDP does not support split() sub-communicators")
+    return axis
+
+
+def spec_for_shape(shape, n: int, axis: str) -> P:
+    """The FSDP PartitionSpec for one leaf shape: shard the largest
+    ``n``-divisible axis, earlier axis on ties; replicate if none."""
+    best = None
+    for i, d in enumerate(shape):
+        if d % n == 0 and d > 0 and (best is None or d > shape[best]):
+            best = i
+    if best is None:
+        return P()
+    return P(*(axis if i == best else None for i in range(len(shape))))
+
+
+def fsdp_spec(tree, comm: CommunicatorBase):
+    """Per-leaf PartitionSpecs for ``tree`` under ``comm``'s mesh axis."""
+    axis = _check_flat(comm)
+    n = comm.size
+    return jax.tree_util.tree_map(
+        lambda l: spec_for_shape(jax.numpy.shape(l), n, axis), tree
+    )
+
+
+def fsdp_shard(tree, comm: CommunicatorBase):
+    """Place ``tree`` scattered over the mesh per :func:`fsdp_spec`."""
+    mesh = comm.mesh
+    return jax.tree_util.tree_map(
+        lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
+        tree,
+        fsdp_spec(tree, comm),
+    )
+
+
+def _constrain(tree, comm: CommunicatorBase):
+    """with_sharding_constraint to the FSDP layout (traced-side: shapes are
+    static, so the same shape rule applies)."""
+    mesh = comm.mesh
+    return jax.tree_util.tree_map(
+        lambda l, s: jax.lax.with_sharding_constraint(l, NamedSharding(mesh, s)),
+        tree,
+        fsdp_spec(tree, comm),
+    )
+
+
+def jit_fsdp_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    comm: CommunicatorBase,
+    donate: bool = True,
+    train_kwargs: Optional[dict] = None,
+    label_smoothing: float = 0.0,
+) -> Callable:
+    """The FSDP classification train step (same call shape as
+    ``jit_train_step``): ``step(variables, opt_state, images, labels)``.
+
+    ``variables``/``opt_state`` must be placed with :func:`fsdp_shard`; the
+    batch is global (leading axis = global batch) and is constrained onto the
+    mesh inside the program, so callers may pass ordinary host arrays.
+
+    Unlike ``jit_train_step`` this is NOT a ``shard_map`` program: there is no
+    per-rank body and no explicit gradient collective — one global program,
+    and the partitioner owns the byte movement (module docstring). For the
+    same reason the communicator's gradient-strategy knobs do NOT apply here:
+    ``allreduce_grad_dtype`` (the compressed-wire setting) and double
+    buffering configure the explicit collective in the shard_map step, and
+    this step has no such collective to configure — a warning is emitted if
+    the communicator carries a wire dtype so the setting never goes silently
+    unused.
+    """
+    _check_flat(comm)
+    if getattr(comm, "allreduce_grad_dtype", None) is not None:
+        import warnings
+
+        warnings.warn(
+            "jit_fsdp_train_step ignores the communicator's "
+            f"allreduce_grad_dtype={comm.allreduce_grad_dtype!r}: the FSDP "
+            "step's gradient reduce_scatter is inserted by the XLA "
+            "partitioner in the gradient's own dtype, not by the "
+            "communicator strategy",
+            stacklevel=2,
+        )
+    train_kwargs = dict(train_kwargs or {})
+
+    def step(variables, opt_state, images, labels):
+        images = jax.lax.with_sharding_constraint(
+            images, NamedSharding(comm.mesh, comm.data_spec)
+        )
+        labels = jax.lax.with_sharding_constraint(
+            labels, NamedSharding(comm.mesh, comm.data_spec)
+        )
+        params = variables["params"]
+        rest = {k: v for k, v in variables.items() if k != "params"}
+        mutable = list(rest.keys())
+        from chainermn_tpu.training import classification_loss_fn
+
+        loss_fn = classification_loss_fn(
+            model, rest, mutable, images, labels, train_kwargs, label_smoothing
+        )
+        (loss, updated), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        # keep the gradients scattered (this is what makes the backward's
+        # cross-device reduction a reduce_scatter rather than an all-reduce)
+        grads = _constrain(grads, comm)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # pin the updated state back to the at-rest layout so donation reuses
+        # the input buffers and nothing silently re-replicates
+        params = _constrain(params, comm)
+        opt_state = _constrain(opt_state, comm)
+        new_variables = {"params": params, **_constrain(updated, comm)}
+        return new_variables, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)

@@ -20,6 +20,42 @@ from jax.sharding import PartitionSpec as P
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase
 
 
+def classification_loss_fn(
+    model,
+    rest: dict,
+    mutable: list,
+    images,
+    labels,
+    train_kwargs: dict,
+    label_smoothing: float,
+):
+    """``loss_fn(params) -> (loss, updated_collections)`` shared by the
+    shard_map step below and the FSDP step (``parallel/fsdp.py``), so the
+    training math — loss options, mutable-collection handling — can never
+    diverge between layouts."""
+
+    def loss_fn(p):
+        if mutable:
+            logits, updated = model.apply(
+                {"params": p, **rest}, images, mutable=mutable, **train_kwargs
+            )
+        else:
+            logits = model.apply({"params": p}, images, **train_kwargs)
+            updated = {}
+        if label_smoothing:
+            targets = optax.smooth_labels(
+                jax.nn.one_hot(labels, logits.shape[-1]), label_smoothing
+            )
+            loss = optax.softmax_cross_entropy(logits, targets).mean()
+        else:
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+        return loss, updated
+
+    return loss_fn
+
+
 def make_classification_train_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -53,26 +89,9 @@ def make_classification_train_step(
         params_v = jax.tree_util.tree_map(
             lambda a: jax.lax.pcast(a, comm.axis_name, to="varying"), params
         )
-
-        def loss_fn(p):
-            if mutable:
-                logits, updated = model.apply(
-                    {"params": p, **rest}, images, mutable=mutable, **train_kwargs
-                )
-            else:
-                logits = model.apply({"params": p}, images, **train_kwargs)
-                updated = {}
-            if label_smoothing:
-                targets = optax.smooth_labels(
-                    jax.nn.one_hot(labels, logits.shape[-1]), label_smoothing
-                )
-                loss = optax.softmax_cross_entropy(logits, targets).mean()
-            else:
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, labels
-                ).mean()
-            return loss, updated
-
+        loss_fn = classification_loss_fn(
+            model, rest, mutable, images, labels, train_kwargs, label_smoothing
+        )
         (loss, updated), grads = jax.value_and_grad(loss_fn, has_aux=True)(params_v)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

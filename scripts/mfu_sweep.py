@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""MFU sweep driver: run bench.py once per (stem, batch) cell, sequentially.
+
+The axon TPU tunnel is single-tenant and wedges if a lease-holding process is
+SIGKILLed (PERF.md hazard #2 — one mid-compile SIGKILL cost hours of chip
+time this round). So: cells run one at a time, each gets ONE attempt with a
+budget generous enough for a contended compile (batch-192 ResNet-50 compile
+exceeded 1200s while the CPU test suite ran beside it), and timeouts go
+through bench.py's parent, which since round 3 TERMinates (letting PJRT
+release the device grant) and only escalates to SIGKILL after 60s of ignored
+TERM. Results append to scripts/mfu_sweep.jsonl as they land, so an
+interrupted sweep loses only the remaining cells.
+
+Usage: python scripts/mfu_sweep.py [out.jsonl]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+CELLS = [
+    # (stem, batch) — conv7/96 and conv7/128 were measured earlier in round 3
+    # (PERF.md). space_to_depth first: it is the likeliest MFU winner.
+    ("space_to_depth", 128),
+    ("space_to_depth", 256),
+    ("conv7", 192),
+    ("conv7", 256),
+    ("space_to_depth", 192),
+]
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "bench.py")
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "mfu_sweep.jsonl")
+    for stem, batch in CELLS:
+        env = dict(os.environ,
+                   CHAINERMN_TPU_BENCH_STEM=stem,
+                   CHAINERMN_TPU_BENCH_BATCH=str(batch),
+                   CHAINERMN_TPU_BENCH_SWEEP="0",
+                   CHAINERMN_TPU_BENCH_STEPS="50",
+                   CHAINERMN_TPU_BENCH_ATTEMPTS="1",
+                   CHAINERMN_TPU_BENCH_TIMEOUT="2700",
+                   CHAINERMN_TPU_BENCH_TOTAL_BUDGET="2760")
+        t0 = time.time()
+        print(f"=== cell stem={stem} batch={batch}", file=sys.stderr, flush=True)
+        proc = subprocess.run([sys.executable, BENCH], env=env,
+                              stdout=subprocess.PIPE, text=True)
+        line = (proc.stdout or "").strip().splitlines()
+        rec = {"stem": stem, "batch": batch, "rc": proc.returncode,
+               "wall_s": round(time.time() - t0, 1)}
+        if line:
+            try:
+                rec["result"] = json.loads(line[-1])
+            except json.JSONDecodeError:
+                rec["raw"] = line[-1][:500]
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"=== cell done rc={proc.returncode} "
+              f"({rec['wall_s']}s)", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""FSDP (ZeRO-3) tests: sharding rule, memory contract, numerics parity.
+
+The reference has no sharded-state data parallelism (SURVEY.md S2.16); these
+pin the extension's contract: (1) the shape rule scatters the big leaves and
+co-shards moments with params, (2) per-device at-rest bytes are full/n,
+(3) for BN-free models the FSDP step computes EXACTLY the replicated
+data-parallel step's update (same global-batch gradient). BatchNorm models
+are intentionally NOT layout-identical: FSDP's global program computes
+global-batch (sync-BN) statistics while the shard_map step normalizes
+per-rank batches (see the fsdp module docstring).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.models import MLP
+from chainermn_tpu.parallel import fsdp_shard, fsdp_spec, jit_fsdp_train_step
+from chainermn_tpu.parallel.fsdp import spec_for_shape
+from chainermn_tpu.training import jit_train_step
+
+
+def test_spec_for_shape_rule():
+    n, ax = 8, "x"
+    assert spec_for_shape((8, 3), n, ax) == P(ax, None)
+    assert spec_for_shape((3, 16), n, ax) == P(None, ax)
+    # both divisible: largest wins
+    assert spec_for_shape((16, 64), n, ax) == P(None, ax)
+    # tie: earlier axis wins
+    assert spec_for_shape((16, 16), n, ax) == P(ax, None)
+    # nothing divisible: replicated
+    assert spec_for_shape((5, 3), n, ax) == P()
+    assert spec_for_shape((), n, ax) == P()
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("tpu")
+
+
+def _init(comm, width=64):
+    model = MLP(n_units=width, n_out=10, compute_dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((2, 12), jnp.float32)
+    variables = model.init(rng, x)
+    return model, variables
+
+
+def test_state_is_scattered(comm):
+    model, variables = _init(comm)
+    opt = optax.adam(1e-3)
+    sharded = fsdp_shard(variables, comm)
+    opt_state = fsdp_shard(jax.jit(opt.init)(sharded["params"]), comm)
+    n = comm.size
+
+    def shard_frac(leaf):
+        return leaf.addressable_shards[0].data.size / leaf.size
+
+    # every n-divisible leaf sits at 1/n per device — params AND adam moments
+    big = [l for l in jax.tree_util.tree_leaves(sharded["params"])
+           if any(d % n == 0 for d in l.shape) and l.size >= n]
+    assert big and all(shard_frac(l) == 1 / n for l in big)
+    mu = opt_state[0].mu
+    big_mu = [l for l in jax.tree_util.tree_leaves(mu)
+              if any(d % n == 0 for d in l.shape) and l.size >= n]
+    assert big_mu and all(shard_frac(l) == 1 / n for l in big_mu)
+
+
+def test_fsdp_matches_replicated_step(comm):
+    """FSDP and the canonical shard_map DP step produce the same params
+    after several adam steps — layout changes nothing about the math."""
+    model, variables = _init(comm)
+    opt = optax.adam(1e-2)
+    n = comm.size
+    rng = np.random.RandomState(1)
+    images = jnp.asarray(rng.randn(2 * n, 12), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 10, (2 * n,)), jnp.int32)
+
+    # replicated baseline: multi-node optimizer + shard_map step
+    mn_opt = chainermn_tpu.create_multi_node_optimizer(opt, comm)
+    rep_vars = comm.bcast_data(variables)
+    rep_state = jax.device_put(
+        jax.jit(mn_opt.init)(rep_vars["params"]), comm.named_sharding()
+    )
+    rep_step = jit_train_step(model, mn_opt, comm, donate=False)
+
+    fs_vars = fsdp_shard(variables, comm)
+    fs_state = fsdp_shard(jax.jit(opt.init)(fs_vars["params"]), comm)
+    fs_step = jit_fsdp_train_step(model, opt, comm, donate=False)
+
+    for _ in range(3):
+        rep_vars, rep_state, rep_loss = rep_step(rep_vars, rep_state,
+                                                 images, labels)
+        fs_vars, fs_state, fs_loss = fs_step(fs_vars, fs_state, images, labels)
+
+    np.testing.assert_allclose(float(rep_loss), float(fs_loss), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(rep_vars["params"]),
+                    jax.tree_util.tree_leaves(fs_vars["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_fsdp_rejects_hierarchical(comm):
+    hier = chainermn_tpu.create_communicator("hierarchical")
+    if isinstance(hier.axis_name, str):
+        pytest.skip("hierarchical comm degenerated to one axis on this host")
+    with pytest.raises(ValueError, match="flat single-axis"):
+        fsdp_spec({"w": jnp.zeros((8, 8))}, hier)
