@@ -39,16 +39,48 @@ class TransformerBlock(nn.Module):
     moe_experts: int = 0
     moe_axis: Optional[str] = None
     moe_capacity_factor: float = 1.25
+    # tensor_axis set -> Megatron-style block: head-sharded attention +
+    # column/row FFN from parallel.tensor, one psum each. Train with the
+    # global-objective pattern (tensor.py docstring), NOT the pcast/varying
+    # gradient pattern of the dense blocks.
+    tensor_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, pos_offset=0):
         dt = self.compute_dtype
         d_head = self.d_model // self.n_heads
+
+        h = nn.LayerNorm(dtype=dt)(x)
+        if self.tensor_axis is not None:
+            if self.moe_experts:
+                # guard here too (not only in TransformerLM): the TP branch
+                # would otherwise silently train a dense FFN instead of the
+                # experts AND return a bare array where the MoE contract
+                # promises (x, aux_loss)
+                raise ValueError(
+                    "tensor_axis and moe_experts are mutually exclusive "
+                    "on a TransformerBlock"
+                )
+            from chainermn_tpu.parallel.tensor import (
+                TensorParallelAttention,
+                TensorParallelMLP,
+            )
+
+            x = x + TensorParallelAttention(
+                d_model=self.d_model, n_heads=self.n_heads,
+                axis_name=self.tensor_axis, causal=True,
+                attention=self.attention, sequence_axis=self.sequence_axis,
+                compute_dtype=dt, name="attn",
+            )(h)
+            h = nn.LayerNorm(dtype=dt)(x)
+            return x + TensorParallelMLP(
+                d_model=self.d_model, d_ff=self.d_ff,
+                axis_name=self.tensor_axis, compute_dtype=dt, name="mlp",
+            )(h)
+
         attn_fn = sequence_parallel_attention(
             self.attention, self.sequence_axis, causal=True
         )
-
-        h = nn.LayerNorm(dtype=dt)(x)
         qkv = nn.DenseGeneral((3, self.n_heads, d_head), dtype=dt, name="qkv")(h)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
         o = attn_fn(q, k, v)
@@ -90,9 +122,19 @@ class TransformerLM(nn.Module):
     moe_axis: Optional[str] = None
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    # Megatron-style tensor parallelism: heads + FFN width sharded over this
+    # mesh axis in every block (embeddings and lm_head stay replicated).
+    # Train with the global-objective pattern (parallel/tensor.py docstring).
+    tensor_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, return_aux: bool = False):
+        if self.tensor_axis is not None and self.moe_experts:
+            raise ValueError(
+                "tensor_axis and moe_experts are mutually exclusive: the MoE "
+                "blocks' expert axis and the TP axis would need a combined "
+                "gradient pattern this model does not define"
+            )
         d_ff = self.d_ff or 4 * self.d_model
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
@@ -109,6 +151,7 @@ class TransformerLM(nn.Module):
                 moe_experts=self.moe_experts if is_moe else 0,
                 moe_axis=self.moe_axis,
                 moe_capacity_factor=self.moe_capacity_factor,
+                tensor_axis=self.tensor_axis,
                 name=f"block_{i}",
             )(x)
             x, aux = out if is_moe else (out, 0.0)

@@ -39,6 +39,7 @@ def pipeline_apply(
     x,
     axis_name: str,
     n_microbatches: int,
+    remat: bool = False,
 ):
     """Run ``x`` through ``n_stages = axis_size`` pipeline stages.
 
@@ -49,9 +50,20 @@ def pipeline_apply(
       x: full batch, replicated across the axis; leading dim divisible by
         ``n_microbatches``.
       axis_name: the pipeline mesh axis (inside ``shard_map``).
+      remat: rematerialize each stage in the backward pass
+        (``jax.checkpoint``). Without it the scan stashes every stage's
+        internal activations for all ``n_microbatches`` ticks; with it only
+        the microbatch boundary tensors persist and stage internals are
+        recomputed — the same live-activation bound 1F1B schedules buy with
+        manual fwd/bwd interleaving, obtained here by trading one extra
+        stage forward. (XLA owns the schedule either way; an explicit 1F1B
+        tick order would not change what the compiler overlaps, only this
+        memory profile, which remat already provides.)
 
     Returns the full-batch output of the last stage, replicated.
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b = x.shape[0]

@@ -12,6 +12,12 @@ from chainermn_tpu.parallel.fsdp import (
     jit_fsdp_train_step,
 )
 from chainermn_tpu.parallel.moe import ExpertParallelMLP
+from chainermn_tpu.parallel.tensor import (
+    ColumnParallelDense,
+    RowParallelDense,
+    TensorParallelAttention,
+    TensorParallelMLP,
+)
 from chainermn_tpu.parallel.sequence import (
     full_attention,
     ring_attention,
@@ -30,6 +36,10 @@ __all__ = [
     "fsdp_shard",
     "fsdp_spec",
     "jit_fsdp_train_step",
+    "ColumnParallelDense",
+    "RowParallelDense",
+    "TensorParallelAttention",
+    "TensorParallelMLP",
     "full_attention",
     "ring_attention",
     "ulysses_attention",

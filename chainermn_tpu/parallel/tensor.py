@@ -1,0 +1,233 @@
+"""Tensor parallelism (Megatron-style) over a mesh axis — TPU extension.
+
+The reference's only tensor-parallel construct is the channel-parallel
+convolution example (SURVEY.md S2.16: "no general TP engine"); this module
+provides the general engine for transformer-shaped models: column-parallel
+and row-parallel projections whose composition moves ONE ``psum`` per MLP
+and one per attention block (the Megatron f/g schedule), with the backward
+collectives derived by autodiff instead of hand-written.
+
+Layout convention (mirrors :mod:`chainermn_tpu.parallel.moe`): parameters are
+declared with their GLOBAL shapes — ordinary ``model.init`` outside
+``shard_map`` gives the correct initialization distribution and replicated
+storage — and each rank slices its block at apply time by axis index. A step
+builder that wants the weights sharded at rest passes the leaves in with a
+``P(axis)`` in_spec instead; the slice then sees the local shape and becomes
+the identity (same shape-check trick as the MoE experts).
+
+Training with TP layers — the **global-objective pattern** (tested leaf-exact
+in ``tests/parallel_tests/test_tensor.py``)::
+
+    def loss(params):                       # params INVARIANT (no pcast)
+        local = local_loss(model.apply(params, x))
+        return global_objective(local, (dp_axis, tp_axis))
+
+    grads = jax.grad(loss)(params)          # exact global grads, replicated
+
+With invariant params and an invariant (pmean'd) loss, shard_map's
+replication tracking assembles every leaf's exact global gradient: sliced
+leaves psum their zero-padded slice cotangents, replicated-compute leaves
+(row bias, embeddings, layernorms) average their identical copies — no
+per-leaf bookkeeping in user code. Do NOT ``pcast`` the params to varying
+here (the canonical DP step's trick): with a ``psum`` inside the forward, a
+varying loss differentiates the SUM of per-rank losses, which inflates every
+pre-psum leaf's gradient by ``n_tp``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+
+def _axis_size(axis_name: str) -> int:
+    return lax.psum(1, axis_name)
+
+
+class ColumnParallelDense(nn.Module):
+    """``y = x @ W[:, my_slice] + b[my_slice]`` — output feature-sharded.
+
+    ``features`` is the GLOBAL output width; the module returns the local
+    ``features / n`` slice. No communication in forward; the backward's
+    input-gradient psum is inserted by shard_map's replication tracking
+    (Megatron's "f" identity). ``kernel``/``bias`` are *sliced* leaves for
+    :func:`tp_grad_mean`.
+    """
+
+    features: int
+    axis_name: str
+    use_bias: bool = True
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        n = _axis_size(self.axis_name)
+        if self.features % n:
+            raise ValueError(
+                f"global features {self.features} not divisible by "
+                f"tensor-axis size {n}"
+            )
+        local_f = self.features // n
+        w = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features), self.compute_dtype,
+        )
+        r = lax.axis_index(self.axis_name)
+        if w.shape[-1] != local_f:  # replicated global weight: take my block
+            w = lax.dynamic_slice_in_dim(w, r * local_f, local_f, axis=-1)
+        y = x.astype(self.compute_dtype) @ w
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.features,), self.compute_dtype)
+            if b.shape[-1] != local_f:
+                b = lax.dynamic_slice_in_dim(b, r * local_f, local_f, axis=-1)
+            y = y + b
+        return y
+
+
+class RowParallelDense(nn.Module):
+    """``y = psum_tp(x_local @ W[my_slice, :]) + b`` — input feature-sharded,
+    output replicated. The one forward collective of the pair (Megatron's
+    "g"). ``kernel`` is a *sliced* leaf; ``bias`` adds after the psum on
+    every rank identically, so it is a *replicated-compute* leaf.
+    """
+
+    features: int
+    axis_name: str
+    in_features: Optional[int] = None  # GLOBAL input width (default: local*n)
+    use_bias: bool = True
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        n = _axis_size(self.axis_name)
+        local_in = x.shape[-1]
+        global_in = self.in_features or local_in * n
+        if global_in % n:
+            raise ValueError(
+                f"global in_features {global_in} not divisible by "
+                f"tensor-axis size {n}"
+            )
+        if global_in // n != local_in:
+            raise ValueError(
+                f"input is {local_in}-wide locally but global in_features "
+                f"{global_in} / {n} ranks = {global_in // n}"
+            )
+        w = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (global_in, self.features), self.compute_dtype,
+        )
+        r = lax.axis_index(self.axis_name)
+        if w.shape[0] != local_in:
+            w = lax.dynamic_slice_in_dim(w, r * local_in, local_in, axis=0)
+        y = lax.psum(x.astype(self.compute_dtype) @ w, self.axis_name)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros,
+                               (self.features,), self.compute_dtype)
+        return y
+
+
+class TensorParallelMLP(nn.Module):
+    """column(d_ff) -> activation -> row(d_model): one psum total."""
+
+    d_model: int
+    d_ff: int
+    axis_name: str
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.d_ff, self.axis_name,
+                                compute_dtype=self.compute_dtype)(x)
+        h = nn.gelu(h)
+        return RowParallelDense(self.d_model, self.axis_name,
+                                in_features=self.d_ff,
+                                compute_dtype=self.compute_dtype)(h)
+
+
+class TensorParallelAttention(nn.Module):
+    """Multi-head attention with HEADS sharded over the tensor axis:
+    column-parallel qkv (each rank computes its ``n_heads/n`` heads),
+    local attention, row-parallel output projection (one psum).
+
+    The inner attention is pluggable exactly like ``TransformerBlock``'s
+    (``attention='full'|'ring'|'ulysses'|'flash'`` + ``sequence_axis``): the
+    sequence-parallel kinds operate per-head, so TP (heads over one mesh
+    axis) composes with SP/CP (sequence over another) with no extra code.
+    """
+
+    d_model: int
+    n_heads: int
+    axis_name: str
+    causal: bool = True
+    attention: str = "full"
+    sequence_axis: Optional[str] = None
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from chainermn_tpu.parallel.sequence import sequence_parallel_attention
+
+        attn_fn = sequence_parallel_attention(
+            self.attention, self.sequence_axis, causal=self.causal
+        )
+        n = _axis_size(self.axis_name)
+        if self.n_heads % n:
+            raise ValueError(
+                f"n_heads {self.n_heads} not divisible by tensor-axis size {n}"
+            )
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"d_model {self.d_model} not divisible by n_heads {self.n_heads}"
+            )
+        d_head = self.d_model // self.n_heads
+        local_h = self.n_heads // n
+        qkv = ColumnParallelDense(
+            3 * self.d_model, self.axis_name,
+            compute_dtype=self.compute_dtype, name="qkv_tpcol",
+        )(x)
+        # local width is 3 * local_h * d_head. The global feature order is
+        # thereby DEFINED as (rank, 3, local_head, d_head)-major: rank r's
+        # contiguous slice is its own (q, k, v) block for its own heads.
+        # Init is i.i.d., so this ordering is as valid as torch/flax's
+        # (3, head, d_head); parity tests permute accordingly.
+        b, t = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(b, t, 3, local_h, d_head)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = attn_fn(q, k, v)
+        o = o.reshape(b, t, local_h * d_head)
+        return RowParallelDense(
+            self.d_model, self.axis_name, in_features=self.d_model,
+            compute_dtype=self.compute_dtype, name="proj_tprow",
+        )(o)
+
+
+def global_objective(local_loss, axes):
+    """``pmean`` the per-rank loss over every mesh axis it still varies on —
+    the closing line of the global-objective pattern (module docstring).
+
+    Why not a plain ``lax.pmean(local, axes)``: after a row-parallel psum the
+    loss is already invariant over the tensor axis, and JAX rejects reducing
+    an axis the value does not vary on; which axes remain varying depends on
+    the model's final layers. This reduces exactly the still-varying subset
+    (``jax.typeof(...).vma``), so one call is correct for pure-TP, pure-DP,
+    and hybrid steps alike.
+    """
+    import jax
+
+    if isinstance(axes, str):
+        axes = (axes,)
+    vary = tuple(a for a in axes if a in jax.typeof(local_loss).vma)
+    return lax.pmean(local_loss, vary) if vary else local_loss
+
+
+__all__ = [
+    "ColumnParallelDense",
+    "RowParallelDense",
+    "TensorParallelMLP",
+    "TensorParallelAttention",
+    "global_objective",
+]

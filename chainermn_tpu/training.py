@@ -142,6 +142,69 @@ def jit_train_step(
     return jax.jit(sm, donate_argnums=donate_argnums)
 
 
+def _jit_tp_lm_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    comm: CommunicatorBase,
+    tensor_axis: str,
+    shard_sequence: bool,
+    donate: bool,
+) -> Callable:
+    """The tensor-parallel LM step (dispatched to by :func:`jit_lm_train_step`
+    when the model was built with ``tensor_axis``).
+
+    Uses the **global-objective** gradient pattern (parallel/tensor.py):
+    params stay invariant, the loss is pmean'd over every mesh axis it varies
+    on, and replication tracking assembles each leaf's exact global gradient
+    — sliced TP leaves by psum of zero-padded slices, replicated leaves by
+    averaging. Consequently ``optimizer`` must be a PLAIN optax transform:
+    the grads arriving at it are already the global gradient, and a
+    multi-node wrapper's extra mean would shrink them by the axis size.
+
+    The batch shards over every communicator axis EXCEPT ``tensor_axis``
+    (pure TP on a flat comm = replicated batch; a hierarchical comm gives
+    dp x tp with dp on the other axis).
+    """
+    from chainermn_tpu.parallel.tensor import global_objective
+
+    axes = comm.axis_name
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    if tensor_axis not in axes:
+        raise ValueError(
+            f"model.tensor_axis={tensor_axis!r} is not one of the "
+            f"communicator's mesh axes {axes}"
+        )
+    if shard_sequence or getattr(model, "sequence_axis", None) is not None:
+        raise ValueError(
+            "the TP step shards batch over the non-tensor axes; combine "
+            "tensor_axis with sequence_axis at the module level "
+            "(TensorParallelAttention) over a mesh with a third axis instead"
+        )
+    dp_axes = tuple(a for a in axes if a != tensor_axis)
+
+    def body(params, opt_state, tokens, targets):
+        def loss_fn(p):
+            logits = model.apply(p, tokens, 0)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+            return global_objective(ce, axes)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_opt_state, loss
+
+    data = P(dp_axes) if dp_axes else P()
+    sm = comm.shard_map(
+        body,
+        in_specs=(P(), P(), data, data),
+        out_specs=(P(), P(), P()),
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(sm, donate_argnums=donate_argnums)
+
+
 def jit_lm_train_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -166,6 +229,12 @@ def jit_lm_train_step(
     attn = getattr(model, "attention", None)
     seq_axis = getattr(model, "sequence_axis", None)
     moe_experts = getattr(model, "moe_experts", 0)
+    tensor_axis = getattr(model, "tensor_axis", None)
+    if tensor_axis is not None:
+        return _jit_tp_lm_train_step(
+            model, optimizer, comm, tensor_axis,
+            shard_sequence=shard_sequence, donate=donate,
+        )
     if moe_experts and getattr(model, "moe_axis", None) != comm.axis_name:
         raise ValueError(
             f"MoE model must be built with moe_axis={comm.axis_name!r} "

@@ -157,7 +157,19 @@ def main() -> None:
                         help="C++ batch assembly (gather + fused uint8->f32 "
                              "normalize, GIL-free threads) with one-batch "
                              "prefetch — the MultiprocessIterator slot")
+    parser.add_argument("--fsdp", action="store_true",
+                        help="ZeRO-3 layout: params/grads/moments scattered "
+                             "over the data axis, XLA-partitioner-inserted "
+                             "gather/scatter (parallel.fsdp); BN statistics "
+                             "become global-batch (sync-BN) by construction")
     args = parser.parse_args()
+
+    if args.fsdp and (args.mnbn or args.double_buffering):
+        # MNBN's explicit collectives need shard_map axis names, which the
+        # FSDP global program doesn't have (its BN is already global-batch);
+        # double buffering configures the explicit gradient collective the
+        # FSDP step doesn't own.
+        raise SystemExit("--fsdp is incompatible with --mnbn/--double-buffering")
 
     if args.recipe:
         if args.warmup_epochs is None:
@@ -176,11 +188,16 @@ def main() -> None:
     # rather than silently running f32 (reference: pure_nccl-only flag)
     comm = chainermn_tpu.create_communicator(
         args.communicator,
-        allreduce_grad_dtype=None if args.dtype == "float32" else args.dtype,
+        # FSDP has no explicit gradient collective to configure a wire dtype
+        # on (the partitioner reduces in the gradient's own dtype)
+        allreduce_grad_dtype=None if (args.dtype == "float32" or args.fsdp)
+        else args.dtype,
     )
     if comm.rank == 0:
+        wire = "n/a (fsdp: partitioner reduces in the gradient dtype)" \
+            if args.fsdp else args.dtype
         print(f"arch={args.arch} communicator={args.communicator} "
-              f"wire-dtype={args.dtype} double_buffering={args.double_buffering} "
+              f"wire-dtype={wire} double_buffering={args.double_buffering} "
               f"devices={comm.size}")
 
     dataset = (NpzImageNet(args.train_npz) if args.train_npz
@@ -248,17 +265,29 @@ def main() -> None:
         )
     else:
         lr = args.lr
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(lr, momentum=0.9), comm,
-        double_buffering=args.double_buffering,
-    )
-    opt_state = jax.device_put(
-        optimizer.init(variables["params"]), comm.named_sharding()
-    )
-    step = jit_train_step(
-        model, optimizer, comm, train_kwargs={"train": True},
-        label_smoothing=args.label_smoothing,
-    )
+    if args.fsdp:
+        from chainermn_tpu.parallel import fsdp_shard, jit_fsdp_train_step
+
+        optimizer = optax.sgd(lr, momentum=0.9)  # no multi-node wrapper:
+        # the gradient mean falls out of the global-batch loss (fsdp.py)
+        variables = fsdp_shard(variables, comm)
+        opt_state = fsdp_shard(jax.jit(optimizer.init)(variables["params"]), comm)
+        step = jit_fsdp_train_step(
+            model, optimizer, comm, train_kwargs={"train": True},
+            label_smoothing=args.label_smoothing,
+        )
+    else:
+        optimizer = chainermn_tpu.create_multi_node_optimizer(
+            optax.sgd(lr, momentum=0.9), comm,
+            double_buffering=args.double_buffering,
+        )
+        opt_state = jax.device_put(
+            optimizer.init(variables["params"]), comm.named_sharding()
+        )
+        step = jit_train_step(
+            model, optimizer, comm, train_kwargs={"train": True},
+            label_smoothing=args.label_smoothing,
+        )
 
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(variables["params"]))
     if comm.rank == 0:
@@ -268,10 +297,14 @@ def main() -> None:
     if val_shard is not None:
         from jax.sharding import PartitionSpec as P
 
-        eval_forward = jax.jit(comm.shard_map(
-            lambda v, x: model.apply(v, x, train=False),
-            in_specs=(P(), comm.data_spec), out_specs=comm.data_spec,
-        ))
+        if args.fsdp:
+            # variables live scattered; a global program gathers them at use
+            eval_forward = jax.jit(lambda v, x: model.apply(v, x, train=False))
+        else:
+            eval_forward = jax.jit(comm.shard_map(
+                lambda v, x: model.apply(v, x, train=False),
+                in_specs=(P(), comm.data_spec), out_specs=comm.data_spec,
+            ))
 
         def _local_eval():
             # top-1 over this process's held-out shard; the multi-node

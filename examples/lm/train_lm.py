@@ -67,6 +67,10 @@ def main() -> None:
                              "(context parallelism); needs ring/ulysses")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="expert-parallel MoE FFN every 2nd block")
+    parser.add_argument("--tensor-parallel", action="store_true",
+                        help="Megatron-style TP: heads + FFN width sharded "
+                             "over the mesh axis, batch replicated "
+                             "(parallel.tensor; global-objective grads)")
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--n-tokens", type=int, default=200_000)
     parser.add_argument("--max-len", type=int, default=None,
@@ -78,6 +82,13 @@ def main() -> None:
     comm = chainermn_tpu.create_communicator("tpu")
     if args.seq_parallel and args.attention not in ("ring", "ulysses"):
         raise SystemExit("--seq-parallel needs --attention ring|ulysses")
+    if args.tensor_parallel and (args.seq_parallel or args.moe_experts):
+        raise SystemExit("--tensor-parallel uses the whole flat mesh axis; "
+                         "it does not combine with --seq-parallel or "
+                         "--moe-experts in this example")
+    if args.tensor_parallel and args.n_heads % comm.size:
+        raise SystemExit(f"--tensor-parallel needs n_heads divisible by the "
+                         f"{comm.size}-way mesh axis")
 
     model = TransformerLM(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
@@ -87,6 +98,7 @@ def main() -> None:
         sequence_axis=comm.axis_name if args.seq_parallel else None,
         moe_experts=args.moe_experts,
         moe_axis=comm.axis_name if args.moe_experts else None,
+        tensor_axis=comm.axis_name if args.tensor_parallel else None,
         compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
         else jnp.float32,
     )
@@ -96,8 +108,11 @@ def main() -> None:
     tokens_all = stream[: n_seq * args.seq_len].reshape(n_seq, args.seq_len)
     targets_all = stream[1 : n_seq * args.seq_len + 1].reshape(n_seq, args.seq_len)
 
-    if args.seq_parallel:
-        batch = args.batchsize  # sequence axis is what shards over the mesh
+    if args.seq_parallel or args.tensor_parallel:
+        # SP: the sequence axis shards over the mesh. TP: the WEIGHTS shard
+        # over the mesh and the batch is replicated. Either way --batchsize
+        # is already the global batch.
+        batch = args.batchsize
     else:
         batch = args.batchsize * comm.size
     if n_seq < batch:
@@ -117,14 +132,17 @@ def main() -> None:
                 yield tokens_all[sel], targets_all[sel]
 
     sample = jnp.asarray(tokens_all[:1])
-    if args.moe_experts or args.seq_parallel:
+    if args.moe_experts or args.seq_parallel or args.tensor_parallel:
         # collectives inside the model: init under the mesh
         from jax.sharding import PartitionSpec as P
 
         spec = (P(None, comm.axis_name) if args.seq_parallel
+                else P() if args.tensor_parallel
                 else comm.data_spec)
         init_tok = jnp.asarray(
-            tokens_all[:batch] if not args.seq_parallel else tokens_all[:1]
+            tokens_all[:batch]
+            if not (args.seq_parallel or args.tensor_parallel)
+            else tokens_all[:1]
         )
         params = jax.jit(comm.shard_map(
             lambda t: model.init(
@@ -134,9 +152,15 @@ def main() -> None:
     else:
         params = comm.bcast_data(model.init(jax.random.PRNGKey(0), sample))
 
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.adam(args.lr), comm
-    )
+    if args.tensor_parallel:
+        # plain optax: the TP step's grads are already the exact global
+        # gradient (global-objective pattern); a multi-node wrapper's extra
+        # mean would shrink them by the axis size
+        optimizer = optax.adam(args.lr)
+    else:
+        optimizer = chainermn_tpu.create_multi_node_optimizer(
+            optax.adam(args.lr), comm
+        )
     opt_state = jax.device_put(optimizer.init(params), comm.named_sharding())
     step = jit_lm_train_step(model, optimizer, comm,
                              shard_sequence=args.seq_parallel)
@@ -145,7 +169,7 @@ def main() -> None:
     if comm.rank == 0:
         print(f"{n_params / 1e6:.2f}M params  attention={args.attention} "
               f"seq_parallel={args.seq_parallel} moe={args.moe_experts} "
-              f"devices={comm.size}")
+              f"tensor_parallel={args.tensor_parallel} devices={comm.size}")
 
     gen = batches()
     t0, toks = time.time(), 0

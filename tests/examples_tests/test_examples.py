@@ -115,6 +115,15 @@ def test_train_lm_sequence_parallel():
     assert "done: 25 iterations" in proc.stdout
 
 
+def test_train_lm_tensor_parallel():
+    proc = run_example(
+        "lm/train_lm.py",
+        ["--iterations", "25", "--tensor-parallel", "--seq-len", "32",
+         "--d-model", "32", "--n-heads", "8", "--n-tokens", "20000"],
+    )
+    assert "done: 25 iterations" in proc.stdout
+
+
 def test_train_imagenet():
     proc = run_example(
         "imagenet/train_imagenet.py",
@@ -146,6 +155,19 @@ def test_train_imagenet_mnbn_double_buffering():
          "--mnbn", "--double-buffering"],
     )
     assert "done: 2 iterations" in proc.stdout
+
+
+def test_train_imagenet_fsdp():
+    """ZeRO-3 layout end-to-end: scattered params/moments, recipe eval path
+    (global-program eval forward on the scattered variables)."""
+    proc = run_example(
+        "imagenet/train_imagenet.py",
+        ["--arch", "resnet18", "--batchsize", "2", "--iterations", "2",
+         "--image-size", "32", "--classes", "10", "--n-synthetic", "64",
+         "--fsdp", "--val-frac", "0.1"],
+    )
+    assert "done: 2 iterations" in proc.stdout
+    assert "top-1" in proc.stdout
 
 
 def test_train_imagenet_native_loader():

@@ -92,6 +92,38 @@ def test_pipeline_gradients_match_serial(comm):
                                    rtol=1e-4, atol=1e-5, err_msg=k)
 
 
+def test_pipeline_remat_matches_serial_forward_and_grad(comm):
+    """remat=True (the 1F1B-memory-profile option) must be numerically
+    invisible: same outputs, same gradients, only the backward recomputes."""
+    n, d, b = comm.size, 6, 12
+    stacked = _stacked_params(jax.random.PRNGKey(8), n, d)
+    x = jax.random.normal(jax.random.PRNGKey(9), (b, d))
+    y = jax.random.normal(jax.random.PRNGKey(10), (b, d))
+
+    def loss_serial(p):
+        return jnp.mean((_serial(p, x) - y) ** 2)
+
+    def body(stacked, x, y):
+        local = jax.tree_util.tree_map(lambda l: l[0], stacked)
+        out = pipeline_apply(_stage, local, x, comm.axis_name, 4, remat=True)
+        return jnp.mean((out - y) ** 2)
+
+    def loss_pipe(p):
+        f = comm.shard_map(body, in_specs=(comm.data_spec, P(), P()),
+                           out_specs=P())
+        return f(p, x, y)
+
+    np.testing.assert_allclose(
+        float(jax.jit(loss_pipe)(stacked)), float(loss_serial(stacked)),
+        rtol=1e-5,
+    )
+    g_want = jax.grad(loss_serial)(stacked)
+    g_got = jax.jit(jax.grad(loss_pipe))(stacked)
+    for k in g_want:
+        np.testing.assert_allclose(np.asarray(g_got[k]), np.asarray(g_want[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
 def test_pipeline_rejects_bad_microbatch_count(comm):
     stacked = _stacked_params(jax.random.PRNGKey(7), comm.size, 4)
     x = jnp.zeros((10, 4))

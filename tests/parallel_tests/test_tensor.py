@@ -1,0 +1,215 @@
+"""Tensor parallelism: serial parity of the column/row pair, attention with
+sharded heads, and the global-objective gradient pattern.
+
+The reference's only TP is the channel-parallel conv example (SURVEY.md
+S2.16); these pin the general engine's contract: same global weights ->
+bit-identical-ish outputs and gradients as the unsharded computation, with
+exactly one psum per MLP / attention block.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.parallel import (
+    TensorParallelAttention,
+    TensorParallelMLP,
+)
+from chainermn_tpu.parallel.tensor import global_objective
+from chainermn_tpu.parallel.sequence import full_attention
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("tpu")
+
+
+def _run_replicated(comm, fn, *args):
+    """Trace fn on the mesh with every input replicated, output replicated."""
+    sm = comm.shard_map(
+        fn, in_specs=tuple(P() for _ in args), out_specs=P(),
+        
+    )
+    return jax.jit(sm)(*args)
+
+
+def test_mlp_matches_serial_dense(comm):
+    d_model, d_ff, b, t = 16, 64, 4, 6
+    mlp = TensorParallelMLP(d_model=d_model, d_ff=d_ff,
+                            axis_name=comm.axis_name)
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, t, d_model))
+    params = _run_replicated(
+        comm, lambda xx: mlp.init(jax.random.PRNGKey(1), xx), x
+    )
+
+    got = _run_replicated(comm, lambda p, xx: mlp.apply(p, xx), params, x)
+
+    # serial semantics with the SAME global weights
+    cp = params["params"]["ColumnParallelDense_0"]
+    rp = params["params"]["RowParallelDense_0"]
+    want = jax.nn.gelu(x @ cp["kernel"] + cp["bias"]) @ rp["kernel"] + rp["bias"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_attention_matches_serial(comm):
+    n = comm.size
+    d_model, n_heads, b, t = 32, 8, 2, 6
+    assert n_heads % n == 0
+    attn = TensorParallelAttention(d_model=d_model, n_heads=n_heads,
+                                   axis_name=comm.axis_name, causal=True)
+    x = jax.random.normal(jax.random.PRNGKey(2), (b, t, d_model))
+    params = _run_replicated(
+        comm, lambda xx: attn.init(jax.random.PRNGKey(3), xx), x
+    )
+    got = _run_replicated(comm, lambda p, xx: attn.apply(p, xx), params, x)
+
+    # serial: undo the (rank, 3, local_head, d_head)-major feature order
+    d_head, local_h = d_model // n_heads, n_heads // n
+    qkv_k = params["params"]["qkv_tpcol"]["kernel"]       # [D, 3*d_model]
+    qkv_b = params["params"]["qkv_tpcol"]["bias"]
+    qkv = x @ qkv_k + qkv_b
+    qkv = qkv.reshape(b, t, n, 3, local_h, d_head)
+    q = qkv[:, :, :, 0].reshape(b, t, n * local_h, d_head)
+    k = qkv[:, :, :, 1].reshape(b, t, n * local_h, d_head)
+    v = qkv[:, :, :, 2].reshape(b, t, n * local_h, d_head)
+    o = full_attention(q, k, v, causal=True)
+    # row kernel rows are (rank, local_head, d_head)-major == the o layout
+    proj_k = params["params"]["proj_tprow"]["kernel"]     # [d_model, d_model]
+    proj_b = params["params"]["proj_tprow"]["bias"]
+    want = o.reshape(b, t, d_model) @ proj_k + proj_b
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_tp_grad_matches_serial(comm):
+    """The global-objective pattern (tensor.py docstring) must reassemble the
+    exact serial gradient for EVERY leaf: invariant params + pmean'd loss
+    make replication tracking psum the zero-padded slice cotangents and
+    average the replicated ones. (Differentiating a varying loss instead
+    silently inflates every pre-psum leaf by n — the bug this test pins.)"""
+    d_model, d_ff, b, t = 8, 32, 2, 4
+    mlp = TensorParallelMLP(d_model=d_model, d_ff=d_ff,
+                            axis_name=comm.axis_name)
+    x = jax.random.normal(jax.random.PRNGKey(4), (b, t, d_model))
+    y = jax.random.normal(jax.random.PRNGKey(5), (b, t, d_model))
+    params = _run_replicated(
+        comm, lambda xx: mlp.init(jax.random.PRNGKey(6), xx), x
+    )
+
+    def tp_grads(p, xx, yy):
+        def loss(pp):
+            local = jnp.mean((mlp.apply(pp, xx) - yy) ** 2)
+            return global_objective(local, comm.axis_name)
+
+        return jax.grad(loss)(p)
+
+    g_tp = jax.jit(comm.shard_map(
+        tp_grads, in_specs=(P(), P(), P()), out_specs=P()
+    ))(params, x, y)
+
+    def serial_loss(p):
+        cp, rp = p["params"]["ColumnParallelDense_0"], p["params"]["RowParallelDense_0"]
+        out = (jax.nn.gelu(x @ cp["kernel"] + cp["bias"]) @ rp["kernel"]
+               + rp["bias"])
+        return jnp.mean((out - y) ** 2)
+
+    g_serial = jax.grad(serial_loss)(params)
+    flat_tp = jax.tree_util.tree_leaves_with_path(g_tp)
+    flat_s = dict(
+        (jax.tree_util.keystr(kp), l)
+        for kp, l in jax.tree_util.tree_leaves_with_path(g_serial)
+    )
+    assert flat_tp
+    for kp, l in flat_tp:
+        key = jax.tree_util.keystr(kp)
+        np.testing.assert_allclose(
+            np.asarray(l), np.asarray(flat_s[key]),
+            rtol=1e-4, atol=1e-6, err_msg=key,
+        )
+
+
+def test_tp_transformer_lm_trains(comm):
+    """TransformerLM(tensor_axis=...) through jit_lm_train_step: the TP
+    dispatch path, global-objective grads, plain optax optimizer. Loss must
+    decrease and params stay replicated-identical across steps."""
+    import optax
+
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.training import jit_lm_train_step
+
+    lm = TransformerLM(
+        vocab_size=32, d_model=16, n_heads=8, n_layers=2, max_len=64,
+        tensor_axis=comm.axis_name, compute_dtype=jnp.float32,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(10), (4, 12), 0, 32)
+    params = _run_replicated(
+        comm, lambda tt: lm.init(jax.random.PRNGKey(11), tt), tokens
+    )
+    opt = optax.adam(1e-2)
+    state = jax.jit(opt.init)(params)
+    step = jit_lm_train_step(lm, opt, comm, donate=False)
+    losses = []
+    for _ in range(5):
+        params, state, lval = step(params, state, tokens, tokens)
+        losses.append(float(lval))
+    assert losses[-1] < losses[0], losses
+
+
+def test_tp_lm_rejects_foreign_axis(comm):
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.training import jit_lm_train_step
+    import optax
+
+    lm = TransformerLM(vocab_size=8, d_model=8, n_heads=8, n_layers=1,
+                       tensor_axis="nonexistent")
+    with pytest.raises(ValueError, match="mesh axes"):
+        jit_lm_train_step(lm, optax.sgd(0.1), comm)
+
+
+def test_hybrid_dp_tp_step_trains(comm):
+    """dp x tp over a 2-axis mesh: batch sharded over dp, weights sliced over
+    tp, per-leaf grad reduction — loss decreases and params stay replicated."""
+    hier = chainermn_tpu.create_communicator("hierarchical")
+    axes = hier.axis_name
+    if isinstance(axes, str):
+        pytest.skip("hierarchical comm degenerated to one axis")
+    dp_axis, tp_axis = axes
+    d_model, d_ff = 8, 16
+    mlp = TensorParallelMLP(d_model=d_model, d_ff=d_ff, axis_name=tp_axis)
+    n_dp = hier.mesh.shape[dp_axis]
+    xs = jax.random.normal(jax.random.PRNGKey(7), (2 * n_dp, 3, d_model))
+    ys = jax.random.normal(jax.random.PRNGKey(8), (2 * n_dp, 3, d_model))
+    params = jax.jit(hier.shard_map(
+        lambda xx: mlp.init(jax.random.PRNGKey(9), xx[:1]),
+        in_specs=P(dp_axis), out_specs=P()
+    ))(xs)
+
+    import optax
+
+    opt = optax.sgd(0.1)
+    state = jax.jit(opt.init)(params)
+
+    def step(p, s, xx, yy):
+        def loss(pp):
+            local = jnp.mean((mlp.apply(pp, xx) - yy) ** 2)
+            return global_objective(local, (dp_axis, tp_axis))
+
+        lval, g = jax.value_and_grad(loss)(p)
+        updates, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s2, lval
+
+    jstep = jax.jit(hier.shard_map(
+        step,
+        in_specs=(P(), P(), P(dp_axis), P(dp_axis)),
+        out_specs=(P(), P(), P()),
+        
+    ))
+    losses = []
+    for _ in range(5):
+        params, state, lval = jstep(params, state, xs, ys)
+        losses.append(float(lval))
+    assert losses[-1] < losses[0], losses
