@@ -227,7 +227,65 @@ class MeshCommunicator(CommunicatorBase):
 
         return jax.tree_util.tree_map(leaf, x)
 
+    # Below this many bytes per leaf, prod uses one all_gather + local
+    # reduce (one collective, size x bytes — fine for the typical tiny
+    # operands); above it, the ring decomposition (2x payload wire,
+    # O(payload) memory, n-1 latency steps).
+    _PROD_RING_THRESHOLD = 1 << 16
+
+    def _prod(self, x):
+        """Allreduce-prod. XLA has no prod collective and psum_scatter can't
+        carry the op, so this is either gather+reduce (small leaves) or a
+        ring reduce-scatter in multiply (large leaves) — the same
+        decomposition `_grouped_sum` uses, with ppermute because the
+        reduction op must be ours."""
+        ring_ok = self.size > 1
+
+        def leaf(a):
+            if not ring_ok or a.size * a.dtype.itemsize <= self._PROD_RING_THRESHOLD:
+                return jnp.prod(self._gathered(a), axis=0)
+            return self._ring_prod_leaf(a)
+
+        return jax.tree_util.tree_map(leaf, x)
+
+    def _ring_prod_leaf(self, a):
+        """Ring allreduce with multiply: after s hops the carry that will end
+        at group slot q has visited slots q-s..q-1, each multiplying in its
+        local block for index q; an all_gather of the finished blocks
+        rebuilds the full product. Works grouped (ring within each group),
+        ungrouped, and on multi-axis meshes (ppermute linearizes tuple axes
+        exactly as axis_index does)."""
+        axis = self._axes
+        n = self.size
+        pos = self.axis_index()
+        flat = jnp.ravel(a)
+        pad = (-flat.size) % n
+        if pad:  # pad value never survives the final slice; ones for tidiness
+            flat = jnp.concatenate([flat, jnp.ones((pad,), flat.dtype)])
+        blocks = flat.reshape(n, -1)
+        if self._groups is None:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+        else:
+            perm = [(g[i], g[(i + 1) % len(g)])
+                    for g in self._groups for i in range(len(g))]
+
+        def block_for(s):
+            return jnp.take(blocks, jnp.mod(pos - s - 1, n), axis=0)
+
+        carry = block_for(0)
+        for s in range(1, n):
+            carry = lax.ppermute(carry, axis, perm)
+            carry = carry * block_for(s)
+        full = lax.all_gather(
+            carry, axis, axis_index_groups=self._groups, tiled=False
+        ).reshape(-1)
+        if pad:
+            full = full[: flat.size - pad]
+        return full.reshape(a.shape)
+
     def _t_allreduce(self, x, op: ReduceOp):
+        if op == "prod":
+            return self._prod(x)
         if self._groups is None:
             if op == "sum":
                 return lax.psum(x, self._axes)
@@ -237,10 +295,6 @@ class MeshCommunicator(CommunicatorBase):
                 return lax.pmax(x, self._axes)
             if op == "min":
                 return lax.pmin(x, self._axes)
-            if op == "prod":
-                return jax.tree_util.tree_map(
-                    lambda g: jnp.prod(g, axis=0), self._gathered(x)
-                )
             raise ValueError(f"unknown reduce op {op!r}")
         if op == "max":
             return lax.pmax(x, self._axes, axis_index_groups=self._groups)
@@ -251,10 +305,6 @@ class MeshCommunicator(CommunicatorBase):
         if op == "mean":
             return jax.tree_util.tree_map(
                 lambda s: s / self.size, self._grouped_sum(x)
-            )
-        if op == "prod":  # no scatter-able primitive for prod: gather+reduce
-            return jax.tree_util.tree_map(
-                lambda a: jnp.prod(a, axis=0), self._gathered(x)
             )
         raise ValueError(f"unknown reduce op {op!r}")
 

@@ -126,6 +126,12 @@ class TransformerLM(nn.Module):
     # mesh axis in every block (embeddings and lm_head stay replicated).
     # Train with the global-objective pattern (parallel/tensor.py docstring).
     tensor_axis: Optional[str] = None
+    # With tensor_axis: shard the LM head over the vocab too. __call__ then
+    # returns LOCAL logits [B, T, vocab/n] (rank r's contiguous vocab slice)
+    # — full [B, T, vocab] logits are never materialized. Train against
+    # parallel.tensor.vocab_parallel_cross_entropy (jit_lm_train_step does
+    # this automatically); for inference, all_gather the last axis.
+    vocab_parallel_head: bool = False
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, return_aux: bool = False):
@@ -135,6 +141,8 @@ class TransformerLM(nn.Module):
                 "blocks' expert axis and the TP axis would need a combined "
                 "gradient pattern this model does not define"
             )
+        if self.vocab_parallel_head and self.tensor_axis is None:
+            raise ValueError("vocab_parallel_head needs tensor_axis")
         d_ff = self.d_ff or 4 * self.d_model
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
@@ -157,8 +165,16 @@ class TransformerLM(nn.Module):
             x, aux = out if is_moe else (out, 0.0)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype,
-                          name="lm_head")(x)
+        if self.vocab_parallel_head:
+            from chainermn_tpu.parallel.tensor import ColumnParallelDense
+
+            logits = ColumnParallelDense(
+                self.vocab_size, self.tensor_axis,
+                compute_dtype=self.compute_dtype, name="lm_head",
+            )(x)
+        else:
+            logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype,
+                              name="lm_head")(x)
         logits = logits.astype(jnp.float32)
         if return_aux:
             return logits, aux_total

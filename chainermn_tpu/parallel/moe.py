@@ -113,10 +113,11 @@ class ExpertParallelMLP(nn.Module):
 
         # --- per-expert FFN (batched einsum: one MXU-friendly matmul) -- #
         # Expert weights are declared GLOBAL [n_experts, ...] and each rank
-        # slices its local block by axis index: init stays ordinary flax
-        # (replicated params), and a step builder that wants ZeRO-style
-        # expert-weight sharding can pass these leaves in with a P(axis)
-        # in_spec instead — the slice below then becomes the identity.
+        # slices its local block by axis index: init stays ordinary flax and
+        # storage is replicated (flax validates param shapes against the
+        # declaration, so a shard_map in_spec cannot feed local-shape
+        # leaves); at-rest sharding of expert weights is the partitioner's
+        # job (fsdp_shard's layout under plain jit), not an in_spec trick.
         # batch_axis=0: each expert inits as an independent (in, out) matrix
         # — a plain lecun_normal would fold n_experts into fan_in and shrink
         # the per-expert std by sqrt(n_experts)
@@ -134,8 +135,6 @@ class ExpertParallelMLP(nn.Module):
         r = lax.axis_index(self.axis_name)
 
         def local(p):
-            if p.shape[0] == local_e:  # already sharded by the step's in_spec
-                return p
             return lax.dynamic_slice_in_dim(p, r * local_e, local_e, 0)
 
         h = nn.relu(jnp.einsum("ecd,edf->ecf", recv, local(w1)) + local(b1))

@@ -10,10 +10,12 @@ collectives derived by autodiff instead of hand-written.
 Layout convention (mirrors :mod:`chainermn_tpu.parallel.moe`): parameters are
 declared with their GLOBAL shapes — ordinary ``model.init`` outside
 ``shard_map`` gives the correct initialization distribution and replicated
-storage — and each rank slices its block at apply time by axis index. A step
-builder that wants the weights sharded at rest passes the leaves in with a
-``P(axis)`` in_spec instead; the slice then sees the local shape and becomes
-the identity (same shape-check trick as the MoE experts).
+storage — and each rank slices its block at apply time by axis index.
+Storage is therefore replicated (flax validates param shapes against the
+declaration, so shard_map in_specs cannot feed these modules local-shape
+leaves); TP here buys *compute* and *activation* sharding. Weights-at-rest
+sharding is the partitioner's job — the :mod:`chainermn_tpu.parallel.fsdp`
+layout under plain ``jit`` — not a shard_map in_spec trick.
 
 Training with TP layers — the **global-objective pattern** (tested leaf-exact
 in ``tests/parallel_tests/test_tensor.py``)::
@@ -76,14 +78,12 @@ class ColumnParallelDense(nn.Module):
             (x.shape[-1], self.features), self.compute_dtype,
         )
         r = lax.axis_index(self.axis_name)
-        if w.shape[-1] != local_f:  # replicated global weight: take my block
-            w = lax.dynamic_slice_in_dim(w, r * local_f, local_f, axis=-1)
+        w = lax.dynamic_slice_in_dim(w, r * local_f, local_f, axis=-1)
         y = x.astype(self.compute_dtype) @ w
         if self.use_bias:
             b = self.param("bias", nn.initializers.zeros,
                            (self.features,), self.compute_dtype)
-            if b.shape[-1] != local_f:
-                b = lax.dynamic_slice_in_dim(b, r * local_f, local_f, axis=-1)
+            b = lax.dynamic_slice_in_dim(b, r * local_f, local_f, axis=-1)
             y = y + b
         return y
 
@@ -121,8 +121,7 @@ class RowParallelDense(nn.Module):
             (global_in, self.features), self.compute_dtype,
         )
         r = lax.axis_index(self.axis_name)
-        if w.shape[0] != local_in:
-            w = lax.dynamic_slice_in_dim(w, r * local_in, local_in, axis=0)
+        w = lax.dynamic_slice_in_dim(w, r * local_in, local_in, axis=0)
         y = lax.psum(x.astype(self.compute_dtype) @ w, self.axis_name)
         if self.use_bias:
             y = y + self.param("bias", nn.initializers.zeros,
@@ -205,6 +204,38 @@ class TensorParallelAttention(nn.Module):
         )(o)
 
 
+def vocab_parallel_cross_entropy(local_logits, targets, axis_name: str):
+    """Per-token cross entropy over a VOCAB-SHARDED logits tensor, without
+    ever materializing the full ``[..., vocab]`` logits (the classic
+    large-vocab memory win of a vocab-parallel head).
+
+    ``local_logits [..., V/n]`` is rank ``r``'s contiguous vocab slice
+    ``[r*V/n, (r+1)*V/n)`` — e.g. the output of
+    ``ColumnParallelDense(vocab_size, axis)``; ``targets`` hold GLOBAL vocab
+    ids. Three scalar-per-token collectives: pmax for the stable shift, psum
+    of the local sum-exp for the denominator, and a masked psum that routes
+    each target's logit from the one rank whose shard holds it. Output is
+    invariant over ``axis_name`` (matches
+    ``optax.softmax_cross_entropy_with_integer_labels`` on the gathered
+    logits — pinned in tests), and autodiff through it yields the sharded
+    head's exact gradients under the global-objective pattern.
+    """
+    r = lax.axis_index(axis_name)
+    v_local = local_logits.shape[-1]
+    logits = local_logits.astype(jnp.float32)
+    start = r * v_local
+    gmax = lax.pmax(
+        lax.stop_gradient(jnp.max(logits, axis=-1)), axis_name
+    )
+    shifted = logits - gmax[..., None]
+    denom = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
+    in_shard = (targets >= start) & (targets < start + v_local)
+    local_idx = jnp.clip(targets - start, 0, v_local - 1)
+    t_local = jnp.take_along_axis(shifted, local_idx[..., None], axis=-1)[..., 0]
+    t_logit = lax.psum(jnp.where(in_shard, t_local, 0.0), axis_name)
+    return jnp.log(denom) - t_logit
+
+
 def global_objective(local_loss, axes):
     """``pmean`` the per-rank loss over every mesh axis it still varies on —
     the closing line of the global-objective pattern (module docstring).
@@ -220,6 +251,16 @@ def global_objective(local_loss, axes):
 
     if isinstance(axes, str):
         axes = (axes,)
+    # The pattern is built ON vma tracking: with check_vma=False every value
+    # reads as vma-empty, no pmean would ever fire, and the "grads" would be
+    # per-rank garbage — fail loudly instead (axis_index is varying by
+    # construction, so an empty vma on it means tracking is off).
+    if not jax.typeof(lax.axis_index(axes[0])).vma:
+        raise ValueError(
+            "global_objective requires replication (vma) tracking, but this "
+            "shard_map was built with check_vma=False — the global-objective "
+            "gradient pattern cannot work there (no automatic psum assembly)"
+        )
     vary = tuple(a for a in axes if a in jax.typeof(local_loss).vma)
     return lax.pmean(local_loss, vary) if vary else local_loss
 
@@ -230,4 +271,5 @@ __all__ = [
     "TensorParallelMLP",
     "TensorParallelAttention",
     "global_objective",
+    "vocab_parallel_cross_entropy",
 ]

@@ -180,14 +180,36 @@ def _jit_tp_lm_train_step(
             "tensor_axis with sequence_axis at the module level "
             "(TensorParallelAttention) over a mesh with a third axis instead"
         )
+    if (getattr(model, "attention", None) == "flash"
+            and jax.default_backend() != "tpu"):
+        # The dense LM step works around interpret-mode Pallas by dropping
+        # to check_vma=False; the TP step CANNOT (the global-objective
+        # pattern is built on vma tracking — global_objective raises).
+        raise ValueError(
+            "tensor_axis + attention='flash' needs compiled TPU Pallas "
+            "kernels; in interpret mode (non-TPU backends) the required "
+            "check_vma=False would break the global-objective gradient "
+            "pattern — use attention='full' off-TPU"
+        )
     dp_axes = tuple(a for a in axes if a != tensor_axis)
+
+    vocab_parallel = getattr(model, "vocab_parallel_head", False)
 
     def body(params, opt_state, tokens, targets):
         def loss_fn(p):
             logits = model.apply(p, tokens, 0)
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets
-            ).mean()
+            if vocab_parallel:
+                from chainermn_tpu.parallel.tensor import (
+                    vocab_parallel_cross_entropy,
+                )
+
+                ce = vocab_parallel_cross_entropy(
+                    logits, targets, tensor_axis
+                ).mean()
+            else:
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets
+                ).mean()
             return global_objective(ce, axes)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)

@@ -71,6 +71,10 @@ def main() -> None:
                         help="Megatron-style TP: heads + FFN width sharded "
                              "over the mesh axis, batch replicated "
                              "(parallel.tensor; global-objective grads)")
+    parser.add_argument("--vocab-parallel-head", action="store_true",
+                        help="with --tensor-parallel: shard the LM head "
+                             "over the vocab; full logits are never "
+                             "materialized (sharded-vocab cross entropy)")
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--n-tokens", type=int, default=200_000)
     parser.add_argument("--max-len", type=int, default=None,
@@ -89,6 +93,8 @@ def main() -> None:
     if args.tensor_parallel and args.n_heads % comm.size:
         raise SystemExit(f"--tensor-parallel needs n_heads divisible by the "
                          f"{comm.size}-way mesh axis")
+    if args.vocab_parallel_head and not args.tensor_parallel:
+        raise SystemExit("--vocab-parallel-head needs --tensor-parallel")
 
     model = TransformerLM(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
@@ -99,6 +105,7 @@ def main() -> None:
         moe_experts=args.moe_experts,
         moe_axis=comm.axis_name if args.moe_experts else None,
         tensor_axis=comm.axis_name if args.tensor_parallel else None,
+        vocab_parallel_head=args.vocab_parallel_head,
         compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
         else jnp.float32,
     )

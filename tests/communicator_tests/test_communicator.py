@@ -55,6 +55,50 @@ def test_allreduce_ops(comm, op):
         np.testing.assert_allclose(y[r], expected, rtol=1e-5)
 
 
+def test_allreduce_prod_large_ring(comm):
+    """Leaves above _PROD_RING_THRESHOLD take the ppermute ring
+    decomposition (2x payload wire instead of size x); must agree with the
+    gathered path bit-for-bit-ish, padding lane included (odd length)."""
+    n = comm.size
+    rng = np.random.RandomState(7)
+    # > 64 KiB of f32 per rank, odd length to exercise ring padding; values
+    # near 1 so the product of `size` factors stays well-conditioned
+    per_rank = 16411
+    x = (rng.uniform(0.9, 1.1, size=(n, per_rank))
+         .astype(np.float32))
+    x[:, 3] *= -1.0  # sign handling
+    y = np.asarray(comm.allreduce(x, "prod"))
+    expected = x.prod(axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(y[r], expected, rtol=1e-5)
+
+
+def test_hierarchical_allreduce_prod_large_ring():
+    """Multi-axis (hierarchical) comms ring over the linearized tuple axes —
+    no silent size-x-bytes gather fallback for large leaves."""
+    comm = create_communicator("hierarchical")
+    n = comm.size
+    rng = np.random.RandomState(9)
+    x = rng.uniform(0.9, 1.1, size=(n, 16411)).astype(np.float32)
+    y = np.asarray(comm.allreduce(x, "prod"))
+    expected = x.prod(axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(y[r], expected, rtol=1e-5)
+
+
+def test_grouped_allreduce_prod_large_ring(comm):
+    """The ring must also respect split() groups: ring within each group."""
+    sub = comm.split(color=np.arange(comm.size) % 2)
+    n = comm.size
+    rng = np.random.RandomState(8)
+    per_rank = 16411
+    x = rng.uniform(0.9, 1.1, size=(n, per_rank)).astype(np.float32)
+    y = np.asarray(sub.allreduce(x, "prod"))
+    for r in range(n):
+        members = [q for q in range(n) if q % 2 == r % 2]
+        np.testing.assert_allclose(y[r], x[members].prod(axis=0), rtol=1e-5)
+
+
 @pytest.mark.parametrize("root", [0, 3])
 def test_bcast(comm, root):
     x = _ranked(comm)
@@ -273,8 +317,9 @@ class TestSplit:
         np.testing.assert_allclose(m[0], np.mean([float(r) for r in range(half)]))
 
     def test_split_allreduce_pytree(self):
-        """Grouped sum/mean/prod go through gather+local-reduce; they must
-        accept pytrees like the ungrouped psum/pmean path does."""
+        """Grouped sum/mean ride the ring-decomposed path and small prod the
+        gather+local-reduce path; all must accept pytrees like the ungrouped
+        psum/pmean path does."""
         comm = create_communicator("naive")
         n = comm.size
         sub = comm.split([r % 2 for r in range(n)])
