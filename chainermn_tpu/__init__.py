@@ -31,6 +31,7 @@ from chainermn_tpu.links import (
     create_mnbn_model,
 )
 from chainermn_tpu.optimizers import (
+    clip_by_global_norm_sharded,
     create_multi_node_optimizer,
     create_zero_optimizer,
 )
@@ -60,6 +61,7 @@ __all__ = [
     "create_communicator",
     "create_multi_node_optimizer",
     "create_zero_optimizer",
+    "clip_by_global_norm_sharded",
     "create_multi_node_evaluator",
     "MultiNodeChainList",
     "MultiNodeBatchNormalization",

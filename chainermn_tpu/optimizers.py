@@ -191,9 +191,11 @@ def create_zero_optimizer(
 
     Constraints: the inner optimizer must be *elementwise* (sgd, momentum,
     adam(w), rmsprop... — anything whose update for parameter i depends only
-    on grad/param/moment i). Global-statistic transforms (e.g.
-    ``clip_by_global_norm``) would compute shard-local statistics — compose
-    them outside. Requires a flat (single-axis, unsplit) communicator.
+    on grad/param/moment i). Plain global-statistic transforms (e.g.
+    ``optax.clip_by_global_norm``) would compute shard-local statistics —
+    use :func:`clip_by_global_norm_sharded` (psums the squared norm across
+    shards) for gradient clipping, or compose other global transforms
+    outside. Requires a flat (single-axis, unsplit) communicator.
 
     Use with ``jit_train_step(model, opt, comm)`` (it reads ``state_spec``)
     and place the initial state with
@@ -285,6 +287,55 @@ def create_zero_optimizer(
     from jax.sharding import PartitionSpec as P
 
     return ZeroOptimizer(init=init, update=update, state_spec=P(axis))
+
+
+def clip_by_global_norm_sharded(
+    max_norm: float,
+    communicator: CommunicatorBase,
+) -> optax.GradientTransformation:
+    """``optax.clip_by_global_norm`` whose norm is the TRUE global norm when
+    the gradients it sees are 1/n shards.
+
+    Inside :func:`create_zero_optimizer`, the inner chain runs on each
+    rank's parameter-vector shard — plain ``optax.clip_by_global_norm``
+    there would clip by the *shard-local* norm (the documented ZeRO
+    constraint against global-statistic transforms). This transform psums
+    the squared norm over the communicator's axis first, so::
+
+        create_zero_optimizer(
+            optax.chain(clip_by_global_norm_sharded(1.0, comm),
+                        optax.adam(lr)),
+            comm)
+
+    clips identically to replicated ``optax.chain(clip_by_global_norm(1.0),
+    adam(lr))`` — pinned in tests. Outside a traced mesh context it is an
+    error (the psum needs the axis); use plain optax clipping for
+    replicated gradients.
+    """
+    import jax.numpy as jnp
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+        local_sq = sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree_util.tree_leaves(updates)
+        )
+        # through the communicator, not a raw lax.psum: split()
+        # sub-communicators then reduce over THEIR group only, and
+        # multi-axis meshes reduce over all their axes
+        gn = jnp.sqrt(communicator.allreduce(local_sq, "sum"))
+        # optax semantics: scale by max_norm/gn only when gn > max_norm
+        scale = jnp.where(gn > max_norm, max_norm / gn, 1.0)
+        return (
+            jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), updates),
+            state,
+        )
+
+    return optax.GradientTransformation(init, update)
 
 
 def wait_double_buffering(state: _DoubleBufferState) -> Any:

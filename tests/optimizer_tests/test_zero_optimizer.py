@@ -60,6 +60,37 @@ def test_zero_matches_unsharded(comm, inner):
                                    rtol=2e-5, atol=2e-6)
 
 
+def test_zero_sharded_clip_matches_replicated_clip(comm):
+    """clip_by_global_norm_sharded inside the ZeRO inner chain must clip by
+    the TRUE global norm: same trajectory as replicated optax.chain(
+    clip_by_global_norm, sgd) under the multi-node optimizer. A plain
+    optax.clip_by_global_norm in the shard would use 1/n-shard norms and
+    diverge — the documented ZeRO constraint this transform lifts."""
+    max_norm = 0.05  # small enough that clipping actually engages
+
+    ref_opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.chain(optax.clip_by_global_norm(max_norm),
+                    optax.sgd(0.1, momentum=0.9)), comm
+    )
+    zero_opt = chainermn_tpu.create_zero_optimizer(
+        optax.chain(
+            chainermn_tpu.clip_by_global_norm_sharded(max_norm, comm),
+            optax.sgd(0.1, momentum=0.9),
+        ),
+        comm,
+    )
+    step_r, vars_r, st_r, images, labels = _setup(comm, ref_opt)
+    step_z, vars_z, st_z, _, _ = _setup(comm, zero_opt)
+    for _ in range(4):
+        vars_r, st_r, loss_r = step_r(vars_r, st_r, images, labels)
+        vars_z, st_z, loss_z = step_z(vars_z, st_z, images, labels)
+    np.testing.assert_allclose(float(loss_z), float(loss_r), rtol=1e-5)
+    for lr, lz in zip(jax.tree_util.tree_leaves(vars_r["params"]),
+                      jax.tree_util.tree_leaves(vars_z["params"])):
+        np.testing.assert_allclose(np.asarray(lz), np.asarray(lr),
+                                   rtol=2e-5, atol=2e-6)
+
+
 def test_zero_state_is_sharded(comm):
     """Moment leaves must be rank-major [n, shard] and actually sharded —
     per-device optimizer memory is full/n (the ZeRO-1 claim)."""
