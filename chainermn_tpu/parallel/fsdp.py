@@ -54,15 +54,31 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase
 
 
-def _check_flat(comm: CommunicatorBase) -> str:
-    axis = comm.axis_name
-    if not isinstance(axis, str):
-        raise ValueError(
-            f"FSDP needs a flat single-axis communicator (got axes {axis!r}); "
-            "hierarchical meshes have no single data axis to shard over"
-        )
+def _shard_axis(comm: CommunicatorBase, axis: Optional[str]) -> str:
+    """Resolve the mesh axis the weights scatter over.
+
+    Flat communicator: its one axis (``axis`` may be omitted). Hierarchical
+    communicator: ``axis`` picks which level shards — passing the *intra*
+    (ICI) axis gives HSDP: weights scattered within each fast domain and
+    replicated across the slow (inter/DCN) one, so the per-use all_gathers
+    ride ICI while cross-host traffic stays one gradient all-reduce.
+    """
     if getattr(comm, "_groups", None) is not None:
         raise ValueError("FSDP does not support split() sub-communicators")
+    axes = comm.axis_name
+    if isinstance(axes, str):
+        if axis is not None and axis != axes:
+            raise ValueError(f"axis {axis!r} is not the communicator's "
+                             f"axis {axes!r}")
+        return axes
+    if axis is None:
+        raise ValueError(
+            f"hierarchical communicator has axes {axes!r}: pass axis=... to "
+            "choose the level the weights scatter over (the intra/ICI axis "
+            "for HSDP)"
+        )
+    if axis not in axes:
+        raise ValueError(f"axis {axis!r} not in communicator axes {axes!r}")
     return axis
 
 
@@ -78,33 +94,34 @@ def spec_for_shape(shape, n: int, axis: str) -> P:
     return P(*(axis if i == best else None for i in range(len(shape))))
 
 
-def fsdp_spec(tree, comm: CommunicatorBase):
-    """Per-leaf PartitionSpecs for ``tree`` under ``comm``'s mesh axis."""
-    axis = _check_flat(comm)
-    n = comm.size
+def fsdp_spec(tree, comm: CommunicatorBase, axis: Optional[str] = None):
+    """Per-leaf PartitionSpecs scattering ``tree`` over ``axis`` (see
+    :func:`_shard_axis`; omit on a flat communicator)."""
+    ax = _shard_axis(comm, axis)
+    n = comm.mesh.shape[ax]
     return jax.tree_util.tree_map(
-        lambda l: spec_for_shape(jax.numpy.shape(l), n, axis), tree
+        lambda l: spec_for_shape(jax.numpy.shape(l), n, ax), tree
     )
 
 
-def fsdp_shard(tree, comm: CommunicatorBase):
+def fsdp_shard(tree, comm: CommunicatorBase, axis: Optional[str] = None):
     """Place ``tree`` scattered over the mesh per :func:`fsdp_spec`."""
     mesh = comm.mesh
     return jax.tree_util.tree_map(
         lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
         tree,
-        fsdp_spec(tree, comm),
+        fsdp_spec(tree, comm, axis),
     )
 
 
-def _constrain(tree, comm: CommunicatorBase):
+def _constrain(tree, comm: CommunicatorBase, axis: Optional[str] = None):
     """with_sharding_constraint to the FSDP layout (traced-side: shapes are
     static, so the same shape rule applies)."""
     mesh = comm.mesh
     return jax.tree_util.tree_map(
         lambda l, s: jax.lax.with_sharding_constraint(l, NamedSharding(mesh, s)),
         tree,
-        fsdp_spec(tree, comm),
+        fsdp_spec(tree, comm, axis),
     )
 
 
@@ -115,13 +132,18 @@ def jit_fsdp_train_step(
     donate: bool = True,
     train_kwargs: Optional[dict] = None,
     label_smoothing: float = 0.0,
+    axis: Optional[str] = None,
 ) -> Callable:
     """The FSDP classification train step (same call shape as
     ``jit_train_step``): ``step(variables, opt_state, images, labels)``.
 
-    ``variables``/``opt_state`` must be placed with :func:`fsdp_shard`; the
-    batch is global (leading axis = global batch) and is constrained onto the
-    mesh inside the program, so callers may pass ordinary host arrays.
+    ``variables``/``opt_state`` must be placed with :func:`fsdp_shard` (same
+    ``axis``); the batch is global (leading axis = global batch) and is
+    constrained onto the mesh inside the program, so callers may pass
+    ordinary host arrays. On a hierarchical communicator, ``axis`` picks the
+    scatter level (HSDP — see :func:`_shard_axis`): the batch still shards
+    over ALL mesh axes, so the partitioner emits intra-domain all_gathers
+    for the weights and a cross-domain gradient all-reduce.
 
     Unlike ``jit_train_step`` this is NOT a ``shard_map`` program: there is no
     per-rank body and no explicit gradient collective — one global program,
@@ -133,7 +155,7 @@ def jit_fsdp_train_step(
     the communicator carries a wire dtype so the setting never goes silently
     unused.
     """
-    _check_flat(comm)
+    _shard_axis(comm, axis)
     if getattr(comm, "allreduce_grad_dtype", None) is not None:
         import warnings
 
@@ -165,14 +187,14 @@ def jit_fsdp_train_step(
         (loss, updated), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         # keep the gradients scattered (this is what makes the backward's
         # cross-device reduction a reduce_scatter rather than an all-reduce)
-        grads = _constrain(grads, comm)
+        grads = _constrain(grads, comm, axis)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         # pin the updated state back to the at-rest layout so donation reuses
         # the input buffers and nothing silently re-replicates
-        params = _constrain(params, comm)
-        opt_state = _constrain(opt_state, comm)
-        new_variables = {"params": params, **_constrain(updated, comm)}
+        params = _constrain(params, comm, axis)
+        opt_state = _constrain(opt_state, comm, axis)
+        new_variables = {"params": params, **_constrain(updated, comm, axis)}
         return new_variables, opt_state, loss
 
     donate_argnums = (0, 1) if donate else ()

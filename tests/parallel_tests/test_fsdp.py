@@ -160,9 +160,50 @@ def test_fsdp_state_roundtrips_through_sharded_checkpointer(comm, tmp_path):
             a.sharding, b.sharding)
 
 
-def test_fsdp_rejects_hierarchical(comm):
+def test_hsdp_over_hierarchical_mesh(comm):
+    """HSDP: scatter weights over the intra (fast/ICI) axis only, replicate
+    across inter — per-device shard = 1/n_intra, numerics match the flat
+    replicated baseline (BN-free model), batch sharded over both axes."""
+    hier = chainermn_tpu.create_communicator("hierarchical")
+    axes = hier.axis_name
+    if isinstance(axes, str):
+        pytest.skip("hierarchical comm degenerated to one axis on this host")
+    inter, intra = axes
+    n_intra = hier.mesh.shape[intra]
+    model, variables = _init(comm)
+    opt = optax.adam(1e-2)
+    hs_vars = fsdp_shard(variables, hier, axis=intra)
+    hs_state = fsdp_shard(jax.jit(opt.init)(hs_vars["params"]), hier,
+                          axis=intra)
+    # per-device at-rest bytes = 1/n_intra for shardable leaves
+    big = [l for l in jax.tree_util.tree_leaves(hs_vars["params"])
+           if any(d % n_intra == 0 for d in l.shape) and l.size >= n_intra]
+    assert big and all(
+        l.addressable_shards[0].data.size / l.size == 1 / n_intra for l in big
+    )
+
+    rng = np.random.RandomState(2)
+    images = jnp.asarray(rng.randn(2 * comm.size, 12), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 10, (2 * comm.size,)), jnp.int32)
+    hs_step = jit_fsdp_train_step(model, opt, hier, donate=False, axis=intra)
+
+    # flat-FSDP baseline on the same data: same global program semantics
+    fs_vars = fsdp_shard(variables, comm)
+    fs_state = fsdp_shard(jax.jit(opt.init)(fs_vars["params"]), comm)
+    fs_step = jit_fsdp_train_step(model, opt, comm, donate=False)
+    for _ in range(3):
+        hs_vars, hs_state, hs_loss = hs_step(hs_vars, hs_state, images, labels)
+        fs_vars, fs_state, fs_loss = fs_step(fs_vars, fs_state, images, labels)
+    np.testing.assert_allclose(float(hs_loss), float(fs_loss), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(hs_vars["params"]),
+                    jax.tree_util.tree_leaves(fs_vars["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_fsdp_hierarchical_requires_axis(comm):
     hier = chainermn_tpu.create_communicator("hierarchical")
     if isinstance(hier.axis_name, str):
         pytest.skip("hierarchical comm degenerated to one axis on this host")
-    with pytest.raises(ValueError, match="flat single-axis"):
+    with pytest.raises(ValueError, match="pass axis="):
         fsdp_spec({"w": jnp.zeros((8, 8))}, hier)
