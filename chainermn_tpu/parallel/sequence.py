@@ -92,9 +92,15 @@ def ring_attention(
         scale = d ** -0.5
 
     q32 = q.astype(jnp.float32)
-    # mark the accumulators as per-device state (varying over the ring axis);
-    # without it the fori_loop carry's replicated-ness changes across steps
-    _vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    # mark the accumulators as per-device state; without it the fori_loop
+    # carry's replicated-ness changes across steps. Vary over the RING axis
+    # plus every axis the inputs already vary on (under TP composition the
+    # q/k/v carry the tensor axis's vma too; a ring-axis-only pcast would
+    # make the carry types diverge after one iteration). With check_vma off
+    # the vma sets are empty and this degenerates to the ring axis alone.
+    vma = (frozenset({axis_name}) | jax.typeof(q).vma
+           | jax.typeof(k).vma | jax.typeof(v).vma)
+    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
     m0 = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
     l0 = _vary(jnp.zeros((b, h, t), jnp.float32))
     o0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))

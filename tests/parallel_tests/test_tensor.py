@@ -235,6 +235,57 @@ def test_tp_lm_vocab_parallel_head_trains(comm):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.parametrize("sp_kind", ["ring", "ulysses"])
+def test_tp_attention_composes_with_sp(comm, sp_kind):
+    """The docstring claim that TP (heads over one axis) composes with
+    sequence parallelism (sequence over another): on the hierarchical
+    (inter x intra) mesh, heads shard over intra and the sequence over
+    inter; output must match serial full attention with the same weights.
+    (Ulysses additionally needs local_heads divisible by the sp size.)"""
+    hier = chainermn_tpu.create_communicator("hierarchical")
+    axes = hier.axis_name
+    if isinstance(axes, str):
+        pytest.skip("hierarchical comm degenerated to one axis")
+    sp_axis, tp_axis = axes  # sequence over inter, heads over intra
+    n_sp = hier.mesh.shape[sp_axis]
+    n_tp = hier.mesh.shape[tp_axis]
+    d_model, n_heads, b = 32, 8, 2
+    t = 4 * n_sp  # global sequence, shards 4 tokens per sp rank
+    assert n_heads % n_tp == 0
+    if sp_kind == "ulysses" and (n_heads // n_tp) % n_sp:
+        pytest.skip("ulysses needs local_heads divisible by sp size")
+    attn = TensorParallelAttention(
+        d_model=d_model, n_heads=n_heads, axis_name=tp_axis, causal=True,
+        attention=sp_kind, sequence_axis=sp_axis,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(30), (b, t, d_model))
+
+    # init under the mesh on one sequence shard (collectives inside)
+    params = jax.jit(hier.shard_map(
+        lambda xx: attn.init(jax.random.PRNGKey(31), xx),
+        in_specs=P(None, sp_axis), out_specs=P(),
+    ))(x)
+    got = jax.jit(hier.shard_map(
+        lambda p, xx: attn.apply(p, xx),
+        in_specs=(P(), P(None, sp_axis)), out_specs=P(None, sp_axis),
+    ))(params, x)
+
+    # serial reference: same (rank, 3, local_head, d_head)-major layout
+    d_head, local_h = d_model // n_heads, n_heads // n_tp
+    qkv_k = params["params"]["qkv_tpcol"]["kernel"]
+    qkv_b = params["params"]["qkv_tpcol"]["bias"]
+    qkv = (x @ qkv_k + qkv_b).reshape(b, t, n_tp, 3, local_h, d_head)
+    q = qkv[:, :, :, 0].reshape(b, t, n_heads, d_head)
+    k = qkv[:, :, :, 1].reshape(b, t, n_heads, d_head)
+    v = qkv[:, :, :, 2].reshape(b, t, n_heads, d_head)
+    o = full_attention(q, k, v, causal=True)
+    proj_k = params["params"]["proj_tprow"]["kernel"]
+    proj_b = params["params"]["proj_tprow"]["bias"]
+    want = o.reshape(b, t, d_model) @ proj_k + proj_b
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_global_objective_rejects_vma_off(comm):
     """Under check_vma=False no pmean would ever fire and the pattern's
     grads would be silently wrong — it must raise instead."""
