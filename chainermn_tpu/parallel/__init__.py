@@ -3,6 +3,7 @@ from chainermn_tpu.parallel.mesh import (
     INTER_AXIS,
     INTRA_AXIS,
     RankGeometry,
+    make_3d_mesh,
     make_hierarchical_mesh,
     make_mesh,
 )
@@ -32,6 +33,7 @@ __all__ = [
     "RankGeometry",
     "make_mesh",
     "make_hierarchical_mesh",
+    "make_3d_mesh",
     "ExpertParallelMLP",
     "fsdp_shard",
     "fsdp_spec",

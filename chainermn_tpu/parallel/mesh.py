@@ -83,6 +83,48 @@ def make_hierarchical_mesh(
     return Mesh(arr, (inter_axis, intra_axis))
 
 
+def make_3d_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    dp_axis: str = "dp",
+    sp_axis: str = "sp",
+    tp_axis: str = "tp",
+    shape: tuple[int, int, int] | None = None,
+) -> Mesh:
+    """Three-level ``dp x sp x tp`` mesh for hybrid data x sequence x tensor
+    parallel training (extension beyond the reference's two-level split).
+
+    ``tp`` is the innermost axis: device order is (process, id)-sorted, so
+    the innermost axis spans ICI-nearest neighbors — the right place for
+    tensor parallelism's per-block psums, with sequence-parallel ring hops
+    one level out and the data-parallel gradient reduction outermost.
+    Without ``shape``, the device count is factored into the most balanced
+    (dp, sp, tp) triple — which is process-oblivious: on a MULTI-HOST pod
+    pass ``shape`` explicitly with ``tp`` (x ``sp``) dividing the
+    per-process device count, or the innermost axes can straddle hosts and
+    the per-block psums ride DCN (make_hierarchical_mesh aligns to process
+    boundaries automatically; this heuristic does not).
+    """
+    devs = _sorted_devices(devices)
+    n = len(devs)
+    if shape is None:
+        best: tuple[int, int, int] = (1, 1, n)
+        for a in range(1, n + 1):
+            if n % a:
+                continue
+            m = n // a
+            for b in range(1, m + 1):
+                if m % b:
+                    continue
+                cand = (a, b, m // b)
+                if max(cand) - min(cand) < max(best) - min(best):
+                    best = cand
+        shape = best
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"shape {shape} does not cover {n} devices")
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, (dp_axis, sp_axis, tp_axis))
+
+
 @dataclasses.dataclass(frozen=True)
 class RankGeometry:
     """Host-side rank geometry, ChainerMN-shaped (``[U] _communication_utility.

@@ -161,9 +161,13 @@ def _jit_tp_lm_train_step(
     the grads arriving at it are already the global gradient, and a
     multi-node wrapper's extra mean would shrink them by the axis size.
 
-    The batch shards over every communicator axis EXCEPT ``tensor_axis``
-    (pure TP on a flat comm = replicated batch; a hierarchical comm gives
-    dp x tp with dp on the other axis).
+    The batch shards over every communicator axis EXCEPT ``tensor_axis`` and
+    the model's ``sequence_axis`` (pure TP on a flat comm = replicated
+    batch; a hierarchical comm gives dp x tp). A model built with BOTH
+    ``tensor_axis`` and a distinct ``sequence_axis`` (``attention='ring'|
+    'ulysses'``) over a 3-axis mesh gives full **dp x sp x tp**: the
+    sequence dimension shards over ``sequence_axis`` and each shard's
+    ``pos_offset`` is threaded automatically.
     """
     from chainermn_tpu.parallel.tensor import global_objective
 
@@ -174,11 +178,35 @@ def _jit_tp_lm_train_step(
             f"model.tensor_axis={tensor_axis!r} is not one of the "
             f"communicator's mesh axes {axes}"
         )
-    if shard_sequence or getattr(model, "sequence_axis", None) is not None:
+    seq_axis = getattr(model, "sequence_axis", None)
+    if shard_sequence and seq_axis is None:
         raise ValueError(
-            "the TP step shards batch over the non-tensor axes; combine "
-            "tensor_axis with sequence_axis at the module level "
-            "(TensorParallelAttention) over a mesh with a third axis instead"
+            "shard_sequence=True with a TP model needs the model built with "
+            "sequence_axis (and attention='ring'|'ulysses')"
+        )
+    if seq_axis is not None and (seq_axis == tensor_axis
+                                 or seq_axis not in axes):
+        raise ValueError(
+            f"model.sequence_axis={seq_axis!r} must be a mesh axis distinct "
+            f"from tensor_axis={tensor_axis!r} (mesh axes {axes})"
+        )
+    if seq_axis is not None and not shard_sequence:
+        # mirror the dense path: a sequence_axis model under this step WILL
+        # have its sequence sharded — a caller asking for shard_sequence=
+        # False must not silently get sequence sharding anyway
+        raise ValueError(
+            f"model has sequence_axis={seq_axis!r}: the TP step shards the "
+            "sequence over it — pass shard_sequence=True (or build the "
+            "model without sequence_axis for batch-only sharding)"
+        )
+    if seq_axis is not None and getattr(model, "attention", None) not in (
+            "ring", "ulysses"):
+        # 'full' under a sharded sequence silently computes block-diagonal
+        # attention (each shard attends within its own chunk only)
+        raise ValueError(
+            f"sequence_axis={seq_axis!r} needs attention='ring'|'ulysses'; "
+            f"got {getattr(model, 'attention', None)!r} — plain 'full' "
+            "would attend within each sequence shard only"
         )
     if (getattr(model, "attention", None) == "flash"
             and jax.default_backend() != "tpu"):
@@ -191,13 +219,16 @@ def _jit_tp_lm_train_step(
             "check_vma=False would break the global-objective gradient "
             "pattern — use attention='full' off-TPU"
         )
-    dp_axes = tuple(a for a in axes if a != tensor_axis)
+    dp_axes = tuple(a for a in axes if a != tensor_axis and a != seq_axis)
 
     vocab_parallel = getattr(model, "vocab_parallel_head", False)
 
     def body(params, opt_state, tokens, targets):
+        pos_offset = (jax.lax.axis_index(seq_axis) * tokens.shape[1]
+                      if seq_axis is not None else 0)
+
         def loss_fn(p):
-            logits = model.apply(p, tokens, 0)
+            logits = model.apply(p, tokens, pos_offset)
             if vocab_parallel:
                 from chainermn_tpu.parallel.tensor import (
                     vocab_parallel_cross_entropy,
@@ -217,7 +248,9 @@ def _jit_tp_lm_train_step(
         params = optax.apply_updates(params, updates)
         return params, new_opt_state, loss
 
-    data = P(dp_axes) if dp_axes else P()
+    # batch dim over the dp axes, sequence dim over the model's seq axis
+    data = P(dp_axes if dp_axes else None,
+             seq_axis if seq_axis is not None else None)
     sm = comm.shard_map(
         body,
         in_specs=(P(), P(), data, data),

@@ -286,6 +286,46 @@ def test_tp_attention_composes_with_sp(comm, sp_kind):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_3d_dp_sp_tp_lm_trains(comm):
+    """Full hybrid: dp x sp x tp over a (2,2,2) mesh — TransformerLM with
+    ring attention over sp, Megatron blocks + vocab-parallel head over tp,
+    batch over dp. Dispatched through the public jit_lm_train_step."""
+    import optax
+
+    from chainermn_tpu.communicators import MeshCommunicator
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.parallel import make_3d_mesh
+    from chainermn_tpu.training import jit_lm_train_step
+
+    mesh = make_3d_mesh()
+    if 1 in mesh.shape.values():
+        pytest.skip("needs a genuine 3-way factorization of the device count")
+    c3 = MeshCommunicator(mesh=mesh)
+    n_dp, n_sp, n_tp = (mesh.shape[a] for a in ("dp", "sp", "tp"))
+    if 8 % n_tp:
+        pytest.skip(f"8 heads not divisible by tp={n_tp}")
+    lm = TransformerLM(
+        vocab_size=16 * n_tp, d_model=16, n_heads=8, n_layers=1, max_len=128,
+        attention="ring", sequence_axis="sp", tensor_axis="tp",
+        vocab_parallel_head=True, compute_dtype=jnp.float32,
+    )
+    b, t_local = 2 * n_dp, 6  # global seq = t_local * n_sp
+    tokens = jax.random.randint(jax.random.PRNGKey(40),
+                                (b, t_local * n_sp), 0, 16 * n_tp)
+    params = jax.jit(c3.shard_map(
+        lambda tt: lm.init(jax.random.PRNGKey(41), tt),
+        in_specs=P("dp", "sp"), out_specs=P(),
+    ))(tokens)
+    opt = optax.adam(1e-2)
+    state = jax.jit(opt.init)(params)
+    step = jit_lm_train_step(lm, opt, c3, shard_sequence=True, donate=False)
+    losses = []
+    for _ in range(5):
+        params, state, lval = step(params, state, tokens, tokens)
+        losses.append(float(lval))
+    assert losses[-1] < losses[0], losses
+
+
 def test_global_objective_rejects_vma_off(comm):
     """Under check_vma=False no pmean would ever fire and the pattern's
     grads would be silently wrong — it must raise instead."""
@@ -309,6 +349,31 @@ def test_tp_lm_rejects_flash_off_tpu(comm):
                        tensor_axis=comm.axis_name, attention="flash")
     with pytest.raises(ValueError, match="flash"):
         jit_lm_train_step(lm, optax.sgd(0.1), comm)
+
+
+def test_tp_lm_rejects_full_attention_with_sequence_axis(comm):
+    """'full' under a sharded sequence would silently compute block-diagonal
+    attention — must be rejected, like the dense path does."""
+    import optax
+
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.training import jit_lm_train_step
+
+    hier = chainermn_tpu.create_communicator("hierarchical")
+    axes = hier.axis_name
+    if isinstance(axes, str):
+        pytest.skip("hierarchical comm degenerated to one axis")
+    sp_axis, tp_axis = axes
+    lm = TransformerLM(vocab_size=16, d_model=16, n_heads=8, n_layers=1,
+                       tensor_axis=tp_axis, sequence_axis=sp_axis)
+    with pytest.raises(ValueError, match="ring"):
+        jit_lm_train_step(lm, optax.sgd(0.1), hier, shard_sequence=True)
+    # and shard_sequence=False must not silently shard the sequence anyway
+    lm_ring = TransformerLM(vocab_size=16, d_model=16, n_heads=8, n_layers=1,
+                            attention="ring", tensor_axis=tp_axis,
+                            sequence_axis=sp_axis)
+    with pytest.raises(ValueError, match="shard_sequence=True"):
+        jit_lm_train_step(lm_ring, optax.sgd(0.1), hier, shard_sequence=False)
 
 
 def test_tp_lm_rejects_foreign_axis(comm):
