@@ -8,7 +8,11 @@ from chainermn_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
-from chainermn_tpu.models.transformer import TransformerBlock, TransformerLM
+from chainermn_tpu.models.transformer import (
+    TransformerBlock,
+    TransformerLM,
+    generate,
+)
 from chainermn_tpu.models.vision import GoogLeNet, InceptionBlock, VGG16
 
 __all__ = [
@@ -25,4 +29,5 @@ __all__ = [
     "VGG16",
     "TransformerBlock",
     "TransformerLM",
+    "generate",
 ]

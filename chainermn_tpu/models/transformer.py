@@ -18,8 +18,12 @@ from __future__ import annotations
 
 from typing import Optional
 
+import functools
+
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from chainermn_tpu.parallel.moe import ExpertParallelMLP
 from chainermn_tpu.parallel.sequence import sequence_parallel_attention
@@ -179,3 +183,80 @@ class TransformerLM(nn.Module):
         if return_aux:
             return logits, aux_total
         return logits
+
+
+def generate(
+    model: TransformerLM,
+    params,
+    prompt,
+    n_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng=None,
+):
+    """Autoregressive decoding for :class:`TransformerLM` (inference utility
+    beyond the reference, which has no generation loop; completes the LM
+    family's user surface).
+
+    ``prompt [B, T0]`` ints; returns ``[B, T0 + n_tokens]``. ``temperature=0``
+    is greedy (deterministic); otherwise softmax sampling at the given
+    temperature with ``rng``. The decode loop is a jitted ``lax.scan`` over a
+    fixed ``T0 + n_tokens`` buffer, cached per (model, shapes, temperature) —
+    repeat calls with the same shapes reuse the compile. Each step re-runs
+    the full forward on the buffer (no KV cache: simple, correct, static
+    shapes); causal attention makes positions past the current length
+    irrelevant to the sampled token. Single-device / replicated-params only:
+    the parallel training layouts (tensor_axis, sequence_axis, moe_axis)
+    trace collectives that need a mesh context — rebuild a plain model for
+    inference, or run inside an equivalent shard_map.
+    """
+    if (model.tensor_axis is not None or model.sequence_axis is not None
+            or model.moe_experts):
+        raise ValueError(
+            "generate() runs outside a mesh: rebuild the model without "
+            "tensor_axis/sequence_axis/moe_experts (attention='full') "
+            "for inference"
+        )
+    if temperature and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    b, t0 = prompt.shape
+    if t0 + n_tokens > model.max_len:
+        raise ValueError(
+            f"{t0 + n_tokens} tokens exceed max_len={model.max_len}"
+        )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    run = _generate_fn(model, int(n_tokens), float(temperature), b, int(t0),
+                       jnp.dtype(prompt.dtype).name)
+    return run(params, prompt, rng)
+
+
+@functools.lru_cache(maxsize=32)
+def _generate_fn(model, n_tokens, temperature, b, t0, dtype_name):
+    """One compiled decode program per (model, shape, temperature) key —
+    flax modules are frozen/hashable, so they key an lru_cache directly."""
+    total = t0 + n_tokens
+    dtype = jnp.dtype(dtype_name)
+
+    @jax.jit
+    def run(params, prompt, rng):
+        buf = jnp.zeros((b, total), dtype).at[:, :t0].set(prompt)
+
+        def step(carry, i):
+            buf, key = carry
+            logits = model.apply(params, buf)      # [B, total, V]
+            # the token at position i is predicted from the logits at i-1
+            nxt_logits = lax.dynamic_slice_in_dim(logits, i - 1, 1, axis=1)[:, 0]
+            key, sub = jax.random.split(key)
+            if temperature:
+                nxt = jax.random.categorical(
+                    sub, nxt_logits / temperature, axis=-1
+                )
+            else:
+                nxt = jnp.argmax(nxt_logits, axis=-1)
+            buf = buf.at[:, i].set(nxt.astype(buf.dtype))
+            return (buf, key), None
+
+        (out, _), _ = lax.scan(step, (buf, rng), jnp.arange(t0, total))
+        return out
+
+    return run
