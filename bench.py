@@ -32,6 +32,7 @@ synthetic data — this measures the training step, not input pipelines.
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -68,6 +69,46 @@ _RETRYABLE = (
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+# Scratch file where the child persists every record the moment it exists.
+# Survives abandoned pipes, SIGKILLed children, and the driver's process-tree
+# kill: whatever measurement was ever completed can be salvaged by the parent
+# (or by a later attempt) instead of being re-earned or lost.
+def _scratch_path() -> str:
+    # Default is scoped by pid — the parent exports its choice to children so
+    # one run shares a file, but concurrent runs (the CI smoke test runs
+    # beside a real-chip bench) never cross-contaminate or unlink each
+    # other's salvage.
+    return os.environ.get(
+        "CHAINERMN_TPU_BENCH_SCRATCH",
+        f"/tmp/chainermn_tpu_bench_scratch_{os.getpid()}.jsonl",
+    )
+
+
+def _scratch_write(record: dict) -> None:
+    try:
+        with open(_scratch_path(), "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError as e:
+        log(f"scratch write failed: {e}")
+
+
+def _scratch_salvage() -> dict | None:
+    """Last parseable *measurement* record from the scratch file, if any."""
+    try:
+        with open(_scratch_path()) as f:
+            lines = f.read().strip().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and rec.get("metric") and rec.get("value"):
+            return rec
+    return None
 
 
 def _chip_peak(device_kind: str):
@@ -168,8 +209,6 @@ _SWEEP_GRID = [
 
 
 def child_main() -> None:
-    import signal
-
     # Python's default SIGTERM disposition is immediate kernel termination —
     # no stack unwind, no PJRT client teardown, so the parent's TERM-first
     # escalation would release nothing. Raise SystemExit instead so the
@@ -266,7 +305,10 @@ def child_main() -> None:
     # A measurement in hand must survive a sweep overrun: emit the headline
     # record NOW (the parent salvages the last parseable line on child
     # timeout), then again with the sweep attached on normal completion.
+    # Also persist it to the scratch file — stdout pipes die with the
+    # process tree; the file does not.
     print(json.dumps(record), flush=True)
+    _scratch_write(record)
 
     # ---- strategy x double-buffering sweep (BASELINE.md metric 2) -------- #
     sweep = []
@@ -324,6 +366,20 @@ def child_main() -> None:
             record["double_buffering_speedup"] = round(base / db, 4)
 
     print(json.dumps(record))
+    _scratch_write(record)
+
+
+def _failure_record(err_class: str, detail: str, attempts_run: int) -> dict:
+    return {
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": err_class,
+        "detail": detail[-500:],
+        "attempts": attempts_run,
+        "device_kind": None,
+    }
 
 
 def parent_main() -> None:
@@ -333,15 +389,69 @@ def parent_main() -> None:
     # would otherwise make the whole bench silently exceed the driver's
     # budget with no JSON emitted. Timeout covers init + compiles + steps
     # (the sweep's per-child budget is CHAINERMN_TPU_BENCH_CHILD_BUDGET).
-    attempt_timeout = float(os.environ.get("CHAINERMN_TPU_BENCH_TIMEOUT", "1800"))
+    # Defaults deliberately fit well inside the driver's window: round 3's
+    # 1800s/attempt + 3600s total outlived it (rc=124, no record). A hung
+    # backend that doesn't come up within ~12min per attempt won't come up
+    # at 30min either.
+    attempt_timeout = float(os.environ.get("CHAINERMN_TPU_BENCH_TIMEOUT", "720"))
     # And a TOTAL cap: a wedged single-tenant tunnel (PERF.md hazard #2)
-    # hangs every attempt — 5 x 1800s of retries would outlive any driver
+    # hangs every attempt — unlimited retries would outlive any driver
     # budget and still emit nothing. Stop retrying once the cumulative spend
     # passes the total budget and emit the failure record instead.
-    total_budget = float(os.environ.get("CHAINERMN_TPU_BENCH_TOTAL_BUDGET", "3600"))
+    total_budget = float(os.environ.get("CHAINERMN_TPU_BENCH_TOTAL_BUDGET", "1500"))
     t_start = time.time()
     last_tail = ""
     attempts_run = 0
+
+    # Pin the scratch path now and export it so every child of THIS run
+    # writes where this parent salvages (see _scratch_path: the pid-scoped
+    # default would otherwise differ between parent and child).
+    os.environ["CHAINERMN_TPU_BENCH_SCRATCH"] = _scratch_path()
+    # Start each run with a clean scratch file: a stale record from an
+    # earlier round must never be salvaged as this run's measurement.
+    try:
+        os.unlink(_scratch_path())
+    except OSError:
+        pass
+
+    # THE un-losable guarantee: if the driver starts tearing us down
+    # (`timeout` sends SIGTERM first), emit the best record we have — a
+    # salvaged child measurement beats a failure record beats nothing —
+    # *before* the follow-up SIGKILL lands. Budgets above are the first
+    # line of defense; this handler is the backstop that round 3 lacked.
+    child_box: list = [None]
+
+    def _on_term(signum, frame):
+        # Raw os.write only: the signal may land while the main thread is
+        # inside the SAME buffered writer (e.g. forwarding child stderr) and
+        # a buffered print() here would raise "reentrant call inside
+        # BufferedWriter", killing the backstop before it emits anything.
+        os.write(2, f"parent received signal {signum}; emitting record\n".encode())
+        salvaged = _scratch_salvage()
+        if salvaged is not None:
+            salvaged["salvaged_on_signal"] = signum
+            os.write(1, (json.dumps(salvaged) + "\n").encode())
+        else:
+            rec = _failure_record(
+                "SIGTERM" if signum == signal.SIGTERM else f"signal {signum}",
+                last_tail or "driver killed bench before any measurement",
+                attempts_run,
+            )
+            os.write(1, (json.dumps(rec) + "\n").encode())
+        child = child_box[0]
+        if child is not None and child.poll() is None:
+            # Best effort: let the child unwind so the device grant is
+            # released (a SIGKILLed lease wedges the single-tenant tunnel,
+            # PERF.md hazard #2). The driver's SIGKILL may cut this short.
+            child.terminate()
+            try:
+                child.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                pass
+        os._exit(0 if salvaged is not None else 1)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
     for i in range(1, attempts + 1):
         remaining = total_budget - (time.time() - t_start)
         if remaining <= 60:
@@ -357,6 +467,7 @@ def parent_main() -> None:
             stderr=subprocess.PIPE,
             text=True,
         )
+        child_box[0] = popen
         try:
             stdout_txt, stderr_txt = popen.communicate(timeout=attempt_timeout)
             proc = subprocess.CompletedProcess(
@@ -423,20 +534,18 @@ def parent_main() -> None:
         if will_retry:
             time.sleep(delay)
             delay = min(delay * 2, 120.0)
+    # All attempts exhausted. A partial measurement any child persisted to
+    # scratch (e.g. headline landed, then the sweep hung) still counts.
+    salvaged = _scratch_salvage()
+    if salvaged is not None:
+        salvaged["salvaged_after_failure"] = True
+        print(json.dumps(salvaged))
+        return
     # Final failure: one parseable JSON record, not a stack trace.
     err_class = next(
         (s for s in _RETRYABLE + ("TimeoutExpired",) if s in last_tail), "unknown"
     )
-    print(json.dumps({
-        "metric": "resnet50_imagenet_train_throughput",
-        "value": None,
-        "unit": "images/sec/chip",
-        "vs_baseline": None,
-        "error": err_class,
-        "detail": last_tail[-500:],
-        "attempts": attempts_run,
-        "device_kind": None,
-    }))
+    print(json.dumps(_failure_record(err_class, last_tail, attempts_run)))
     raise SystemExit(1)
 
 
