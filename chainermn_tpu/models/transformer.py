@@ -6,9 +6,9 @@ exercises sequence parallelism end to end. Design notes:
 
 - layout ``[batch, seq, heads, head_dim]``; params f32, compute bf16 by
   default (casts fuse into the MXU matmuls);
-- attention is pluggable (``'full' | 'ring' | 'ulysses'`` from
-  :mod:`chainermn_tpu.parallel.sequence`) so the same module runs
-  single-chip or sequence-sharded inside ``comm.shard_map`` with the
+- attention is pluggable (``'full' | 'ring' | 'zigzag' | 'ulysses' |
+  'flash'`` from :mod:`chainermn_tpu.parallel.sequence`) so the same module
+  runs single-chip or sequence-sharded inside ``comm.shard_map`` with the
   sequence axis in the batch ``PartitionSpec``;
 - static shapes, ``nn.scan``-free explicit layer stack (layer count is a
   Python constant — XLA sees a straight-line program it can pipeline).
@@ -108,7 +108,10 @@ class TransformerLM(nn.Module):
     """Decoder-only LM. ``__call__(tokens[B, T_local], pos_offset)`` ->
     logits ``[B, T_local, vocab]``; when sequence-sharded, ``pos_offset`` is
     each shard's global position base (pass ``axis_index * T_local`` inside
-    the traced step)."""
+    the traced step) — EXCEPT under ``attention='zigzag'``, whose shards are
+    not contiguous: pass the full ``[T_local]`` position vector from
+    :func:`~chainermn_tpu.parallel.sequence.zigzag_positions` instead
+    (``training._shard_positions`` picks the right form automatically)."""
 
     vocab_size: int
     d_model: int = 512
@@ -150,7 +153,13 @@ class TransformerLM(nn.Module):
         d_ff = self.d_ff or 4 * self.d_model
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
-        pos = pos_offset + jnp.arange(tokens.shape[1])
+        # pos_offset: scalar base (contiguous shard) OR a [T_local] vector of
+        # explicit global positions (zigzag layout — each shard holds one
+        # early and one late chunk, so its positions are not contiguous)
+        if jnp.ndim(pos_offset) == 0:
+            pos = pos_offset + jnp.arange(tokens.shape[1])
+        else:
+            pos = pos_offset
         x = x + nn.Embed(self.max_len, self.d_model,
                          dtype=self.compute_dtype, name="pos_embed")(pos)[None]
         aux_total = jnp.float32(0.0)

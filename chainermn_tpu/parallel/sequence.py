@@ -69,6 +69,7 @@ def ring_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    skip_masked_blocks: bool = True,
 ):
     """Exact attention over a sequence sharded along ``axis_name``.
 
@@ -113,9 +114,33 @@ def ring_attention(
         if causal:
             k_pos = src * t + jnp.arange(t)
             mask = q_pos[:, None] >= k_pos[None, :]
+            # Blocks from the future (src > my) are fully masked — skip the
+            # einsums entirely instead of computing and discarding them.
+            # NOTE this halves per-rank FLOPs but NOT wall-clock: the ring
+            # barriers every step, so lockstep time is set by the busiest
+            # rank (rank n-1 computes every step). zigzag_ring_attention
+            # fixes the imbalance itself; this cond still saves energy and
+            # helps when ranks aren't lockstep (e.g. CPU testing).
+            # skip_masked_blocks=False keeps the round-3 compute-everything
+            # behavior (benchmark baseline).
+            if skip_masked_blocks:
+                m, l, o = lax.cond(
+                    src <= my,
+                    lambda mlo: _block_attend(
+                        q32, kb, vb, scale=scale, mask=mask,
+                        m=mlo[0], l=mlo[1], o=mlo[2]
+                    ),
+                    lambda mlo: mlo,
+                    (m, l, o),
+                )
+            else:
+                m, l, o = _block_attend(
+                    q32, kb, vb, scale=scale, mask=mask, m=m, l=l, o=o
+                )
         else:
-            mask = None
-        m, l, o = _block_attend(q32, kb, vb, scale=scale, mask=mask, m=m, l=l, o=o)
+            m, l, o = _block_attend(
+                q32, kb, vb, scale=scale, mask=None, m=m, l=l, o=o
+            )
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
         return m, l, o, kb, vb
@@ -126,6 +151,143 @@ def ring_attention(
     m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
     # rows with no visible keys (never happens for causal with aligned
     # blocks, but keep the division safe)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def zigzag_permutation(t_global: int, n_shards: int):
+    """Sequence permutation for the zigzag (striped-block) layout.
+
+    The global sequence is split into ``2n`` chunks; shard ``i`` holds chunks
+    ``(i, 2n-1-i)`` — one early and one late chunk — so each rank's causal
+    workload is equal (the contiguous layout gives rank 0 one visible block
+    and rank n-1 all n: the classic ring-attention imbalance).
+
+    Returns an index array ``perm`` of length ``t_global`` such that
+    ``x[:, perm]`` laid out contiguously over ``n_shards`` gives every shard
+    its zigzag chunk pair. Apply the SAME permutation to tokens and targets
+    (next-token pairing is preserved; a mean loss over tokens is
+    permutation-invariant, so training needs no unpermute). Invert for
+    outputs with ``jnp.argsort(perm)``.
+    """
+    if t_global % (2 * n_shards):
+        raise ValueError(
+            f"sequence length {t_global} must divide into 2*{n_shards} chunks"
+        )
+    c = t_global // (2 * n_shards)
+    idx = []
+    for i in range(n_shards):
+        idx.append(jnp.arange(i * c, (i + 1) * c))
+        j = 2 * n_shards - 1 - i
+        idx.append(jnp.arange(j * c, (j + 1) * c))
+    return jnp.concatenate(idx)
+
+
+def zigzag_positions(rank, n_shards: int, t_local: int):
+    """Global positions of shard ``rank``'s tokens under the zigzag layout
+    (``rank`` may be traced, e.g. ``lax.axis_index``). Shape ``[t_local]`` —
+    feed to position embeddings in place of the contiguous
+    ``offset + arange`` base."""
+    c = t_local // 2
+    early = rank * c + jnp.arange(c)
+    late = (2 * n_shards - 1 - rank) * c + jnp.arange(c)
+    return jnp.concatenate([early, late])
+
+
+def zigzag_ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """Causal ring attention over a **zigzag-sharded** sequence — the
+    load-balanced form of :func:`ring_attention`.
+
+    Each shard holds the chunk pair ``(i, 2n-1-i)`` of a ``2n``-chunk global
+    sequence (lay data out with :func:`zigzag_permutation`). Per ring step
+    every rank then does the SAME useful work — exactly half the chunk-pair
+    interactions are visible, and they are computed without masks:
+
+    - block from an earlier rank (``src < my``): all local queries attend the
+      block's early chunk only (its late chunk is entirely in the future);
+    - block from a later rank (``src > my``): only the local late chunk
+      attends, but it sees the whole block;
+    - the local (diagonal) block needs the one genuinely masked update.
+
+    Total FLOPs are ~half of contiguous causal ring (which computes every
+    masked block) and per-rank work is equal, so the per-step ppermute
+    barrier no longer waits on a straggler. Exact: matches full attention on
+    the unpermuted sequence (tested). Differentiable.
+    """
+    if not causal:
+        # zigzag exists solely to balance the causal mask; unmasked ring
+        # attention is layout-independent
+        return ring_attention(q, k, v, axis_name, causal=False, scale=scale)
+    if not isinstance(axis_name, str):
+        raise ValueError(
+            f"zigzag_ring_attention needs a single named mesh axis, got "
+            f"{axis_name!r}"
+        )
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    if t % 2:
+        raise ValueError(f"local sequence length {t} must be even (chunk pair)")
+    c = t // 2
+    if scale is None:
+        scale = d ** -0.5
+
+    q32 = q.astype(jnp.float32)
+    vma = (frozenset({axis_name}) | jax.typeof(q).vma
+           | jax.typeof(k).vma | jax.typeof(v).vma)
+    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
+    m = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
+    l = _vary(jnp.zeros((b, h, t), jnp.float32))
+    o = _vary(jnp.zeros((b, t, h, d), jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Step 0 — the diagonal block: the one masked update (positions are the
+    # zigzag pair's, not contiguous).
+    pos = zigzag_positions(my, n, t)
+    mask0 = pos[:, None] >= pos[None, :]
+    m, l, o = _block_attend(q32, k, v, scale=scale, mask=mask0, m=m, l=l, o=o)
+    kb = lax.ppermute(k, axis_name, perm)
+    vb = lax.ppermute(v, axis_name, perm)
+
+    def body(step, carry):
+        m, l, o, kb, vb = carry
+
+        # src = (my - step) % n; for step in [1, n) src < my <=> my >= step
+        def from_earlier(mlo):
+            # every local query sees the whole early chunk, nothing of the
+            # late chunk — unmasked [t, c] update
+            return _block_attend(
+                q32, kb[:, :c], vb[:, :c], scale=scale, mask=None,
+                m=mlo[0], l=mlo[1], o=mlo[2]
+            )
+
+        def from_later(mlo):
+            # only the local late chunk attends, and it sees the whole
+            # incoming block — unmasked [c, t] update into rows [c:]
+            m, l, o = mlo
+            m2, l2, o2 = _block_attend(
+                q32[:, c:], kb, vb, scale=scale, mask=None,
+                m=m[:, :, c:], l=l[:, :, c:], o=o[:, c:]
+            )
+            return (jnp.concatenate([m[:, :, :c], m2], axis=2),
+                    jnp.concatenate([l[:, :, :c], l2], axis=2),
+                    jnp.concatenate([o[:, :c], o2], axis=1))
+
+        m, l, o = lax.cond(my >= step, from_earlier, from_later, (m, l, o))
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return m, l, o, kb, vb
+
+    m, l, o, _, _ = lax.fori_loop(1, n, body, (m, l, o, kb, vb))
     l = jnp.where(l == 0.0, 1.0, l)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
@@ -212,11 +374,13 @@ def sequence_parallel_attention(
         return functools.partial(flash_attention, causal=causal, scale=scale)
     if kind == "full" or axis_name is None:
         return functools.partial(full_attention, causal=causal, scale=scale)
-    if kind not in ("ring", "ulysses"):
+    if kind not in ("ring", "zigzag", "ulysses"):
         raise ValueError(
-            f"unknown attention kind {kind!r}; use ring|ulysses|full|flash"
+            f"unknown attention kind {kind!r}; use "
+            "ring|zigzag|ulysses|full|flash"
         )
-    impl = ring_attention if kind == "ring" else ulysses_attention
+    impl = {"ring": ring_attention, "zigzag": zigzag_ring_attention,
+            "ulysses": ulysses_attention}[kind]
 
     def f(q, k, v):
         try:
@@ -224,7 +388,12 @@ def sequence_parallel_attention(
         except NameError:
             # axis not bound: we're outside shard_map (flax init, eval on a
             # gathered sequence) — the whole sequence is local, so exact
-            # full attention IS the correct semantics (params are identical)
+            # full attention IS the correct semantics (params are identical).
+            # CAVEAT for 'zigzag': data fed to the sharded model is
+            # zigzag-PERMUTED; outside the mesh, un-permute it first
+            # (jnp.argsort(zigzag_permutation(...))) or these causal
+            # positions are wrong. Init is value-independent, so module
+            # construction is unaffected.
             return full_attention(q, k, v, causal=causal, scale=scale)
         return impl(q, k, v, axis_name, causal=causal, scale=scale)
 

@@ -142,6 +142,21 @@ def jit_train_step(
     return jax.jit(sm, donate_argnums=donate_argnums)
 
 
+def _shard_positions(model, seq_axis, t_local):
+    """Per-shard global positions under sequence sharding: a scalar base for
+    contiguous layouts, a position VECTOR for zigzag (each shard holds one
+    early + one late chunk; feed data permuted by
+    :func:`~chainermn_tpu.parallel.sequence.zigzag_permutation`)."""
+    if seq_axis is None:
+        return 0
+    idx = jax.lax.axis_index(seq_axis)
+    if getattr(model, "attention", None) == "zigzag":
+        from chainermn_tpu.parallel.sequence import zigzag_positions
+
+        return zigzag_positions(idx, jax.lax.axis_size(seq_axis), t_local)
+    return idx * t_local
+
+
 def _jit_tp_lm_train_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -182,7 +197,7 @@ def _jit_tp_lm_train_step(
     if shard_sequence and seq_axis is None:
         raise ValueError(
             "shard_sequence=True with a TP model needs the model built with "
-            "sequence_axis (and attention='ring'|'ulysses')"
+            "sequence_axis (and attention='ring'|'zigzag'|'ulysses')"
         )
     if seq_axis is not None and (seq_axis == tensor_axis
                                  or seq_axis not in axes):
@@ -200,13 +215,13 @@ def _jit_tp_lm_train_step(
             "model without sequence_axis for batch-only sharding)"
         )
     if seq_axis is not None and getattr(model, "attention", None) not in (
-            "ring", "ulysses"):
+            "ring", "zigzag", "ulysses"):
         # 'full' under a sharded sequence silently computes block-diagonal
         # attention (each shard attends within its own chunk only)
         raise ValueError(
-            f"sequence_axis={seq_axis!r} needs attention='ring'|'ulysses'; "
-            f"got {getattr(model, 'attention', None)!r} — plain 'full' "
-            "would attend within each sequence shard only"
+            f"sequence_axis={seq_axis!r} needs attention='ring'|'zigzag'|"
+            f"'ulysses'; got {getattr(model, 'attention', None)!r} — plain "
+            "'full' would attend within each sequence shard only"
         )
     if (getattr(model, "attention", None) == "flash"
             and jax.default_backend() != "tpu"):
@@ -224,8 +239,7 @@ def _jit_tp_lm_train_step(
     vocab_parallel = getattr(model, "vocab_parallel_head", False)
 
     def body(params, opt_state, tokens, targets):
-        pos_offset = (jax.lax.axis_index(seq_axis) * tokens.shape[1]
-                      if seq_axis is not None else 0)
+        pos_offset = _shard_positions(model, seq_axis, tokens.shape[1])
 
         def loss_fn(p):
             logits = model.apply(p, tokens, pos_offset)
@@ -274,10 +288,12 @@ def jit_lm_train_step(
     ``shard_sequence=False``: batch axis sharded over the mesh (pure DP).
     ``shard_sequence=True``: the SEQUENCE axis is sharded (context
     parallelism for long-context training) — build the model with
-    ``attention='ring'`` (or ``'ulysses'``) and
-    ``sequence_axis=comm.axis_name``; each shard's global position base is
-    threaded through ``pos_offset``. Gradients are averaged over the axis by
-    the multi-node optimizer either way, so params stay replicated.
+    ``attention='ring'``, ``'zigzag'`` (load-balanced causal; feed data
+    permuted by :func:`~chainermn_tpu.parallel.sequence.zigzag_permutation`)
+    or ``'ulysses'``, and ``sequence_axis=comm.axis_name``; each shard's
+    global positions are threaded through ``pos_offset`` (a vector under
+    zigzag). Gradients are averaged over the axis by the multi-node
+    optimizer either way, so params stay replicated.
     """
     # Mismatched model/step configs run without error but compute the wrong
     # attention (the axis IS bound inside shard_map either way) — reject.
@@ -298,10 +314,11 @@ def jit_lm_train_step(
         )
     if attn is not None:
         if shard_sequence:
-            if attn not in ("ring", "ulysses") or seq_axis != comm.axis_name:
+            if (attn not in ("ring", "zigzag", "ulysses")
+                    or seq_axis != comm.axis_name):
                 raise ValueError(
                     f"shard_sequence=True needs the model built with "
-                    f"attention='ring'|'ulysses' and sequence_axis="
+                    f"attention='ring'|'zigzag'|'ulysses' and sequence_axis="
                     f"{comm.axis_name!r}; got attention={attn!r}, "
                     f"sequence_axis={seq_axis!r}"
                 )
@@ -313,8 +330,9 @@ def jit_lm_train_step(
             )
 
     def body(params, opt_state, tokens, targets):
-        t_local = tokens.shape[1]
-        pos_offset = comm.axis_index() * t_local if shard_sequence else 0
+        pos_offset = _shard_positions(
+            model, comm.axis_name if shard_sequence else None, tokens.shape[1]
+        )
         # varying view for local grads — see make_classification_train_step
         params_v = jax.tree_util.tree_map(
             lambda a: jax.lax.pcast(a, comm.axis_name, to="varying"), params

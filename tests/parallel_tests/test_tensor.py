@@ -235,13 +235,17 @@ def test_tp_lm_vocab_parallel_head_trains(comm):
     assert losses[-1] < losses[0], losses
 
 
-@pytest.mark.parametrize("sp_kind", ["ring", "ulysses"])
+@pytest.mark.parametrize("sp_kind", ["ring", "zigzag", "ulysses"])
 def test_tp_attention_composes_with_sp(comm, sp_kind):
     """The docstring claim that TP (heads over one axis) composes with
     sequence parallelism (sequence over another): on the hierarchical
     (inter x intra) mesh, heads shard over intra and the sequence over
     inter; output must match serial full attention with the same weights.
-    (Ulysses additionally needs local_heads divisible by the sp size.)"""
+    (Ulysses additionally needs local_heads divisible by the sp size;
+    zigzag additionally exercises its varying-predicate lax.cond under the
+    extra tensor axis' vma.)"""
+    from chainermn_tpu.parallel.sequence import zigzag_permutation
+
     hier = chainermn_tpu.create_communicator("hierarchical")
     axes = hier.axis_name
     if isinstance(axes, str):
@@ -259,16 +263,20 @@ def test_tp_attention_composes_with_sp(comm, sp_kind):
         attention=sp_kind, sequence_axis=sp_axis,
     )
     x = jax.random.normal(jax.random.PRNGKey(30), (b, t, d_model))
+    # zigzag shards hold (early, late) chunk pairs of the PERMUTED sequence
+    perm = (zigzag_permutation(t, n_sp) if sp_kind == "zigzag"
+            else jnp.arange(t))
+    inv = jnp.argsort(perm)
 
     # init under the mesh on one sequence shard (collectives inside)
     params = jax.jit(hier.shard_map(
         lambda xx: attn.init(jax.random.PRNGKey(31), xx),
         in_specs=P(None, sp_axis), out_specs=P(),
-    ))(x)
+    ))(x[:, perm])
     got = jax.jit(hier.shard_map(
         lambda p, xx: attn.apply(p, xx),
         in_specs=(P(), P(None, sp_axis)), out_specs=P(None, sp_axis),
-    ))(params, x)
+    ))(params, x[:, perm])[:, inv]
 
     # serial reference: same (rank, 3, local_head, d_head)-major layout
     d_head, local_h = d_model // n_heads, n_heads // n_tp
