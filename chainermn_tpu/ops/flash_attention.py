@@ -66,6 +66,12 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _fold_args(b, h, d, *xs):
+    """Model layout ``[B, T, H, D]`` -> kernel layout ``[B*H, T, D]``."""
+    return tuple(x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+                 for x in xs)
+
+
 # --------------------------------------------------------------------------- #
 # Forward                                                                     #
 # --------------------------------------------------------------------------- #
@@ -125,7 +131,7 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
-         interpret):
+         interpret, out_dtype=None):
     bh, tq, d = q.shape
     tk = k.shape[1]
     grid = (bh, tq // block_q)
@@ -148,7 +154,10 @@ def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            # out_dtype=f32 lets ring callers merge partial block outputs
+            # without a bf16 round-trip (q/k/v still feed the MXU in their
+            # input dtype; the kernel accumulates f32 regardless)
+            jax.ShapeDtypeStruct((bh, tq, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((bh, tq), jnp.float32),
         ],
         interpret=interpret,
@@ -263,17 +272,15 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse, qo, ko = res
-    do, _ = g  # cotangent of (out, lse); lse cotangent unused
+def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
+             block_k, interpret, grad_dtype=None):
+    """dq for one (q-range x k-range) pair, folded ``[B*H, T, D]`` layout —
+    shared by the full backward and the ring backward's per-block calls
+    (which pass ``grad_dtype=f32`` to accumulate across blocks losslessly)."""
     bh, tq, d = q.shape
     tk = k.shape[1]
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     smem = _smem_spec()
-    qo2 = jnp.asarray(qo, jnp.int32).reshape(1, 1)
-    ko2 = jnp.asarray(ko, jnp.int32).reshape(1, 1)
-
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_k=block_k),
         grid=(bh, tq // block_q),
@@ -287,11 +294,19 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), grad_dtype or q.dtype),
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)
 
-    dk, dv = pl.pallas_call(
+
+def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
+              block_k, interpret, grad_dtype=None):
+    """(dk, dv) for one (q-range x k-range) pair, folded layout — see
+    :func:`_dq_call`."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    smem = _smem_spec()
+    return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q),
         grid=(bh, tk // block_k),
@@ -309,11 +324,23 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or v.dtype),
         ],
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)
+
+
+def _bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse, qo, ko = res
+    do, _ = g  # cotangent of (out, lse); lse cotangent unused
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    qo2 = jnp.asarray(qo, jnp.int32).reshape(1, 1)
+    ko2 = jnp.asarray(ko, jnp.int32).reshape(1, 1)
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+              interpret=interpret)
+    dq = _dq_call(q, k, v, do, lse, delta, qo2, ko2, **kw)
+    dk, dv = _dkv_call(q, k, v, do, lse, delta, qo2, ko2, **kw)
     return dq, dk, dv, None, None
 
 
@@ -391,11 +418,92 @@ def flash_attention(
             "implemented — pad the sequence to a multiple of 8"
         )
 
-    def fold(x):  # [B, T, H, D] -> [B*H, T, D]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    out = _flash(fold(q), fold(k), fold(v),
+    qf, kf, vf = _fold_args(b, h, d, q, k, v)
+    out = _flash(qf, kf, vf,
                  jnp.asarray(q_offset, jnp.int32),
                  jnp.asarray(k_offset, jnp.int32),
                  float(scale), bool(causal), bq, bk, bool(interpret))
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+# --------------------------------------------------------------------------- #
+# Block-level entries for ring attention                                      #
+# --------------------------------------------------------------------------- #
+# Ring attention (parallel/sequence.py) computes attention against one K/V
+# block per step and merges partials with the online-softmax recurrence; it
+# owns its own custom VJP at the ring level, so these entries are PRIMAL
+# only — the forward returns the (out, lse) pair the merge needs, and the
+# backward pieces take the ring's final lse/delta and return one block's
+# gradient contributions. All in model layout [B, T, H, D] (lse [B, H, T]).
+
+def _check_blocks(bq, bk, tq, tk):
+    """Ring callers have no XLA fallback (the custom VJP is built on the
+    kernels), so reject un-tileable lengths loudly instead of letting
+    Pallas fail with an obscure Mosaic error."""
+    if bq < min(8, tq) or bk < min(8, tk):
+        raise ValueError(
+            f"ring flash attention: shard lengths (tq={tq}, tk={tk}) have "
+            "no usable block divisor >= 8 — pad the per-shard sequence to a "
+            "multiple of 8 (zigzag chunks: a multiple of 16)"
+        )
+
+
+def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
+                       k_offset=0, block_q=128, block_k=128, interpret=None,
+                       out_dtype=None):
+    """Primal-only flash forward returning ``(out, lse)``.
+
+    ``out [B, Tq, H, D]`` (in ``out_dtype``, default ``q.dtype`` — ring
+    callers pass f32 to merge without a bf16 round-trip), ``lse [B, H, Tq]``
+    (f32; fully-masked rows hold the -1e30 sentinel, which the lse-weighted
+    merge turns into a zero contribution). Causal masking uses global
+    positions via the (possibly traced) offsets, and the kernel's k-loop
+    clamp skips fully-masked blocks — a future block costs ~nothing."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    bq, bk = _pick_block(tq, block_q), _pick_block(tk, block_k)
+    _check_blocks(bq, bk, tq, tk)
+    qf, kf, vf = _fold_args(b, h, d, q, k, v)
+    out, lse = _fwd(qf, kf, vf,
+                    jnp.asarray(q_offset, jnp.int32),
+                    jnp.asarray(k_offset, jnp.int32),
+                    scale=float(scale), causal=bool(causal), block_q=bq,
+                    block_k=bk, interpret=bool(interpret),
+                    out_dtype=out_dtype)
+    return (out.reshape(b, h, tq, d).transpose(0, 2, 1, 3),
+            lse.reshape(b, h, tq))
+
+
+def flash_block_grads(q, k, v, do, lse, delta, *, causal=False, scale=None,
+                      q_offset=0, k_offset=0, block_q=128, block_k=128,
+                      interpret=None, grad_dtype=jnp.float32):
+    """One block's gradient contributions ``(dq, dk, dv)`` given the FINAL
+    (globally merged) ``lse [B, H, Tq]`` and ``delta = rowsum(do * out)
+    [B, H, Tq]`` — the flash backward decomposes over K/V blocks once those
+    are fixed, which is exactly what the ring backward's rotation needs.
+    Layouts as :func:`flash_fwd_with_lse`. Gradients come back in
+    ``grad_dtype`` (default f32) because the ring accumulates them across
+    blocks; cast once at the end."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    bq, bk = _pick_block(tq, block_q), _pick_block(tk, block_k)
+    _check_blocks(bq, bk, tq, tk)
+    qf, kf, vf, dof = _fold_args(b, h, d, q, k, v, do)
+    lsef = lse.reshape(b * h, tq)
+    deltaf = delta.reshape(b * h, tq)
+    qo2 = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    ko2 = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
+    kw = dict(scale=float(scale), causal=bool(causal), block_q=bq,
+              block_k=bk, interpret=bool(interpret), grad_dtype=grad_dtype)
+    dq = _dq_call(qf, kf, vf, dof, lsef, deltaf, qo2, ko2, **kw)
+    dk, dv = _dkv_call(qf, kf, vf, dof, lsef, deltaf, qo2, ko2, **kw)
+    unfold = lambda x: x.reshape(b, h, x.shape[1], d).transpose(0, 2, 1, 3)
+    return unfold(dq), unfold(dk), unfold(dv)

@@ -156,6 +156,320 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+# --------------------------------------------------------------------------- #
+# Ring attention with Pallas flash blocks                                     #
+# --------------------------------------------------------------------------- #
+# The XLA ring above materializes each [B, H, Tq, Tk] score tile via jnp
+# einsums; on TPU the per-block computation should be the flash kernel
+# (ops/flash_attention.py) so the two O(T)-memory paths compose: ring
+# memory ACROSS devices, flash tiling WITHIN each block. AD cannot trace
+# through pallas_call, so the ring owns a custom VJP:
+#
+# - forward: one primal flash call per incoming block (the kernel's causal
+#   trip-count clamp skips fully-masked blocks for free); partials merge by
+#   the lse-weighted rule o <- o*exp(lse-lse') + o_b*exp(lse_b-lse').
+# - backward: the flash backward decomposes over K/V blocks once the FINAL
+#   lse and delta = rowsum(do*out) are fixed, so a second rotation pass
+#   computes per-block (dq, dk_b, dv_b) with the block kernels; dk/dv
+#   accumulators ride the ring WITH their k/v block and arrive back at the
+#   owner after n steps holding every rank's contribution.
+
+def _zz_merge(o, lse, ob, lse_b):
+    """lse-weighted merge of a partial block result into the running
+    (o [B,T,H,D] f32, lse [B,H,T] f32) — the single home of the merge
+    recurrence shared by the ring-flash and zigzag-flash forwards."""
+    lse_new = jnp.logaddexp(lse, lse_b)
+    w1 = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+    w2 = jnp.exp(lse_b - lse_new).transpose(0, 2, 1)[..., None]
+    return o * w1 + ob * w2, lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_flash_fwd_pass(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_flash_fwd_pass(q, k, v, axis_name, causal, scale):
+    from chainermn_tpu.ops.flash_attention import flash_fwd_with_lse
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    vma = (frozenset({axis_name}) | jax.typeof(q).vma
+           | jax.typeof(k).vma | jax.typeof(v).vma)
+    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
+    o0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
+    lse0 = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
+
+    def body(step, carry):
+        o, lse, kb, vb = carry
+        src = (my - step) % n
+        ob, lse_b = flash_fwd_with_lse(
+            q, kb, vb, causal=causal, scale=scale,
+            q_offset=my * t, k_offset=src * t, out_dtype=jnp.float32,
+        )
+        o, lse = _zz_merge(o, lse, ob, lse_b)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return o, lse, kb, vb
+
+    o, lse, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v))
+    return o.astype(q.dtype), lse
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_flash_fwd_pass(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, causal, scale, res, do):
+    from chainermn_tpu.ops.flash_attention import flash_block_grads
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # delta rows must pair with lse rows: [B, T, H] -> [B, H, T]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)
+    vma = (jax.typeof(q).vma | jax.typeof(do).vma
+           | frozenset({axis_name}))
+    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
+    dq0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
+    dk0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
+    dv0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
+
+    def body(step, carry):
+        dq, dka, dva, kb, vb = carry
+        src = (my - step) % n
+        dqb, dkb, dvb = flash_block_grads(
+            q, kb, vb, do, lse, delta, causal=causal, scale=scale,
+            q_offset=my * t, k_offset=src * t,
+        )
+        dq = dq + dqb
+        dka = dka + dkb
+        dva = dva + dvb
+        # accumulators travel WITH their block; after n rotations both are
+        # back at the block's owner carrying all ranks' contributions
+        kb, vb, dka, dva = (lax.ppermute(x, axis_name, perm)
+                            for x in (kb, vb, dka, dva))
+        return dq, dka, dva, kb, vb
+
+    dq, dka, dva, _, _ = lax.fori_loop(0, n, body, (dq0, dk0, dv0, k, v))
+    return dq.astype(q.dtype), dka.astype(k.dtype), dva.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def ring_flash_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """:func:`ring_attention` with Pallas flash kernels as the per-block
+    computation — same semantics and layout, O(T) memory at BOTH levels
+    (ring across devices, flash tiles within a block), fully-masked blocks
+    skipped inside the kernel. Differentiable via a ring-level custom VJP
+    (flash backward kernels in a second rotation pass). Off TPU the kernels
+    run interpreted — use ``check_vma=False`` on the enclosing shard_map
+    there, like plain ``'flash'``."""
+    if not isinstance(axis_name, str):
+        raise ValueError(
+            f"ring_flash_attention needs a single named mesh axis, got "
+            f"{axis_name!r}"
+        )
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _ring_flash(q, k, v, axis_name, bool(causal), float(scale))
+
+
+# --------------------------------------------------------------------------- #
+# Zigzag ring with Pallas flash blocks                                        #
+# --------------------------------------------------------------------------- #
+# The balanced layout AND the kernel blocks — the long-context flagship
+# composition. Every zigzag interaction decomposes into offset-causal or
+# fully-visible chunk pairs, which is exactly what the flash kernel
+# supports: the diagonal step is (qe vs ke causal) + (ql vs kl causal) +
+# (ql vs ke full), and each off-diagonal step is one unmasked [t, c] or
+# [c, t] call — equal FLOPs in both cond branches, so the balance property
+# is preserved. Ring-level custom VJP like _ring_flash, with the same
+# rotating dk/dv accumulators.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _zigzag_flash(q, k, v, axis_name, scale):
+    out, _ = _zigzag_flash_fwd_pass(q, k, v, axis_name, scale)
+    return out
+
+
+def _zigzag_flash_fwd_pass(q, k, v, axis_name, scale):
+    from chainermn_tpu.ops.flash_attention import flash_fwd_with_lse
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    if t % 2:
+        raise ValueError(f"local sequence length {t} must be even")
+    c = t // 2
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    off_e = my * c                 # global offset of the early chunk
+    off_l = (2 * n - 1 - my) * c   # ... and the late chunk
+
+    def block(qc, kc, vc, *, causal, q_off=0, k_off=0):
+        return flash_fwd_with_lse(
+            qc, kc, vc, causal=causal, scale=scale, q_offset=q_off,
+            k_offset=k_off, out_dtype=jnp.float32,
+        )
+
+    # diagonal: qe/ke causal + ql/kl causal + ql/ke full
+    oe, lse_e = block(q[:, :c], k[:, :c], v[:, :c], causal=True,
+                      q_off=off_e, k_off=off_e)
+    ol1, lse_l1 = block(q[:, c:], k[:, c:], v[:, c:], causal=True,
+                        q_off=off_l, k_off=off_l)
+    ol2, lse_l2 = block(q[:, c:], k[:, :c], v[:, :c], causal=False)
+    ol, lse_l = _zz_merge(ol1, lse_l1, ol2, lse_l2)
+    o = jnp.concatenate([oe, ol], axis=1)
+    lse = jnp.concatenate([lse_e, lse_l], axis=2)
+
+    kb = lax.ppermute(k, axis_name, perm)
+    vb = lax.ppermute(v, axis_name, perm)
+
+    def body(step, carry):
+        o, lse, kb, vb = carry
+
+        def from_earlier(args):
+            o, lse = args
+            ob, lse_b = block(q, kb[:, :c], vb[:, :c], causal=False)
+            return _zz_merge(o, lse, ob, lse_b)
+
+        def from_later(args):
+            o, lse = args
+            ob, lse_b = block(q[:, c:], kb, vb, causal=False)
+            ol, lse_l = _zz_merge(o[:, c:], lse[:, :, c:], ob, lse_b)
+            return (jnp.concatenate([o[:, :c], ol], axis=1),
+                    jnp.concatenate([lse[:, :, :c], lse_l], axis=2))
+
+        o, lse = lax.cond(my >= step, from_earlier, from_later, (o, lse))
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return o, lse, kb, vb
+
+    o, lse, _, _ = lax.fori_loop(1, n, body, (o, lse, kb, vb))
+    return o.astype(q.dtype), lse
+
+
+def _zigzag_flash_fwd_rule(q, k, v, axis_name, scale):
+    out, lse = _zigzag_flash_fwd_pass(q, k, v, axis_name, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _zigzag_flash_bwd_rule(axis_name, scale, res, do):
+    from chainermn_tpu.ops.flash_attention import flash_block_grads
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    c = t // 2
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)
+    vma = jax.typeof(q).vma | jax.typeof(do).vma | frozenset({axis_name})
+    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
+    off_e, off_l = my * c, (2 * n - 1 - my) * c
+
+    def grads(qs, ks, vs, dos, lses, deltas, *, causal, q_off=0, k_off=0):
+        return flash_block_grads(
+            qs, ks, vs, dos, lses, deltas, causal=causal, scale=scale,
+            q_offset=q_off, k_offset=k_off,
+        )
+
+    # diagonal contributions (same three pairs as forward)
+    dqe, dke, dve = grads(q[:, :c], k[:, :c], v[:, :c], do[:, :c],
+                          lse[:, :, :c], delta[:, :, :c], causal=True,
+                          q_off=off_e, k_off=off_e)
+    dql1, dkl, dvl = grads(q[:, c:], k[:, c:], v[:, c:], do[:, c:],
+                           lse[:, :, c:], delta[:, :, c:], causal=True,
+                           q_off=off_l, k_off=off_l)
+    dql2, dke2, dve2 = grads(q[:, c:], k[:, :c], v[:, :c], do[:, c:],
+                             lse[:, :, c:], delta[:, :, c:], causal=False)
+    dq = _vary(jnp.concatenate([dqe, dql1 + dql2], axis=1))
+    dka = _vary(jnp.concatenate([dke + dke2, dkl], axis=1))
+    dva = _vary(jnp.concatenate([dve + dve2, dvl], axis=1))
+
+    kb = lax.ppermute(k, axis_name, perm)
+    vb = lax.ppermute(v, axis_name, perm)
+    dka = lax.ppermute(dka, axis_name, perm)
+    dva = lax.ppermute(dva, axis_name, perm)
+
+    def body(step, carry):
+        dq, dka, dva, kb, vb = carry
+
+        def from_earlier(args):
+            dq, dka, dva = args
+            dqb, dkb, dvb = grads(q, kb[:, :c], vb[:, :c], do, lse, delta,
+                                  causal=False)
+            zeros = jnp.zeros((b, c, h, d), jnp.float32)
+            return (dq + dqb,
+                    dka + jnp.concatenate([dkb, zeros], axis=1),
+                    dva + jnp.concatenate([dvb, zeros], axis=1))
+
+        def from_later(args):
+            dq, dka, dva = args
+            dqb, dkb, dvb = grads(q[:, c:], kb, vb, do[:, c:],
+                                  lse[:, :, c:], delta[:, :, c:],
+                                  causal=False)
+            dq = jnp.concatenate([dq[:, :c], dq[:, c:] + dqb], axis=1)
+            return dq, dka + dkb, dva + dvb
+
+        dq, dka, dva = lax.cond(my >= step, from_earlier, from_later,
+                                (dq, dka, dva))
+        kb, vb, dka, dva = (lax.ppermute(x, axis_name, perm)
+                            for x in (kb, vb, dka, dva))
+        return dq, dka, dva, kb, vb
+
+    dq, dka, dva, _, _ = lax.fori_loop(1, n, body, (dq, dka, dva, kb, vb))
+    return dq.astype(q.dtype), dka.astype(k.dtype), dva.astype(v.dtype)
+
+
+_zigzag_flash.defvjp(_zigzag_flash_fwd_rule, _zigzag_flash_bwd_rule)
+
+
+def zigzag_flash_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """:func:`zigzag_ring_attention` with Pallas flash kernels as the block
+    computation — balanced causal work AND O(T)-memory MXU tiles. Data must
+    be zigzag-permuted (:func:`zigzag_permutation`). Off TPU the kernels
+    run interpreted; use ``check_vma=False`` on the enclosing shard_map."""
+    if not causal:
+        return ring_flash_attention(q, k, v, axis_name, causal=False,
+                                    scale=scale)
+    if not isinstance(axis_name, str):
+        raise ValueError(
+            f"zigzag_flash_attention needs a single named mesh axis, got "
+            f"{axis_name!r}"
+        )
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _zigzag_flash(q, k, v, axis_name, float(scale))
+
+
 def zigzag_permutation(t_global: int, n_shards: int):
     """Sequence permutation for the zigzag (striped-block) layout.
 
@@ -357,11 +671,13 @@ def sequence_parallel_attention(
     causal: bool = False,
     scale: Optional[float] = None,
 ):
-    """Pick an attention implementation by name: ``'ring'`` | ``'ulysses'``
-    | ``'full'`` | ``'flash'``. Returns ``f(q, k, v) -> o`` for use inside a
-    traced step. ``'flash'`` is the Pallas-kernel local attention
-    (:mod:`chainermn_tpu.ops.flash_attention`) — same semantics as
-    ``'full'``, O(T) memory; use it when the sequence is NOT sharded."""
+    """Pick an attention implementation by name: ``'ring'`` |
+    ``'ring_flash'`` (ring with Pallas kernel blocks) | ``'zigzag'``
+    (load-balanced causal ring; data must be zigzag-permuted) |
+    ``'ulysses'`` | ``'full'`` | ``'flash'``. Returns ``f(q, k, v) -> o``
+    for use inside a traced step. ``'flash'`` is the Pallas-kernel local
+    attention (:mod:`chainermn_tpu.ops.flash_attention`) — same semantics
+    as ``'full'``, O(T) memory; use it when the sequence is NOT sharded."""
     if kind == "flash":
         if axis_name is not None:
             raise ValueError(
@@ -374,12 +690,15 @@ def sequence_parallel_attention(
         return functools.partial(flash_attention, causal=causal, scale=scale)
     if kind == "full" or axis_name is None:
         return functools.partial(full_attention, causal=causal, scale=scale)
-    if kind not in ("ring", "zigzag", "ulysses"):
+    if kind not in ("ring", "ring_flash", "zigzag", "zigzag_flash",
+                    "ulysses"):
         raise ValueError(
             f"unknown attention kind {kind!r}; use "
-            "ring|zigzag|ulysses|full|flash"
+            "ring|ring_flash|zigzag|zigzag_flash|ulysses|full|flash"
         )
-    impl = {"ring": ring_attention, "zigzag": zigzag_ring_attention,
+    impl = {"ring": ring_attention, "ring_flash": ring_flash_attention,
+            "zigzag": zigzag_ring_attention,
+            "zigzag_flash": zigzag_flash_attention,
             "ulysses": ulysses_attention}[kind]
 
     def f(q, k, v):

@@ -150,7 +150,7 @@ def _shard_positions(model, seq_axis, t_local):
     if seq_axis is None:
         return 0
     idx = jax.lax.axis_index(seq_axis)
-    if getattr(model, "attention", None) == "zigzag":
+    if getattr(model, "attention", None) in ("zigzag", "zigzag_flash"):
         from chainermn_tpu.parallel.sequence import zigzag_positions
 
         return zigzag_positions(idx, jax.lax.axis_size(seq_axis), t_local)
@@ -215,7 +215,7 @@ def _jit_tp_lm_train_step(
             "model without sequence_axis for batch-only sharding)"
         )
     if seq_axis is not None and getattr(model, "attention", None) not in (
-            "ring", "zigzag", "ulysses"):
+            "ring", "ring_flash", "zigzag", "zigzag_flash", "ulysses"):
         # 'full' under a sharded sequence silently computes block-diagonal
         # attention (each shard attends within its own chunk only)
         raise ValueError(
@@ -223,16 +223,17 @@ def _jit_tp_lm_train_step(
             f"'ulysses'; got {getattr(model, 'attention', None)!r} — plain "
             "'full' would attend within each sequence shard only"
         )
-    if (getattr(model, "attention", None) == "flash"
+    if (getattr(model, "attention", None) in ("flash", "ring_flash",
+                                              "zigzag_flash")
             and jax.default_backend() != "tpu"):
         # The dense LM step works around interpret-mode Pallas by dropping
         # to check_vma=False; the TP step CANNOT (the global-objective
         # pattern is built on vma tracking — global_objective raises).
         raise ValueError(
-            "tensor_axis + attention='flash' needs compiled TPU Pallas "
-            "kernels; in interpret mode (non-TPU backends) the required "
-            "check_vma=False would break the global-objective gradient "
-            "pattern — use attention='full' off-TPU"
+            "tensor_axis + Pallas attention (flash/ring_flash) needs "
+            "compiled TPU kernels; in interpret mode (non-TPU backends) the "
+            "required check_vma=False would break the global-objective "
+            "gradient pattern — use attention='full'/'ring' off-TPU"
         )
     dp_axes = tuple(a for a in axes if a != tensor_axis and a != seq_axis)
 
@@ -314,13 +315,14 @@ def jit_lm_train_step(
         )
     if attn is not None:
         if shard_sequence:
-            if (attn not in ("ring", "zigzag", "ulysses")
+            if (attn not in ("ring", "ring_flash", "zigzag", "zigzag_flash",
+                             "ulysses")
                     or seq_axis != comm.axis_name):
                 raise ValueError(
                     f"shard_sequence=True needs the model built with "
-                    f"attention='ring'|'zigzag'|'ulysses' and sequence_axis="
-                    f"{comm.axis_name!r}; got attention={attn!r}, "
-                    f"sequence_axis={seq_axis!r}"
+                    f"attention='ring'|'ring_flash'|'zigzag'|'zigzag_flash'|"
+                    f"'ulysses' and sequence_axis={comm.axis_name!r}; got "
+                    f"attention={attn!r}, sequence_axis={seq_axis!r}"
                 )
         elif seq_axis is not None:
             raise ValueError(
@@ -364,7 +366,8 @@ def jit_lm_train_step(
         # workaround); semantics are unchanged, only the static check is off.
         # Compiled TPU kernels don't need the workaround — keep the check on.
         # ZeRO's all_gather'd updates likewise defeat the static check.
-        check_vma=(attn != "flash" or jax.default_backend() == "tpu")
+        check_vma=(attn not in ("flash", "ring_flash", "zigzag_flash")
+                   or jax.default_backend() == "tpu")
         and getattr(optimizer, "check_vma", True)
         and getattr(comm, "check_vma", True),
     )

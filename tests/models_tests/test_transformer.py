@@ -104,12 +104,14 @@ def test_zigzag_lm_forward_matches_full(comm):
                                atol=2e-4, rtol=2e-4)
 
 
-def test_zigzag_lm_train_step_learns(comm):
-    """The SP train step with attention='zigzag': data permuted once on the
-    host, loss (mean over tokens) needs no unpermute, and it learns."""
+@pytest.mark.parametrize("kind", ["zigzag", "zigzag_flash"])
+def test_zigzag_lm_train_step_learns(comm, kind):
+    """The SP train step with zigzag attention (XLA blocks and Pallas
+    blocks): data permuted once on the host, loss (mean over tokens) needs
+    no unpermute, and it learns."""
     from chainermn_tpu.parallel.sequence import zigzag_permutation
 
-    model = _tiny("zigzag", comm.axis_name)
+    model = _tiny(kind, comm.axis_name)
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, 64, (4, 64)), jnp.int32)
     targets = jnp.asarray(np.roll(np.asarray(tokens), -1, axis=1), jnp.int32)
@@ -127,6 +129,25 @@ def test_zigzag_lm_train_step_learns(comm):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_ring_flash_lm_train_step_learns(comm):
+    """attention='ring_flash' (ring + Pallas kernel blocks, interpret mode
+    here) through the public SP train step."""
+    model = _tiny("ring_flash", comm.axis_name, n_heads=4)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, (2, 64)), jnp.int32)
+    targets = jnp.asarray(np.roll(np.asarray(tokens), -1, axis=1), jnp.int32)
+    params = comm.bcast_data(model.init(jax.random.PRNGKey(0), tokens[:, :8]))
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(1e-2), comm)
+    opt_state = jax.device_put(opt.init(params), comm.named_sharding())
+    step = jit_lm_train_step(model, opt, comm, shard_sequence=True)
+    losses = []
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
 
 
 def test_lm_train_step_sequence_parallel_learns(comm):

@@ -11,7 +11,9 @@ import chainermn_tpu
 from chainermn_tpu.parallel.sequence import (
     full_attention,
     ring_attention,
+    ring_flash_attention,
     ulysses_attention,
+    zigzag_flash_attention,
     zigzag_permutation,
     zigzag_positions,
     zigzag_ring_attention,
@@ -86,6 +88,60 @@ def test_ulysses_rejects_indivisible_heads(comm):
 
 
 # --------------------------------------------------------------------------- #
+# Ring with Pallas flash blocks (ring-level custom VJP)                       #
+# --------------------------------------------------------------------------- #
+
+def _rf_sharded(comm, *, causal):
+    spec = P(None, comm.axis_name)
+    # interpret-mode Pallas needs check_vma off (same as plain 'flash')
+    return jax.jit(comm.shard_map(
+        lambda q, k, v: ring_flash_attention(
+            q, k, v, comm.axis_name, causal=causal),
+        in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+    ))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full_attention(comm, causal):
+    q, k, v = _qkv(t=64)
+    want = full_attention(q, k, v, causal=causal)
+    got = _rf_sharded(comm, causal=causal)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_flash_gradients_match_full_attention(comm):
+    """The ring-level custom VJP (second rotation pass with the flash
+    backward kernels; dk/dv accumulators riding the ring) against AD
+    through full attention."""
+    q, k, v = _qkv(t=64, h=4, d=8)
+    f = _rf_sharded(comm, causal=True)
+
+    def loss_full(q, k, v):
+        return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_rf(q, k, v):
+        return (f(q, k, v) ** 2).sum()
+
+    g_want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.grad(loss_rf, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_ring_flash_bf16(comm):
+    """bf16 q/k/v feed the kernels; partials merge in f32 (out_dtype)."""
+    q, k, v = _qkv(t=64)
+    got = _rf_sharded(comm, causal=True)(
+        *(x.astype(jnp.bfloat16) for x in (q, k, v)))
+    assert got.dtype == jnp.bfloat16
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want),
+                               atol=4e-2, rtol=4e-2)
+
+
+# --------------------------------------------------------------------------- #
 # Zigzag (load-balanced causal) ring                                          #
 # --------------------------------------------------------------------------- #
 
@@ -150,6 +206,54 @@ def test_zigzag_gradients_match_full_attention(comm):
 def test_zigzag_bf16(comm):
     q, k, v = _qkv(t=16)
     got = _zigzag_sharded(comm, *(x.astype(jnp.bfloat16) for x in (q, k, v)))
+    assert got.dtype == jnp.bfloat16
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want),
+                               atol=4e-2, rtol=4e-2)
+
+
+def _zzf_run(comm, q, k, v):
+    t = q.shape[1]
+    perm = zigzag_permutation(t, comm.size)
+    inv = jnp.argsort(perm)
+    spec = P(None, comm.axis_name)
+    f = jax.jit(comm.shard_map(
+        lambda q, k, v: zigzag_flash_attention(q, k, v, comm.axis_name),
+        in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+    ))
+    return f(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+
+
+def test_zigzag_flash_matches_full_attention(comm):
+    """The flagship composition: balanced zigzag layout with Pallas kernel
+    blocks (diag = 2 causal + 1 full chunk call; off-diag = one unmasked
+    call per step, equal FLOPs in both cond branches)."""
+    q, k, v = _qkv(t=64)
+    want = full_attention(q, k, v, causal=True)
+    got = _zzf_run(comm, q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_zigzag_flash_gradients_match_full_attention(comm):
+    q, k, v = _qkv(t=64, h=4, d=8)
+
+    def loss_full(q, k, v):
+        return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_z(q, k, v):
+        return (_zzf_run(comm, q, k, v) ** 2).sum()
+
+    g_want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.grad(loss_z, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_zigzag_flash_bf16(comm):
+    q, k, v = _qkv(t=64)
+    got = _zzf_run(comm, *(x.astype(jnp.bfloat16) for x in (q, k, v)))
     assert got.dtype == jnp.bfloat16
     want = full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want),
