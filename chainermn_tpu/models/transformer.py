@@ -50,9 +50,15 @@ class TransformerBlock(nn.Module):
     tensor_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, pos_offset=0):
+    def __call__(self, x, pos_offset=0, kv_cache=None):
         dt = self.compute_dtype
         d_head = self.d_model // self.n_heads
+        if kv_cache is not None and (self.moe_experts
+                                     or self.sequence_axis is not None):
+            raise ValueError(
+                "kv_cache decoding supports dense and tensor-parallel "
+                "blocks only (not MoE or sequence-sharded)"
+            )
 
         h = nn.LayerNorm(dtype=dt)(x)
         if self.tensor_axis is not None:
@@ -70,24 +76,34 @@ class TransformerBlock(nn.Module):
                 TensorParallelMLP,
             )
 
-            x = x + TensorParallelAttention(
+            attn_out = TensorParallelAttention(
                 d_model=self.d_model, n_heads=self.n_heads,
                 axis_name=self.tensor_axis, causal=True,
                 attention=self.attention, sequence_axis=self.sequence_axis,
                 compute_dtype=dt, name="attn",
-            )(h)
+            )(h, pos_offset=pos_offset, kv_cache=kv_cache)
+            if kv_cache is not None:
+                attn_out, new_cache = attn_out
+            x = x + attn_out
             h = nn.LayerNorm(dtype=dt)(x)
-            return x + TensorParallelMLP(
+            x = x + TensorParallelMLP(
                 d_model=self.d_model, d_ff=self.d_ff,
                 axis_name=self.tensor_axis, compute_dtype=dt, name="mlp",
             )(h)
+            return (x, new_cache) if kv_cache is not None else x
 
-        attn_fn = sequence_parallel_attention(
-            self.attention, self.sequence_axis, causal=True
-        )
         qkv = nn.DenseGeneral((3, self.n_heads, d_head), dtype=dt, name="qkv")(h)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        o = attn_fn(q, k, v)
+        if kv_cache is not None:
+            from chainermn_tpu.parallel.sequence import update_cache_and_attend
+
+            o, new_cache = update_cache_and_attend(kv_cache, q, k, v,
+                                                   pos_offset)
+        else:
+            attn_fn = sequence_parallel_attention(
+                self.attention, self.sequence_axis, causal=True
+            )
+            o = attn_fn(q, k, v)
         x = x + nn.DenseGeneral(self.d_model, axis=(-2, -1), dtype=dt, name="proj")(o)
 
         h = nn.LayerNorm(dtype=dt)(x)
@@ -101,7 +117,8 @@ class TransformerBlock(nn.Module):
             return x + y, aux
         h = nn.Dense(self.d_ff, dtype=dt)(h)
         h = nn.gelu(h)
-        return x + nn.Dense(self.d_model, dtype=dt)(h)
+        x = x + nn.Dense(self.d_model, dtype=dt)(h)
+        return (x, new_cache) if kv_cache is not None else x
 
 
 class TransformerLM(nn.Module):
@@ -141,7 +158,8 @@ class TransformerLM(nn.Module):
     vocab_parallel_head: bool = False
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0, return_aux: bool = False):
+    def __call__(self, tokens, pos_offset=0, return_aux: bool = False,
+                 kv_caches=None):
         if self.tensor_axis is not None and self.moe_experts:
             raise ValueError(
                 "tensor_axis and moe_experts are mutually exclusive: the MoE "
@@ -150,6 +168,13 @@ class TransformerLM(nn.Module):
             )
         if self.vocab_parallel_head and self.tensor_axis is None:
             raise ValueError("vocab_parallel_head needs tensor_axis")
+        if kv_caches is not None and (self.moe_experts
+                                      or self.sequence_axis is not None):
+            raise ValueError(
+                "kv_caches decoding supports dense and tensor-parallel "
+                "models only — rebuild without moe_experts/sequence_axis "
+                "for inference"
+            )
         d_ff = self.d_ff or 4 * self.d_model
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
@@ -163,9 +188,10 @@ class TransformerLM(nn.Module):
         x = x + nn.Embed(self.max_len, self.d_model,
                          dtype=self.compute_dtype, name="pos_embed")(pos)[None]
         aux_total = jnp.float32(0.0)
+        new_caches = []
         for i in range(self.n_layers):
             is_moe = self.moe_experts and (i % self.moe_every == self.moe_every - 1)
-            out = TransformerBlock(
+            block = TransformerBlock(
                 self.d_model, self.n_heads, d_ff,
                 attention=self.attention, sequence_axis=self.sequence_axis,
                 compute_dtype=self.compute_dtype,
@@ -174,7 +200,12 @@ class TransformerLM(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 tensor_axis=self.tensor_axis,
                 name=f"block_{i}",
-            )(x)
+            )
+            if kv_caches is not None:
+                x, c = block(x, pos_offset, kv_cache=kv_caches[i])
+                new_caches.append(c)
+                continue
+            out = block(x, pos_offset)
             x, aux = out if is_moe else (out, 0.0)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
@@ -189,9 +220,24 @@ class TransformerLM(nn.Module):
             logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype,
                               name="lm_head")(x)
         logits = logits.astype(jnp.float32)
+        if kv_caches is not None:
+            return logits, new_caches
         if return_aux:
             return logits, aux_total
         return logits
+
+
+def init_kv_caches(model: TransformerLM, batch: int, cache_len: int,
+                   *, local_heads: Optional[int] = None):
+    """Zeroed per-layer KV cache buffers for :meth:`TransformerLM.__call__`'s
+    ``kv_caches`` argument: a list of ``{'k','v'}`` dicts shaped
+    ``[batch, cache_len, heads, d_head]`` in the model's compute dtype.
+    Tensor-parallel decode (inside ``shard_map``) passes
+    ``local_heads=n_heads // tp_size`` for the per-rank buffers."""
+    h = local_heads or model.n_heads
+    dh = model.d_model // model.n_heads
+    z = lambda: jnp.zeros((batch, cache_len, h, dh), model.compute_dtype)
+    return [{"k": z(), "v": z()} for _ in range(model.n_layers)]
 
 
 def generate(
@@ -202,6 +248,8 @@ def generate(
     *,
     temperature: float = 0.0,
     rng=None,
+    use_cache: bool = True,
+    comm=None,
 ):
     """Autoregressive decoding for :class:`TransformerLM` (inference utility
     beyond the reference, which has no generation loop; completes the LM
@@ -209,22 +257,29 @@ def generate(
 
     ``prompt [B, T0]`` ints; returns ``[B, T0 + n_tokens]``. ``temperature=0``
     is greedy (deterministic); otherwise softmax sampling at the given
-    temperature with ``rng``. The decode loop is a jitted ``lax.scan`` over a
-    fixed ``T0 + n_tokens`` buffer, cached per (model, shapes, temperature) —
-    repeat calls with the same shapes reuse the compile. Each step re-runs
-    the full forward on the buffer (no KV cache: simple, correct, static
-    shapes); causal attention makes positions past the current length
-    irrelevant to the sampled token. Single-device / replicated-params only:
-    the parallel training layouts (tensor_axis, sequence_axis, moe_axis)
-    trace collectives that need a mesh context — rebuild a plain model for
-    inference, or run inside an equivalent shard_map.
+    temperature with ``rng``. Compiled per (model, shapes, temperature) —
+    repeat calls with the same shapes reuse the compile.
+
+    ``use_cache=True`` (default): one full prefill over the prompt fills a
+    static ``[B, T0+n_tokens]`` KV cache per layer, then each step runs ONE
+    token through the model against the cache — O(T*d) per token. The
+    greedy token sequence is identical to the cacheless path (pinned in
+    tests). ``use_cache=False`` keeps the round-3 re-forward-the-buffer
+    loop (O(T^2) attention per token) as the independent reference.
+
+    Tensor-parallel models (``tensor_axis``, incl. ``vocab_parallel_head``):
+    pass ``comm=`` (the communicator whose mesh axis the model was built
+    on) — the whole decode loop then runs inside its ``shard_map`` with
+    per-rank local-head caches; a vocab-parallel head's local logits are
+    ``all_gather``\\ ed (one ``[B, vocab]`` row per step) for sampling.
+    Sequence-sharded and MoE models still need a dense rebuild for
+    inference.
     """
-    if (model.tensor_axis is not None or model.sequence_axis is not None
-            or model.moe_experts):
+    if model.sequence_axis is not None or model.moe_experts:
         raise ValueError(
-            "generate() runs outside a mesh: rebuild the model without "
-            "tensor_axis/sequence_axis/moe_experts (attention='full') "
-            "for inference"
+            "generate() supports dense and tensor-parallel models: rebuild "
+            "without sequence_axis/moe_experts (attention='full') for "
+            "inference"
         )
     if temperature and rng is None:
         raise ValueError("temperature sampling needs an rng key")
@@ -234,14 +289,133 @@ def generate(
             f"{t0 + n_tokens} tokens exceed max_len={model.max_len}"
         )
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    run = _generate_fn(model, int(n_tokens), float(temperature), b, int(t0),
-                       jnp.dtype(prompt.dtype).name)
+    if model.tensor_axis is not None:
+        if comm is None or not use_cache:
+            raise ValueError(
+                "tensor-parallel generate() needs comm= and use_cache=True "
+                "(the decode loop runs inside the communicator's shard_map)"
+            )
+        run = _generate_tp_fn(model, int(n_tokens), float(temperature), b,
+                              int(t0), jnp.dtype(prompt.dtype).name, comm)
+        return run(params, prompt, rng)
+    fn = _generate_cached_fn if use_cache else _generate_fn
+    run = fn(model, int(n_tokens), float(temperature), b, int(t0),
+             jnp.dtype(prompt.dtype).name)
     return run(params, prompt, rng)
+
+
+def _sampler(temperature):
+    """(logits [B, V], key) -> (token [B], key); the split sequence is
+    identical between the cached and cacheless paths so sampled outputs
+    match too (given equal logits)."""
+
+    def sample(lg, key):
+        key, sub = jax.random.split(key)
+        if temperature:
+            return jax.random.categorical(sub, lg / temperature, axis=-1), key
+        return jnp.argmax(lg, axis=-1), key
+
+    return sample
+
+
+@functools.lru_cache(maxsize=32)
+def _generate_cached_fn(model, n_tokens, temperature, b, t0, dtype_name):
+    """KV-cached decode: one prefill over the prompt, then one token per
+    step against the static cache. Compiled per (model, shape, temperature)
+    key. NOTE the lru_cache retains compiled programs closed over param
+    SHAPES only (params are arguments), but each entry still holds a
+    full decode executable — bounded by maxsize."""
+    total = t0 + n_tokens
+    dtype = jnp.dtype(dtype_name)
+    sample = _sampler(temperature)
+
+    @jax.jit
+    def run(params, prompt, rng):
+        caches = init_kv_caches(model, b, total)
+        buf = jnp.zeros((b, total), dtype).at[:, :t0].set(prompt)
+        logits, caches = model.apply(params, prompt, 0, kv_caches=caches)
+        nxt, key = sample(logits[:, -1], rng)
+        buf = buf.at[:, t0].set(nxt.astype(dtype))
+
+        def step(carry, i):
+            buf, caches, key = carry
+            tok = lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
+            lg, caches = model.apply(params, tok, i, kv_caches=caches)
+            nxt, key = sample(lg[:, 0], key)
+            buf = lax.dynamic_update_slice(
+                buf, nxt[:, None].astype(dtype), (0, i + 1))
+            return (buf, caches, key), None
+
+        (buf, _, _), _ = lax.scan(
+            step, (buf, caches, key), jnp.arange(t0, total - 1))
+        return buf
+
+    return run
+
+
+@functools.lru_cache(maxsize=8)
+def _generate_tp_fn(model, n_tokens, temperature, b, t0, dtype_name, comm):
+    """Tensor-parallel cached decode: the same loop as
+    :func:`_generate_cached_fn` traced INSIDE ``comm.shard_map`` — per-rank
+    caches hold the rank's local heads, and a vocab-parallel head's local
+    logits are all_gather'ed (one [B, vocab] row per step) before sampling.
+    Keyed on the communicator by identity — reuse the same comm object to
+    reuse the compile."""
+    from jax.sharding import PartitionSpec as P
+
+    total = t0 + n_tokens
+    dtype = jnp.dtype(dtype_name)
+    sample = _sampler(temperature)
+    axis = model.tensor_axis
+    n_tp = comm.mesh.shape[axis]
+    if model.n_heads % n_tp:
+        raise ValueError(
+            f"n_heads {model.n_heads} not divisible by tensor-axis size {n_tp}"
+        )
+    local_h = model.n_heads // n_tp
+
+    def body(params, prompt, rng):
+        def last_logits(tokens, offset, caches):
+            """Logits at the LAST input position, [B, vocab] — sliced
+            before the vocab all_gather so prefill ships one row per batch
+            element, not [B, T0, vocab]."""
+            lg, caches = model.apply(params, tokens, offset,
+                                     kv_caches=caches)
+            lg = lg[:, -1]
+            if model.vocab_parallel_head:
+                lg = lax.all_gather(lg, axis, axis=-1, tiled=True)
+            return lg, caches
+
+        caches = init_kv_caches(model, b, total, local_heads=local_h)
+        buf = jnp.zeros((b, total), dtype).at[:, :t0].set(prompt)
+        logits, caches = last_logits(prompt, 0, caches)
+        nxt, key = sample(logits, rng)
+        buf = buf.at[:, t0].set(nxt.astype(dtype))
+
+        def step(carry, i):
+            buf, caches, key = carry
+            tok = lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
+            lg, caches = last_logits(tok, i, caches)
+            nxt, key = sample(lg, key)
+            buf = lax.dynamic_update_slice(
+                buf, nxt[:, None].astype(dtype), (0, i + 1))
+            return (buf, caches, key), None
+
+        (buf, _, _), _ = lax.scan(
+            step, (buf, caches, key), jnp.arange(t0, total - 1))
+        return buf
+
+    return jax.jit(comm.shard_map(
+        body, in_specs=(P(), P(), P()), out_specs=P(), check_vma=False,
+    ))
 
 
 @functools.lru_cache(maxsize=32)
 def _generate_fn(model, n_tokens, temperature, b, t0, dtype_name):
-    """One compiled decode program per (model, shape, temperature) key —
+    """The cacheless reference decode (round-3 behavior): re-runs the full
+    forward over the whole buffer per token — O(T^2) attention x T tokens.
+    Kept as the independent correctness reference for the cached path.
+    One compiled decode program per (model, shape, temperature) key —
     flax modules are frozen/hashable, so they key an lru_cache directly."""
     total = t0 + n_tokens
     dtype = jnp.dtype(dtype_name)

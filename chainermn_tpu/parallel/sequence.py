@@ -645,6 +645,47 @@ def ulysses_attention(
     return to_seq(out)
 
 
+def cached_attention(q, kbuf, vbuf, pos_offset, *, scale: Optional[float] = None):
+    """Decode-time attention: ``S`` new queries against a static KV buffer.
+
+    ``q [B, S, H, D]`` holds queries for global positions ``pos_offset ..
+    pos_offset+S-1``; ``kbuf/vbuf [B, Tc, H, D]`` are the cache buffers
+    whose first ``pos_offset+S`` rows are valid (later rows are masked by
+    position, so their contents — typically zeros — never contribute).
+    Static shapes throughout: the compiled program is one [S, Tc] score
+    tile per head, O(Tc*D) per decoded token instead of the O(Tc^2)
+    re-forward of cacheless decoding. Shared by the dense and
+    tensor-parallel decode paths (``pos_offset`` may be traced)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kbuf,
+                   preferred_element_type=jnp.float32) * scale
+    q_pos = pos_offset + jnp.arange(q.shape[1])
+    k_pos = jnp.arange(kbuf.shape[1])
+    mask = k_pos[None, :] <= q_pos[:, None]
+    s = jnp.where(mask[None, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vbuf.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
+                            scale: Optional[float] = None):
+    """Write ``S`` new K/V rows into the cache at ``pos_offset`` and attend
+    the matching queries against the updated buffers — the one shared
+    decode-step body for the dense and tensor-parallel cached paths.
+    Returns ``(out, new_cache)`` with ``new_cache`` the same ``{'k','v'}``
+    dict shape. Causal by construction (the position mask)."""
+    kbuf = lax.dynamic_update_slice(
+        kv_cache["k"], k.astype(kv_cache["k"].dtype), (0, pos_offset, 0, 0))
+    vbuf = lax.dynamic_update_slice(
+        kv_cache["v"], v.astype(kv_cache["v"].dtype), (0, pos_offset, 0, 0))
+    out = cached_attention(q, kbuf, vbuf, pos_offset, scale=scale)
+    return out, {"k": kbuf, "v": vbuf}
+
+
 def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
     """Single-device exact attention, same layout/semantics — the reference
     implementation the parallel variants are tested against, and the

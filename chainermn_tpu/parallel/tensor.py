@@ -167,12 +167,20 @@ class TensorParallelAttention(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pos_offset=0, kv_cache=None):
         from chainermn_tpu.parallel.sequence import sequence_parallel_attention
 
-        attn_fn = sequence_parallel_attention(
-            self.attention, self.sequence_axis, causal=self.causal
-        )
+        if kv_cache is not None and self.sequence_axis is not None:
+            raise ValueError(
+                "kv_cache decoding needs an unsharded sequence — rebuild "
+                "without sequence_axis for inference"
+            )
+        if kv_cache is not None and not self.causal:
+            raise ValueError(
+                "kv_cache decoding is causal by construction (the position "
+                "mask); causal=False with a cache would silently mask "
+                "attention to later cached positions"
+            )
         n = _axis_size(self.axis_name)
         if self.n_heads % n:
             raise ValueError(
@@ -196,12 +204,23 @@ class TensorParallelAttention(nn.Module):
         b, t = qkv.shape[0], qkv.shape[1]
         qkv = qkv.reshape(b, t, 3, local_h, d_head)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        o = attn_fn(q, k, v)
+        if kv_cache is not None:
+            # per-rank cache over LOCAL heads [B, Tc, local_h, d_head]
+            from chainermn_tpu.parallel.sequence import update_cache_and_attend
+
+            o, new_cache = update_cache_and_attend(kv_cache, q, k, v,
+                                                   pos_offset)
+        else:
+            attn_fn = sequence_parallel_attention(
+                self.attention, self.sequence_axis, causal=self.causal
+            )
+            o = attn_fn(q, k, v)
         o = o.reshape(b, t, local_h * d_head)
-        return RowParallelDense(
+        out = RowParallelDense(
             self.d_model, self.axis_name, in_features=self.d_model,
             compute_dtype=self.compute_dtype, name="proj_tprow",
         )(o)
+        return (out, new_cache) if kv_cache is not None else out
 
 
 def vocab_parallel_cross_entropy(local_logits, targets, axis_name: str):
