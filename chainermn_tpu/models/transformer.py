@@ -43,6 +43,7 @@ class TransformerBlock(nn.Module):
     moe_experts: int = 0
     moe_axis: Optional[str] = None
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1
     # tensor_axis set -> Megatron-style block: head-sharded attention +
     # column/row FFN from parallel.tensor, one psum each. Train with the
     # global-objective pattern (tensor.py docstring), NOT the pcast/varying
@@ -112,6 +113,7 @@ class TransformerBlock(nn.Module):
                 n_experts=self.moe_experts, d_model=self.d_model,
                 d_ff=self.d_ff, axis_name=self.moe_axis,
                 capacity_factor=self.moe_capacity_factor,
+                top_k=self.moe_top_k,
                 compute_dtype=dt, name="moe",
             )(h)
             return x + y, aux
@@ -146,6 +148,7 @@ class TransformerLM(nn.Module):
     moe_axis: Optional[str] = None
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1  # 1 = Switch routing, 2 = GShard top-2
     # Megatron-style tensor parallelism: heads + FFN width sharded over this
     # mesh axis in every block (embeddings and lm_head stay replicated).
     # Train with the global-objective pattern (parallel/tensor.py docstring).
@@ -198,6 +201,7 @@ class TransformerLM(nn.Module):
                 moe_experts=self.moe_experts if is_moe else 0,
                 moe_axis=self.moe_axis,
                 moe_capacity_factor=self.moe_capacity_factor,
+                moe_top_k=self.moe_top_k,
                 tensor_axis=self.tensor_axis,
                 name=f"block_{i}",
             )

@@ -37,11 +37,19 @@ from jax import lax
 
 
 class ExpertParallelMLP(nn.Module):
-    """Top-1-routed MoE FFN with experts sharded over ``axis_name``.
+    """Top-k-routed MoE FFN (k = 1 Switch-style, k = 2 GShard-style) with
+    experts sharded over ``axis_name``.
 
     ``n_experts`` must be divisible by the axis size; each rank owns
     ``n_experts / axis_size`` experts. Call with ``[B, T, D]`` (per-rank
-    local batch); returns ``(out [B, T, D], aux_loss scalar)``.
+    local batch); returns ``(out [B, T, D], aux_loss scalar)``. Routing
+    telemetry — ``drop_frac`` (fraction of expert assignments dropped to
+    the capacity bound, globally averaged) and ``frac_routed`` (per-expert
+    first-choice load) — is sown into the ``"moe_stats"`` collection:
+    ``model.apply(..., mutable=["moe_stats"])`` surfaces it without
+    changing the return contract. Silent drops were round 3's gap: at
+    ``capacity_factor=1.25`` an unbalanced early gate can drop a large
+    fraction of tokens with nothing visible in the loss curve.
     """
 
     n_experts: int
@@ -49,6 +57,15 @@ class ExpertParallelMLP(nn.Module):
     d_ff: int
     axis_name: str
     capacity_factor: float = 1.25
+    # top_k=2: each token goes to its two best experts; combine weights are
+    # the two gate probs renormalized to sum to 1 (top_k=1 keeps the raw
+    # Switch-style p1). Second choices get strictly lower capacity priority
+    # than every first choice.
+    top_k: int = 1
+    # Aux loss statistics reduced over the expert axis (pmean) so the
+    # balance objective is the global Switch loss, not the mean of per-shard
+    # products (those differ when shards see different token mixes).
+    global_aux: bool = True
     compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -56,6 +73,8 @@ class ExpertParallelMLP(nn.Module):
         b, t, d = x.shape
         if d != self.d_model:
             raise ValueError(f"input dim {d} != d_model {self.d_model}")
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
         n_ranks = lax.psum(1, self.axis_name)
         if self.n_experts % n_ranks:
             raise ValueError(
@@ -64,52 +83,82 @@ class ExpertParallelMLP(nn.Module):
         local_e = self.n_experts // n_ranks
         tokens = x.reshape(b * t, d).astype(self.compute_dtype)
         n_tok = b * t
+        kk = self.top_k
 
-        # --- gate: top-1 expert per token ------------------------------ #
+        # --- gate: top-k experts per token ----------------------------- #
         gate_logits = nn.Dense(self.n_experts, dtype=self.compute_dtype,
                                name="gate")(tokens)
         gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-        expert_idx = jnp.argmax(gate_probs, axis=-1)            # [n_tok]
-        gate_val = jnp.take_along_axis(
-            gate_probs, expert_idx[:, None], axis=-1
-        )[:, 0]                                                  # [n_tok]
+        topk_probs, topk_idx = lax.top_k(gate_probs, kk)  # [n_tok, k]
+        if kk == 1:
+            combine_w = topk_probs                         # raw p1 (Switch)
+        else:
+            combine_w = topk_probs / topk_probs.sum(-1, keepdims=True)
 
-        # Switch-style load-balance aux loss (computed over the LOCAL shard;
-        # the trainer's loss mean over ranks makes it global)
+        # Load-balance aux loss (Switch form over FIRST choices). With
+        # global_aux the statistics are pmean'd over the axis first, so the
+        # objective is exactly n_e * <frac_routed, mean_prob> of the global
+        # batch.
         frac_routed = jnp.mean(
-            jax.nn.one_hot(expert_idx, self.n_experts, dtype=jnp.float32), axis=0
+            jax.nn.one_hot(topk_idx[:, 0], self.n_experts,
+                           dtype=jnp.float32), axis=0
         )
         mean_prob = jnp.mean(gate_probs, axis=0)
+        if self.global_aux:
+            frac_routed = lax.pmean(frac_routed, self.axis_name)
+            mean_prob = lax.pmean(mean_prob, self.axis_name)
         aux_loss = self.n_experts * jnp.sum(frac_routed * mean_prob)
 
         # --- capacity-bounded dispatch --------------------------------- #
-        capacity = int(max(1, (n_tok + self.n_experts - 1) // self.n_experts
-                           * self.capacity_factor))
-        # position of each token within its expert's queue
-        one_hot = jax.nn.one_hot(expert_idx, self.n_experts,
-                                 dtype=jnp.int32)                # [n_tok, E]
+        capacity = int(max(1, (kk * n_tok + self.n_experts - 1)
+                           // self.n_experts * self.capacity_factor))
+        # One dispatch row per (token, choice) pair, COPY-MAJOR: all first
+        # choices before all second choices, so when capacity binds the
+        # second choices are dropped first (GShard priority).
+        flat_idx = topk_idx.T.reshape(-1)                # [k * n_tok]
+        one_hot = jax.nn.one_hot(flat_idx, self.n_experts,
+                                 dtype=jnp.int32)        # [k*n_tok, E]
         pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1) * one_hot
-        pos = jnp.sum(pos_in_expert, axis=-1)                    # [n_tok]
-        keep = pos < capacity                                    # overflow drop
+        pos = jnp.sum(pos_in_expert, axis=-1)            # [k * n_tok]
+        keep = pos < capacity                            # overflow drop
+
+        # telemetry: fraction of assignments dropped, globally averaged —
+        # sown (not returned) so the (out, aux) contract is unchanged.
+        # NOT during init: sowing there would bake a stale "moe_stats"
+        # collection into the init output, polluting the param tree and
+        # shadowing apply-time values (sow APPENDS to existing entries).
+        if not self.is_initializing():
+            drop_frac = lax.pmean(1.0 - jnp.mean(keep.astype(jnp.float32)),
+                                  self.axis_name)
+            self.sow("moe_stats", "drop_frac", drop_frac)
+            self.sow("moe_stats", "frac_routed", frac_routed)
 
         # dispatch[e, c, d]: token payload bound for expert e at slot c.
-        # Dropped tokens scatter to index == size: genuinely out of bounds,
-        # so mode="drop" discards them (-1 would WRAP to the last slot).
+        # Dropped assignments scatter to index == size: genuinely out of
+        # bounds, so mode="drop" discards them (-1 would WRAP to the last
+        # slot).
         n_slots = self.n_experts * capacity
         dispatch = jnp.zeros((n_slots, d), tokens.dtype)
-        scatter_idx = jnp.where(keep, expert_idx * capacity + pos, n_slots)
-        dispatch = dispatch.at[scatter_idx].set(tokens, mode="drop")
+        scatter_idx = jnp.where(keep, flat_idx * capacity + pos, n_slots)
+        payload = jnp.tile(tokens, (kk, 1))              # copy-major order
+        dispatch = dispatch.at[scatter_idx].set(payload, mode="drop")
         dispatch = dispatch.reshape(self.n_experts, capacity, d)
 
         # --- move tokens to their expert's rank ------------------------ #
-        # [n_ranks, local_e, C, D] --all_to_all(split 0, concat 1)-->
-        # [local_e, n_ranks, C, D]: rank r receives, for each local expert,
-        # every source rank's capacity block (the EP analog of the
-        # parallel-conv alltoall).
-        shaped = dispatch.reshape(n_ranks, local_e, capacity, d)
-        recv = lax.all_to_all(shaped, self.axis_name, split_axis=0,
-                              concat_axis=1, tiled=False)
-        recv = recv.reshape(local_e, n_ranks * capacity, d)
+        # Row-exchange all_to_all (split_axis == concat_axis == 0, tiled):
+        # row r of the send buffer is this rank's capacity block for rank
+        # r's experts; after the exchange, row s holds rank s's block for
+        # MY experts. This form is its own transpose, so the backward pass
+        # is the identical collective (the split!=concat form has a VJP
+        # cotangent-layout bug upstream for local_e > 1, caught by
+        # test_gradients_flow_multi_expert_per_rank).
+        send = dispatch.reshape(n_ranks, local_e * capacity, d)
+        recv = lax.all_to_all(send, self.axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
+        # [n_ranks, local_e, C, D] -> [local_e, n_ranks*C, D]: each local
+        # expert batches every source rank's slots through one einsum
+        recv = recv.reshape(n_ranks, local_e, capacity, d)
+        recv = recv.transpose(1, 0, 2, 3).reshape(local_e, n_ranks * capacity, d)
 
         # --- per-expert FFN (batched einsum: one MXU-friendly matmul) -- #
         # Expert weights are declared GLOBAL [n_experts, ...] and each rank
@@ -140,19 +189,22 @@ class ExpertParallelMLP(nn.Module):
         h = nn.relu(jnp.einsum("ecd,edf->ecf", recv, local(w1)) + local(b1))
         out = jnp.einsum("ecf,efd->ecd", h, local(w2)) + local(b2)
 
-        # --- route results back (transposed all_to_all) ----------------- #
-        # [local_e, n_ranks, C, D] --all_to_all(split 1, concat 0)-->
-        # [n_ranks, local_e, C, D]: back on the sender, expert-major order
-        # (n_ranks * local_e == E) matches the dispatch layout exactly.
+        # --- route results back (the same row exchange, inverted) ------- #
+        # [local_e, n_ranks, C, D] -> rows by source rank -> exchange:
+        # back on the sender, row r holds r's experts' results for my
+        # tokens — global-expert-major order matches the dispatch layout.
         out = out.reshape(local_e, n_ranks, capacity, d)
-        back = lax.all_to_all(out, self.axis_name, split_axis=1,
-                              concat_axis=0, tiled=False)
+        out = out.transpose(1, 0, 2, 3).reshape(n_ranks, local_e * capacity, d)
+        back = lax.all_to_all(out, self.axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
         back = back.reshape(n_slots, d)
 
-        # gather each token's slot; dropped tokens read index n_slots ->
-        # fill 0 (identity through the residual path)
+        # gather each assignment's slot; dropped assignments read index
+        # n_slots -> fill 0 (identity through the residual path), then the
+        # k copies combine weighted by their (re)normalized gate probs
         combined = back.at[scatter_idx].get(mode="fill", fill_value=0.0)
-        y = combined * gate_val[:, None].astype(combined.dtype)
+        w = combine_w.T.reshape(-1)[:, None].astype(combined.dtype)
+        y = (combined * w).reshape(kk, n_tok, d).sum(axis=0)
         return y.reshape(b, t, d).astype(x.dtype), aux_loss
 
 

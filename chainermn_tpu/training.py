@@ -284,7 +284,12 @@ def jit_lm_train_step(
     moe_aux_weight: float = 0.01,
 ) -> Callable:
     """Jitted next-token-prediction step for :class:`TransformerLM`-shaped
-    models. Call as ``step(params, opt_state, tokens, targets)``.
+    models. Call as ``step(params, opt_state, tokens, targets)`` ->
+    ``(params, opt_state, loss)`` — MoE models return a fourth element,
+    ``{'moe_drop_frac': ...}``: the globally-averaged fraction of expert
+    assignments dropped to the capacity bound this step (silent drops were
+    round 3's telemetry gap — log it; a persistently high value means the
+    gate is unbalanced or capacity_factor is too small).
 
     ``shard_sequence=False``: batch axis sharded over the mesh (pure DP).
     ``shard_sequence=True``: the SEQUENCE axis is sharded (context
@@ -342,25 +347,46 @@ def jit_lm_train_step(
 
         def loss_fn(p):
             if moe_experts:
-                logits, aux = model.apply(p, tokens, pos_offset, return_aux=True)
+                (logits, aux), sown = model.apply(
+                    p, tokens, pos_offset, return_aux=True,
+                    mutable=["moe_stats"],
+                )
             else:
-                logits, aux = model.apply(p, tokens, pos_offset), 0.0
+                logits, aux, sown = model.apply(p, tokens, pos_offset), 0.0, {}
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets
             ).mean()
-            return ce + moe_aux_weight * aux
+            return ce + moe_aux_weight * aux, sown
 
-        loss, grads = jax.value_and_grad(loss_fn)(params_v)
+        (loss, sown), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params_v)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, new_opt_state, comm.allreduce(loss, "mean")
+        loss = comm.allreduce(loss, "mean")
+        if not moe_experts:
+            return params, new_opt_state, loss
+        # routing telemetry: mean drop fraction over the MoE layers (each
+        # leaf is already pmean'd over the expert axis inside the module).
+        # sow() appends, so take the LAST leaf per (tuple-valued) entry in
+        # case the caller's variables carried stale stats in.
+        entries = [v for path, v in jax.tree_util.tree_flatten_with_path(
+            sown, is_leaf=lambda x: isinstance(x, tuple))[0]
+            if "drop_frac" in jax.tree_util.keystr(path)]
+        drops = [e[-1] if isinstance(e, tuple) else e for e in entries]
+        # moe_experts set but no layer actually MoE (e.g. n_layers=1 with
+        # moe_every=2): no assignments, no drops — report 0, don't crash
+        stats = {"moe_drop_frac": (jnp.mean(jnp.stack(drops)) if drops
+                                   else jnp.float32(0.0))}
+        return params, new_opt_state, loss, stats
 
     data = P(None, comm.axis_name) if shard_sequence else comm.data_spec
     opt_spec = getattr(optimizer, "state_spec", P())
+    out_specs = ((P(), opt_spec, P(), P()) if moe_experts
+                 else (P(), opt_spec, P()))
     sm = comm.shard_map(
         body,
         in_specs=(P(), opt_spec, data, data),
-        out_specs=(P(), opt_spec, P()),
+        out_specs=out_specs,
         # Pallas interpret mode can't thread varying-manner metadata through
         # kernel-internal literals (JAX suggests check_vma=False as the
         # workaround); semantics are unchanged, only the static check is off.

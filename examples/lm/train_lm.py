@@ -67,6 +67,8 @@ def main() -> None:
                              "(context parallelism); needs ring/ulysses")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="expert-parallel MoE FFN every 2nd block")
+    parser.add_argument("--moe-top-k", type=int, default=1, choices=[1, 2],
+                        help="1 = Switch routing, 2 = GShard top-2")
     parser.add_argument("--tensor-parallel", action="store_true",
                         help="Megatron-style TP: heads + FFN width sharded "
                              "over the mesh axis, batch replicated "
@@ -104,6 +106,7 @@ def main() -> None:
         sequence_axis=comm.axis_name if args.seq_parallel else None,
         moe_experts=args.moe_experts,
         moe_axis=comm.axis_name if args.moe_experts else None,
+        moe_top_k=args.moe_top_k,
         tensor_axis=comm.axis_name if args.tensor_parallel else None,
         vocab_parallel_head=args.vocab_parallel_head,
         compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
@@ -183,8 +186,10 @@ def main() -> None:
     first = last = None
     for it in range(1, args.iterations + 1):
         tok, tgt = next(gen)
-        params, opt_state, loss = step(params, opt_state,
-                                       jnp.asarray(tok), jnp.asarray(tgt))
+        out = step(params, opt_state, jnp.asarray(tok), jnp.asarray(tgt))
+        # MoE steps return routing telemetry as a 4th element
+        params, opt_state, loss = out[:3]
+        stats = out[3] if len(out) > 3 else {}
         if it == 1:
             jax.block_until_ready(loss)
             first = float(loss)
@@ -195,8 +200,10 @@ def main() -> None:
         toks += tok.size
         if it % 20 == 0 and comm.rank == 0:
             last = float(loss)
+            drop = (f"  moe_drop {float(stats['moe_drop_frac']):.1%}"
+                    if stats else "")
             print(f"iter {it:4d}  loss {last:.3f}  "
-                  f"{toks / (time.time() - t0):.0f} tok/s")
+                  f"{toks / (time.time() - t0):.0f} tok/s{drop}")
     last = float(loss)
     if comm.rank == 0:
         print(f"done: {args.iterations} iterations, "

@@ -183,13 +183,16 @@ def test_lm_train_step_data_parallel(comm):
     assert float(l2) < float(l1)
 
 
-def test_moe_lm_trains(comm):
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_lm_trains(comm, top_k):
     """MoE TransformerLM (every 2nd block expert-routed over the mesh axis):
-    the step adds the Switch aux loss and the model learns."""
+    the step adds the Switch aux loss, surfaces routing telemetry as a 4th
+    output, and the model learns — top-1 and top-2 routing."""
     model = TransformerLM(
         vocab_size=64, d_model=32, n_heads=8, n_layers=2, max_len=256,
         attention="full", compute_dtype=jnp.float32,
         moe_experts=comm.size, moe_axis=comm.axis_name, moe_every=2,
+        moe_top_k=top_k,
     )
     rng = np.random.RandomState(1)
     tokens = jnp.asarray(rng.randint(0, 64, (comm.size * 2, 16)), jnp.int32)
@@ -206,10 +209,13 @@ def test_moe_lm_trains(comm):
     step = jit_lm_train_step(model, opt, comm, shard_sequence=False)
     losses = []
     for _ in range(6):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        params, opt_state, loss, stats = step(
+            params, opt_state, tokens, targets)
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+    drop = float(stats["moe_drop_frac"])
+    assert 0.0 <= drop <= 1.0, drop
 
 
 def test_moe_lm_rejects_wrong_axis(comm):
