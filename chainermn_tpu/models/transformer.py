@@ -44,6 +44,10 @@ class TransformerBlock(nn.Module):
     moe_axis: Optional[str] = None
     moe_capacity_factor: float = 1.25
     moe_top_k: int = 1
+    # 'ep' = shard_map ExpertParallelMLP (explicit all_to_all; needs
+    # moe_axis bound); 'gshard' = einsum-dispatch GShardMoE for plain-jit
+    # GSPMD execution (expert stacks shardable at rest; see parallel/gspmd)
+    moe_impl: str = "ep"
     # tensor_axis set -> Megatron-style block: head-sharded attention +
     # column/row FFN from parallel.tensor, one psum each. Train with the
     # global-objective pattern (tensor.py docstring), NOT the pcast/varying
@@ -109,13 +113,29 @@ class TransformerBlock(nn.Module):
 
         h = nn.LayerNorm(dtype=dt)(x)
         if self.moe_experts:
-            y, aux = ExpertParallelMLP(
-                n_experts=self.moe_experts, d_model=self.d_model,
-                d_ff=self.d_ff, axis_name=self.moe_axis,
-                capacity_factor=self.moe_capacity_factor,
-                top_k=self.moe_top_k,
-                compute_dtype=dt, name="moe",
-            )(h)
+            if self.moe_impl not in ("ep", "gshard"):
+                raise ValueError(
+                    f"moe_impl must be 'ep' or 'gshard', got "
+                    f"{self.moe_impl!r}"
+                )
+            if self.moe_impl == "gshard":
+                from chainermn_tpu.parallel.moe import GShardMoE
+
+                y, aux = GShardMoE(
+                    n_experts=self.moe_experts, d_model=self.d_model,
+                    d_ff=self.d_ff,
+                    capacity_factor=self.moe_capacity_factor,
+                    top_k=self.moe_top_k,
+                    compute_dtype=dt, name="moe",
+                )(h)
+            else:
+                y, aux = ExpertParallelMLP(
+                    n_experts=self.moe_experts, d_model=self.d_model,
+                    d_ff=self.d_ff, axis_name=self.moe_axis,
+                    capacity_factor=self.moe_capacity_factor,
+                    top_k=self.moe_top_k,
+                    compute_dtype=dt, name="moe",
+                )(h)
             return x + y, aux
         h = nn.Dense(self.d_ff, dtype=dt)(h)
         h = nn.gelu(h)
@@ -149,6 +169,10 @@ class TransformerLM(nn.Module):
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
     moe_top_k: int = 1  # 1 = Switch routing, 2 = GShard top-2
+    # 'ep': shard_map ExpertParallelMLP over moe_axis (explicit all_to_all).
+    # 'gshard': einsum-dispatch GShardMoE for the plain-jit GSPMD step
+    # (parallel/gspmd) — expert stacks shard at rest, no moe_axis needed.
+    moe_impl: str = "ep"
     # Megatron-style tensor parallelism: heads + FFN width sharded over this
     # mesh axis in every block (embeddings and lm_head stay replicated).
     # Train with the global-objective pattern (parallel/tensor.py docstring).
@@ -202,6 +226,7 @@ class TransformerLM(nn.Module):
                 moe_axis=self.moe_axis,
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_top_k=self.moe_top_k,
+                moe_impl=self.moe_impl,
                 tensor_axis=self.tensor_axis,
                 name=f"block_{i}",
             )

@@ -12,7 +12,13 @@ from chainermn_tpu.parallel.fsdp import (
     fsdp_spec,
     jit_fsdp_train_step,
 )
-from chainermn_tpu.parallel.moe import ExpertParallelMLP
+from chainermn_tpu.parallel.moe import ExpertParallelMLP, GShardMoE
+from chainermn_tpu.parallel.gspmd import (
+    gspmd_lm_train_step,
+    megatron_opt_shard,
+    megatron_param_specs,
+    megatron_shard,
+)
 from chainermn_tpu.parallel.tensor import (
     ColumnParallelDense,
     RowParallelDense,
@@ -35,6 +41,11 @@ __all__ = [
     "make_hierarchical_mesh",
     "make_3d_mesh",
     "ExpertParallelMLP",
+    "GShardMoE",
+    "gspmd_lm_train_step",
+    "megatron_param_specs",
+    "megatron_shard",
+    "megatron_opt_shard",
     "fsdp_shard",
     "fsdp_spec",
     "jit_fsdp_train_step",

@@ -1,0 +1,261 @@
+"""GSPMD tensor parallelism: Megatron sharding as a *layout*, weights at rest.
+
+Round 3's explicit TP (:mod:`chainermn_tpu.parallel.tensor`) buys compute
+and activation sharding but stores every parameter replicated — at real LM
+sizes the replicated matrices OOM a chip the sharded layout would fit. This
+module closes that gap the most TPU-idiomatic way there is: keep the DENSE
+``TransformerLM`` code, annotate each parameter with its Megatron
+partition (heads and FFN columns over the tensor axis, vocab over the head),
+run the train step under **plain jit**, and let XLA's SPMD partitioner
+insert the collectives the explicit implementation hand-writes. Per-device
+parameter AND optimizer-state bytes drop to ~1/n at rest (proven by
+``sharding.shard_shape`` in tests) — no gather-on-use for the per-block
+matmuls: each consumes exactly its local shard, costing Megatron's two
+psums per block. The vocab-sharded embedding and head DO add collectives
+(a cross-shard lookup gather, and the logits re-materialize across the
+axis for the replicated cross entropy) — the price of storing the two
+largest tables at 1/n.
+
+Two entry points:
+
+- :func:`megatron_param_specs` / :func:`megatron_shard` — the per-leaf
+  ``PartitionSpec`` tree for a dense ``TransformerLM`` param tree (path
+  rules below), and placement onto the communicator's mesh.
+- :func:`gspmd_lm_train_step` — the plain-jit LM train step over those
+  layouts (optional ``dp_axis`` shards the batch for dp x tp on a 2-axis
+  mesh).
+
+Sharding rules (leaves not matched stay replicated — layernorms, biases of
+row-parallel outputs):
+
+====================  =======================  ===========================
+leaf                  shape                    spec
+====================  =======================  ===========================
+``qkv/kernel``        ``[d, 3, H, dh]``        ``P(None, None, tp, None)``
+``qkv/bias``          ``[3, H, dh]``           ``P(None, tp, None)``
+``proj/kernel``       ``[H, dh, d]``           ``P(tp, None, None)``
+``Dense_0/kernel``    ``[d, ff]``              ``P(None, tp)``
+``Dense_0/bias``      ``[ff]``                 ``P(tp)``
+``Dense_1/kernel``    ``[ff, d]``              ``P(tp, None)``
+``lm_head/kernel``    ``[d, V]``               ``P(None, tp)``
+``lm_head/bias``      ``[V]``                  ``P(tp)``
+``embed/embedding``   ``[V, d]``               ``P(tp, None)``
+``moe/w1|w2|b1|b2``   ``[E, ...]``             ``P(tp, ...)`` (expert dim)
+====================  =======================  ===========================
+
+MoE under plain jit uses :class:`GShardMoE` (``TransformerLM(...,
+moe_impl='gshard')``): the einsum-dispatch formulation — no explicit
+``all_to_all``; with the expert stack sharded over the axis the partitioner
+derives the exchange. The shard_map ``ExpertParallelMLP`` remains the
+explicit-collective twin (``moe_impl='ep'``, the default).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+
+
+def _leaf_spec(path: str, shape, tp: str, n: int) -> P:
+    """Megatron spec for one dense-TransformerLM leaf (path rules above);
+    P() when the sharded dim would not divide by ``n``."""
+
+    def ok(dim_idx):
+        return shape[dim_idx] % n == 0
+
+    if path.endswith("qkv/kernel") and len(shape) == 4:
+        return P(None, None, tp, None) if ok(2) else P()
+    if path.endswith("qkv/bias") and len(shape) == 3:
+        return P(None, tp, None) if ok(1) else P()
+    if path.endswith("proj/kernel") and len(shape) == 3:
+        return P(tp, None, None) if ok(0) else P()
+    if path.endswith("Dense_0/kernel") and len(shape) == 2:
+        return P(None, tp) if ok(1) else P()
+    if path.endswith("Dense_0/bias") and len(shape) == 1:
+        return P(tp) if ok(0) else P()
+    if path.endswith("Dense_1/kernel") and len(shape) == 2:
+        return P(tp, None) if ok(0) else P()
+    if path.endswith("lm_head/kernel") and len(shape) == 2:
+        return P(None, tp) if ok(1) else P()
+    if path.endswith("lm_head/bias") and len(shape) == 1:
+        return P(tp) if ok(0) else P()
+    if path.endswith("embed/embedding") and len(shape) == 2:
+        return P(tp, None) if ok(0) else P()
+    # GShard MoE expert stacks: shard the expert dim
+    for name in ("moe/w1", "moe/b1", "moe/w2", "moe/b2"):
+        if path.endswith(name):
+            return (P(tp, *(None,) * (len(shape) - 1))
+                    if shape and ok(0) else P())
+    return P()
+
+
+def _norm_path(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    )
+
+
+def megatron_param_specs(params, tp_axis: str, n_tp: int):
+    """Per-leaf ``PartitionSpec`` tree for a dense ``TransformerLM`` param
+    tree (or any tree using the same layer names)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    leaves = [
+        _leaf_spec(_norm_path(p), jnp.shape(l), tp_axis, n_tp)
+        for p, l in flat[0]
+    ]
+    return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+
+def _resolve_tp_axis(comm: CommunicatorBase, tp_axis: Optional[str]) -> str:
+    axes = comm.axis_name
+    if isinstance(axes, str):
+        if tp_axis is not None and tp_axis != axes:
+            raise ValueError(
+                f"tp_axis {tp_axis!r} is not the communicator's axis {axes!r}")
+        return axes
+    if tp_axis is None or tp_axis not in axes:
+        raise ValueError(
+            f"multi-axis mesh {axes!r}: pass tp_axis= naming the tensor axis")
+    return tp_axis
+
+
+def megatron_shard(params, comm: CommunicatorBase,
+                   tp_axis: Optional[str] = None):
+    """Place a dense-LM param tree (or its optimizer state via
+    :func:`megatron_opt_shard`) in the Megatron at-rest layout."""
+    ax = _resolve_tp_axis(comm, tp_axis)
+    n = comm.mesh.shape[ax]
+    specs = megatron_param_specs(params, ax, n)
+    return jax.tree_util.tree_map(
+        lambda l, s: jax.device_put(l, NamedSharding(comm.mesh, s)),
+        params, specs,
+    )
+
+
+def _opt_specs(optimizer, opt_state, param_specs):
+    """Spec tree for an optimizer state: every params-shaped leaf (adam
+    mu/nu, momentum, ...) gets its parameter's spec; anything else (step
+    counts) is replicated. Single-sourced so placement
+    (:func:`megatron_opt_shard`) and the step's per-iteration constraint
+    can never diverge."""
+    return optax.tree_map_params(
+        optimizer, lambda _, s: s, opt_state, param_specs,
+        transform_non_params=lambda _: P(),
+    )
+
+
+def megatron_opt_shard(optimizer, opt_state, params,
+                       comm: CommunicatorBase,
+                       tp_axis: Optional[str] = None):
+    """Co-shard optimizer state with its parameters (see
+    :func:`_opt_specs`)."""
+    ax = _resolve_tp_axis(comm, tp_axis)
+    n = comm.mesh.shape[ax]
+    specs = megatron_param_specs(params, ax, n)
+    return jax.tree_util.tree_map(
+        lambda l, s: jax.device_put(l, NamedSharding(comm.mesh, s)),
+        opt_state, _opt_specs(optimizer, opt_state, specs),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def gspmd_lm_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    comm: CommunicatorBase,
+    tp_axis: Optional[str] = None,
+    dp_axis: Optional[str] = None,
+    donate: bool = True,
+    moe_aux_weight: float = 0.01,
+) -> Callable:
+    """Plain-jit Megatron-TP LM train step: ``step(params, opt_state,
+    tokens, targets) -> (params, opt_state, loss)``.
+
+    ``params``/``opt_state`` should be placed with :func:`megatron_shard` /
+    :func:`megatron_opt_shard` (the step re-constrains them each iteration,
+    so donation keeps the layout without re-sharding traffic). ``model`` is
+    the DENSE ``TransformerLM`` — no ``tensor_axis``; with
+    ``moe_impl='gshard'`` the expert stacks shard over the same axis.
+    ``dp_axis`` (on a 2-axis mesh) shards the batch for dp x tp; otherwise
+    the batch is replicated (pure TP).
+    """
+    if getattr(model, "tensor_axis", None) is not None or (
+            getattr(model, "sequence_axis", None) is not None):
+        raise ValueError(
+            "gspmd_lm_train_step takes the DENSE model: the partitioner "
+            "derives the TP collectives from the param layout — rebuild "
+            "without tensor_axis/sequence_axis"
+        )
+    if getattr(model, "moe_experts", 0) and (
+            getattr(model, "moe_impl", "ep") != "gshard"):
+        raise ValueError(
+            "MoE under the gspmd step needs moe_impl='gshard' (the "
+            "shard_map ExpertParallelMLP's collectives need an axis "
+            "context plain jit does not bind)"
+        )
+    if getattr(comm, "allreduce_grad_dtype", None) is not None:
+        import warnings
+
+        warnings.warn(
+            "gspmd_lm_train_step ignores the communicator's "
+            f"allreduce_grad_dtype={comm.allreduce_grad_dtype!r}: the "
+            "partitioner inserts this step's collectives in the tensors' "
+            "own dtypes; the compressed-wire knob configures the explicit "
+            "shard_map collective only",
+            stacklevel=2,
+        )
+    ax = _resolve_tp_axis(comm, tp_axis)
+    n = comm.mesh.shape[ax]
+    mesh = comm.mesh
+    moe = bool(getattr(model, "moe_experts", 0))
+    data_spec = P(dp_axis, None) if dp_axis else P()
+
+    def constrain(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.lax.with_sharding_constraint(
+                l, NamedSharding(mesh, s)),
+            tree, specs, is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def step(params, opt_state, tokens, targets):
+        specs = megatron_param_specs(params, ax, n)
+        params = constrain(params, specs)
+        tokens = jax.lax.with_sharding_constraint(
+            tokens, NamedSharding(mesh, data_spec))
+        targets = jax.lax.with_sharding_constraint(
+            targets, NamedSharding(mesh, data_spec))
+
+        def loss_fn(p):
+            if moe:
+                logits, aux = model.apply(p, tokens, 0, return_aux=True)
+            else:
+                logits, aux = model.apply(p, tokens, 0), 0.0
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+            return ce + moe_aux_weight * aux
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = constrain(grads, specs)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = constrain(optax.apply_updates(params, updates), specs)
+        opt_state = constrain(opt_state,
+                              _opt_specs(optimizer, opt_state, specs))
+        return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+__all__ = [
+    "megatron_param_specs",
+    "megatron_shard",
+    "megatron_opt_shard",
+    "gspmd_lm_train_step",
+]

@@ -36,6 +36,40 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _route(gate_probs, n_experts: int, top_k: int, capacity_factor: float):
+    """Shared top-k routing: the ONE home of the combine-weight, capacity,
+    priority, and drop math for both MoE implementations (the shard_map
+    ExpertParallelMLP and the plain-jit GShardMoE are documented numeric
+    twins; keeping this logic single-sourced is what keeps them so).
+
+    ``gate_probs [n_tok, E]`` (f32) ->
+    ``(combine_w [n_tok, k], flat_idx [k*n_tok], pos [k*n_tok],
+    keep [k*n_tok], first_choice_frac [E], capacity)``. Assignments are
+    copy-major (all first choices before all second choices), so when
+    capacity binds the second choices drop first (GShard priority).
+    top_k=1 keeps the raw Switch-style p1 combine weight; top_k=2
+    renormalizes the two probs to sum to 1.
+    """
+    if top_k not in (1, 2):
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
+    n_tok = gate_probs.shape[0]
+    topk_probs, topk_idx = lax.top_k(gate_probs, top_k)
+    if top_k == 1:
+        combine_w = topk_probs
+    else:
+        combine_w = topk_probs / topk_probs.sum(-1, keepdims=True)
+    first_choice_frac = jnp.mean(
+        jax.nn.one_hot(topk_idx[:, 0], n_experts, dtype=jnp.float32), axis=0
+    )
+    capacity = int(max(1, (top_k * n_tok + n_experts - 1)
+                       // n_experts * capacity_factor))
+    flat_idx = topk_idx.T.reshape(-1)                    # [k * n_tok]
+    one_hot = jax.nn.one_hot(flat_idx, n_experts, dtype=jnp.int32)
+    pos = jnp.sum((jnp.cumsum(one_hot, axis=0) - 1) * one_hot, axis=-1)
+    keep = pos < capacity
+    return combine_w, flat_idx, pos, keep, first_choice_frac, capacity
+
+
 class ExpertParallelMLP(nn.Module):
     """Top-k-routed MoE FFN (k = 1 Switch-style, k = 2 GShard-style) with
     experts sharded over ``axis_name``.
@@ -73,8 +107,6 @@ class ExpertParallelMLP(nn.Module):
         b, t, d = x.shape
         if d != self.d_model:
             raise ValueError(f"input dim {d} != d_model {self.d_model}")
-        if self.top_k not in (1, 2):
-            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
         n_ranks = lax.psum(1, self.axis_name)
         if self.n_experts % n_ranks:
             raise ValueError(
@@ -85,42 +117,23 @@ class ExpertParallelMLP(nn.Module):
         n_tok = b * t
         kk = self.top_k
 
-        # --- gate: top-k experts per token ----------------------------- #
+        # --- gate + shared top-k routing (see _route) ------------------ #
         gate_logits = nn.Dense(self.n_experts, dtype=self.compute_dtype,
                                name="gate")(tokens)
         gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-        topk_probs, topk_idx = lax.top_k(gate_probs, kk)  # [n_tok, k]
-        if kk == 1:
-            combine_w = topk_probs                         # raw p1 (Switch)
-        else:
-            combine_w = topk_probs / topk_probs.sum(-1, keepdims=True)
+        combine_w, flat_idx, pos, keep, frac_routed, capacity = _route(
+            gate_probs, self.n_experts, kk, self.capacity_factor
+        )
 
         # Load-balance aux loss (Switch form over FIRST choices). With
         # global_aux the statistics are pmean'd over the axis first, so the
         # objective is exactly n_e * <frac_routed, mean_prob> of the global
         # batch.
-        frac_routed = jnp.mean(
-            jax.nn.one_hot(topk_idx[:, 0], self.n_experts,
-                           dtype=jnp.float32), axis=0
-        )
         mean_prob = jnp.mean(gate_probs, axis=0)
         if self.global_aux:
             frac_routed = lax.pmean(frac_routed, self.axis_name)
             mean_prob = lax.pmean(mean_prob, self.axis_name)
         aux_loss = self.n_experts * jnp.sum(frac_routed * mean_prob)
-
-        # --- capacity-bounded dispatch --------------------------------- #
-        capacity = int(max(1, (kk * n_tok + self.n_experts - 1)
-                           // self.n_experts * self.capacity_factor))
-        # One dispatch row per (token, choice) pair, COPY-MAJOR: all first
-        # choices before all second choices, so when capacity binds the
-        # second choices are dropped first (GShard priority).
-        flat_idx = topk_idx.T.reshape(-1)                # [k * n_tok]
-        one_hot = jax.nn.one_hot(flat_idx, self.n_experts,
-                                 dtype=jnp.int32)        # [k*n_tok, E]
-        pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1) * one_hot
-        pos = jnp.sum(pos_in_expert, axis=-1)            # [k * n_tok]
-        keep = pos < capacity                            # overflow drop
 
         # telemetry: fraction of assignments dropped, globally averaged —
         # sown (not returned) so the (out, aux) contract is unchanged.
@@ -208,4 +221,86 @@ class ExpertParallelMLP(nn.Module):
         return y.reshape(b, t, d).astype(x.dtype), aux_loss
 
 
-__all__ = ["ExpertParallelMLP"]
+class GShardMoE(nn.Module):
+    """Einsum-dispatch MoE FFN for **plain-jit (GSPMD) execution** — the
+    partitioner twin of :class:`ExpertParallelMLP`.
+
+    No explicit collectives: routing is expressed as two dispatch/combine
+    einsums over a ``[tokens, E, C]`` one-hot tensor, so the module traces
+    under plain ``jit`` with no mesh axis bound. Shard the expert stacks
+    ``w1/b1/w2/b2`` over a mesh axis at rest
+    (:func:`chainermn_tpu.parallel.gspmd.megatron_param_specs` does this
+    for ``TransformerLM(moe_impl='gshard')``) and XLA derives the token
+    exchange the explicit implementation hand-writes — weights at rest are
+    1/n per device, which the replicated-expert-stack EP module cannot do.
+
+    Same contract as ExpertParallelMLP: ``(out [B,T,D], aux_loss)``, with
+    ``drop_frac`` / ``frac_routed`` sown into ``"moe_stats"``. Top-1 and
+    top-2 routing with the same priority and combine-weight semantics.
+    """
+
+    n_experts: int
+    d_model: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    top_k: int = 1
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        if d != self.d_model:
+            raise ValueError(f"input dim {d} != d_model {self.d_model}")
+        tokens = x.reshape(b * t, d).astype(self.compute_dtype)
+        n_tok = b * t
+        kk = self.top_k
+
+        gate_logits = nn.Dense(self.n_experts, dtype=self.compute_dtype,
+                               name="gate")(tokens)
+        gate_probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+        combine_p, flat_idx, pos, keep, frac_routed, capacity = _route(
+            gate_probs, self.n_experts, kk, self.capacity_factor
+        )
+        # the whole (global) batch is visible under plain jit, so the aux
+        # statistics are global with no pmean
+        mean_prob = jnp.mean(gate_probs, axis=0)
+        aux_loss = self.n_experts * jnp.sum(frac_routed * mean_prob)
+
+        if not self.is_initializing():
+            self.sow("moe_stats", "drop_frac",
+                     1.0 - jnp.mean(keep.astype(jnp.float32)))
+            self.sow("moe_stats", "frac_routed", frac_routed)
+
+        # dispatch[a, e, c] = 1 iff assignment a goes to expert e slot c
+        dispatch = (jax.nn.one_hot(flat_idx, self.n_experts,
+                                   dtype=tokens.dtype)[:, :, None]
+                    * jax.nn.one_hot(pos, capacity, dtype=tokens.dtype
+                                     )[:, None, :]
+                    * keep[:, None, None].astype(tokens.dtype))
+        payload = jnp.tile(tokens, (kk, 1))              # [k*n_tok, D]
+        expert_in = jnp.einsum("ad,aec->ecd", payload, dispatch)
+
+        expert_init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", batch_axis=(0,)
+        )
+        w1 = self.param("w1", expert_init,
+                        (self.n_experts, d, self.d_ff), self.compute_dtype)
+        b1 = self.param("b1", nn.initializers.zeros,
+                        (self.n_experts, 1, self.d_ff), self.compute_dtype)
+        w2 = self.param("w2", expert_init,
+                        (self.n_experts, self.d_ff, d), self.compute_dtype)
+        b2 = self.param("b2", nn.initializers.zeros,
+                        (self.n_experts, 1, d), self.compute_dtype)
+        h = nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, w1) + b1)
+        out = jnp.einsum("ecf,efd->ecd", h, w2) + b2
+
+        # combine: weight each assignment's slot by its gate prob and sum
+        # the k copies per token
+        w = combine_p.T.reshape(-1)                      # [k * n_tok]
+        combined = jnp.einsum("ecd,aec->ad", out,
+                              dispatch * w[:, None, None].astype(out.dtype))
+        y = combined.reshape(kk, n_tok, d).sum(axis=0)
+        return y.reshape(b, t, d).astype(x.dtype), aux_loss
+
+
+__all__ = ["ExpertParallelMLP", "GShardMoE"]
