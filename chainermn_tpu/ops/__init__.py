@@ -7,6 +7,19 @@ TPU-idiomatic extensions (microbatched pipeline schedule).
 """
 
 from chainermn_tpu.ops.flash_attention import flash_attention
-from chainermn_tpu.ops.pipeline import pipeline_apply
+from chainermn_tpu.ops.pipeline import (
+    init_pipeline_lm,
+    jit_pp_lm_train_step,
+    make_pipeline_lm,
+    pipeline_apply,
+    pp_lm_opt_init,
+)
 
-__all__ = ["flash_attention", "pipeline_apply"]
+__all__ = [
+    "flash_attention",
+    "pipeline_apply",
+    "make_pipeline_lm",
+    "init_pipeline_lm",
+    "pp_lm_opt_init",
+    "jit_pp_lm_train_step",
+]

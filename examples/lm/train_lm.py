@@ -50,6 +50,64 @@ def markov_stream(n_tokens: int, vocab: int, order: int = 2, seed: int = 0):
     return out
 
 
+def run_pipeline(args, comm) -> None:
+    """Pipeline-parallel LM: n_stages = mesh size, one causal transformer
+    block resident per rank, stage params stacked P(axis); the GPipe
+    fill-drain schedule microbatches each step (ops.pipeline)."""
+    from chainermn_tpu.ops import (
+        init_pipeline_lm,
+        jit_pp_lm_train_step,
+        make_pipeline_lm,
+        pp_lm_opt_init,
+    )
+
+    n_stages = comm.size
+    mods = make_pipeline_lm(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_stages=n_stages, max_len=args.max_len or max(args.seq_len, 512),
+        compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+        else jnp.float32,
+    )
+    stream = markov_stream(args.n_tokens, args.vocab)
+    n_seq = (len(stream) - 1) // args.seq_len
+    toks = stream[: n_seq * args.seq_len].reshape(n_seq, args.seq_len)
+    tgts = stream[1 : n_seq * args.seq_len + 1].reshape(n_seq, args.seq_len)
+    batch = args.batchsize * args.microbatches
+    if n_seq < batch:
+        raise SystemExit(f"need >= {batch} sequences, have {n_seq}")
+
+    params = init_pipeline_lm(
+        mods, jax.random.PRNGKey(0), jnp.asarray(toks[:1]), n_stages)
+    optimizer = optax.adam(args.lr)
+    opt_state = pp_lm_opt_init(optimizer, params)
+    step = jit_pp_lm_train_step(mods, optimizer, comm,
+                                n_microbatches=args.microbatches)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    bubble = (n_stages - 1) / (args.microbatches + n_stages - 1)
+    if comm.rank == 0:
+        print(f"{n_params / 1e6:.2f}M params  pipeline stages={n_stages} "
+              f"microbatches={args.microbatches} "
+              f"(bubble fraction {bubble:.1%})")
+    t0, toks_seen, first = time.time(), 0, None
+    for it in range(1, args.iterations + 1):
+        i = (it * batch) % max(1, n_seq - batch)
+        tok = jnp.asarray(toks[i : i + batch])
+        tgt = jnp.asarray(tgts[i : i + batch])
+        params, opt_state, loss = step(params, opt_state, tok, tgt)
+        if it == 1:
+            jax.block_until_ready(loss)
+            first = float(loss)
+            t0, toks_seen = time.time(), 0
+            if comm.rank == 0:
+                print(f"compiled; first loss {first:.3f}")
+        toks_seen += tok.size
+        if it % 20 == 0 and comm.rank == 0:
+            print(f"iter {it:4d}  loss {float(loss):.3f}  "
+                  f"{toks_seen / (time.time() - t0):.0f} tok/s")
+    if comm.rank == 0:
+        print(f"done: loss {first:.3f} -> {float(loss):.3f}")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description="ChainerMN-TPU example: LM")
     parser.add_argument("--vocab", type=int, default=64)
@@ -73,6 +131,13 @@ def main() -> None:
                         help="Megatron-style TP: heads + FFN width sharded "
                              "over the mesh axis, batch replicated "
                              "(parallel.tensor; global-objective grads)")
+    parser.add_argument("--pipeline", action="store_true",
+                        help="pipeline parallelism: one transformer block "
+                             "per mesh rank (GPipe fill-drain microbatch "
+                             "schedule; ops.pipeline)")
+    parser.add_argument("--microbatches", type=int, default=8,
+                        help="with --pipeline: microbatches per step "
+                             "(bubble fraction = (S-1)/(M+S-1))")
     parser.add_argument("--vocab-parallel-head", action="store_true",
                         help="with --tensor-parallel: shard the LM head "
                              "over the vocab; full logits are never "
@@ -86,6 +151,19 @@ def main() -> None:
 
     chainermn_tpu.add_global_except_hook()
     comm = chainermn_tpu.create_communicator("tpu")
+    if args.pipeline and (args.seq_parallel or args.moe_experts
+                          or args.tensor_parallel):
+        raise SystemExit("--pipeline uses the whole mesh axis for stages; "
+                         "it does not combine with the other parallel "
+                         "flags in this example")
+    if args.pipeline:
+        if args.n_layers != parser.get_default("n_layers") and (
+                args.n_layers != comm.size):
+            raise SystemExit(
+                f"--pipeline pins the layer count to one block per rank "
+                f"({comm.size} here); --n-layers {args.n_layers} would be "
+                "silently ignored")
+        return run_pipeline(args, comm)
     if args.seq_parallel and args.attention not in ("ring", "ulysses"):
         raise SystemExit("--seq-parallel needs --attention ring|ulysses")
     if args.tensor_parallel and (args.seq_parallel or args.moe_experts):

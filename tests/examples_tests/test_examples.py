@@ -124,6 +124,17 @@ def test_train_lm_tensor_parallel():
     assert "done: 25 iterations" in proc.stdout
 
 
+def test_train_lm_pipeline():
+    proc = run_example(
+        "lm/train_lm.py",
+        ["--iterations", "25", "--pipeline", "--microbatches", "4",
+         "--seq-len", "32", "--d-model", "32", "--n-heads", "4",
+         "--batchsize", "2", "--n-tokens", "20000"],
+    )
+    assert "pipeline stages=" in proc.stdout
+    assert "done: loss" in proc.stdout
+
+
 def test_train_imagenet():
     proc = run_example(
         "imagenet/train_imagenet.py",
