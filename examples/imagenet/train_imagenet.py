@@ -153,10 +153,15 @@ def main() -> None:
     parser.add_argument("--val-frac", type=float, default=None,
                         help="held-out fraction for top-1 eval "
                              "(recipe default: 0.02)")
-    parser.add_argument("--native-loader", action="store_true",
+    parser.add_argument("--native-loader", action=argparse.BooleanOptionalAction,
+                        default=None,
                         help="C++ batch assembly (gather + fused uint8->f32 "
                              "normalize, GIL-free threads) with one-batch "
-                             "prefetch — the MultiprocessIterator slot")
+                             "prefetch — the MultiprocessIterator slot. "
+                             "Defaults ON under --recipe, where a failed "
+                             "extension build degrades (all ranks together) "
+                             "to numpy; an EXPLICIT --native-loader fails "
+                             "hard instead")
     parser.add_argument("--fsdp", action="store_true",
                         help="ZeRO-3 layout: params/grads/moments scattered "
                              "over the data axis, XLA-partitioner-inserted "
@@ -178,6 +183,12 @@ def main() -> None:
             args.label_smoothing = 0.1
         if args.val_frac is None:
             args.val_frac = 0.02
+    # None = unspecified: the recipe defaults the native loader ON (the
+    # measured ~3x assembly win, PERF.md); an explicit True keeps hard
+    # errors, an explicit False (--no-native-loader) forces numpy
+    native_explicit = args.native_loader is True
+    if args.native_loader is None:
+        args.native_loader = bool(args.recipe)
     args.warmup_epochs = args.warmup_epochs or 0.0
     args.label_smoothing = args.label_smoothing or 0.0
     args.val_frac = args.val_frac or 0.0
@@ -234,16 +245,34 @@ def main() -> None:
     global_batch = args.batchsize * comm.size
     ensure_batch_fits(train, global_batch, comm.size)
     if args.native_loader:
-        from chainermn_tpu.native.dataloader import NativeBatchLoader
+        try:
+            from chainermn_tpu.native.dataloader import NativeBatchLoader
 
-        # zero-copy view of the shard: the C++ path gathers rows from the
-        # base array, fuses the normalize, and prefetches one batch ahead
-        base, rows, ys = record_source(train)
-        it = NativeBatchLoader(base, ys, global_batch, rows=rows,
-                               shuffle=True, seed=1)
-        batches = iter(it)
-    else:
+            # zero-copy view of the shard: the C++ path gathers rows from
+            # the base array, fuses the normalize, prefetches a batch ahead
+            base, rows, ys = record_source(train)
+            native_it = NativeBatchLoader(base, ys, global_batch, rows=rows,
+                                          shuffle=True, seed=1)
+        except Exception as e:  # toolchain/build failure on THIS rank
+            if native_explicit:
+                raise  # an explicit opt-in must not silently degrade
+            # per-rank diagnostic: rank 0's banner can't see this failure
+            print(f"[rank {comm.rank}] native loader unavailable "
+                  f"({type(e).__name__}: {e})", flush=True)
+            native_it = None
+        # the step/evaluate cadence is collective — every rank must take
+        # the SAME input path, so agree before choosing (one rank's build
+        # failure would otherwise desync step counts and hang the job)
+        args.native_loader = comm.allreduce_obj(
+            native_it is not None, lambda a, b: a and b)
+        if args.native_loader:
+            it = native_it
+            batches = iter(it)
+    if not args.native_loader:
         it = chainermn_tpu.SerialIterator(train, global_batch, shuffle=True, seed=1)
+    if comm.rank == 0:
+        print(f"input pipeline: "
+              f"{'native C++ prefetch' if args.native_loader else 'numpy'}")
 
     sample = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.bfloat16)
     variables = comm.bcast_data(

@@ -156,6 +156,9 @@ def test_train_imagenet_recipe():
     )
     assert "top-1" in proc.stdout
     assert "epoch   2" in proc.stdout
+    # the recipe defaults to the native C++ loader (numpy fallback only
+    # when the extension can't build — this image has the toolchain)
+    assert "input pipeline: native C++ prefetch" in proc.stdout
 
 
 def test_train_imagenet_mnbn_double_buffering():
