@@ -311,6 +311,15 @@ def clip_by_global_norm_sharded(
     adam(lr))`` — pinned in tests. Outside a traced mesh context it is an
     error (the psum needs the axis); use plain optax clipping for
     replicated gradients.
+
+    Composed against REPLICATED gradients inside a traced step (e.g. under
+    ``create_multi_node_optimizer`` instead of ``create_zero_optimizer``):
+    with replication tracking on (``check_vma=True``, the default) the
+    transform detects invariant leaves via their varying-manner set and
+    divides their contribution by the axis size, so the norm stays exact.
+    With ``check_vma=False`` that information does not exist — the psum
+    then sums n identical replicas and clips by a sqrt(n)-inflated norm
+    with no error; keep this transform inside the ZeRO chain there.
     """
     import jax.numpy as jnp
 
@@ -320,10 +329,39 @@ def clip_by_global_norm_sharded(
 
     def update(updates, state, params=None):
         del params
-        local_sq = sum(
-            jnp.sum(jnp.square(g.astype(jnp.float32)))
-            for g in jax.tree_util.tree_leaves(updates)
-        )
+        leaves = jax.tree_util.tree_leaves(updates)
+        vmas = [frozenset(getattr(jax.typeof(g), "vma", frozenset()) or ())
+                for g in leaves]
+        # vma-aware over-count correction: a leaf NOT varying over a reduce
+        # axis is replicated there — the psum would sum n identical copies
+        # and inflate the norm by sqrt(n) (silent over-clip when this
+        # transform is composed outside its ZeRO home, e.g. under
+        # create_multi_node_optimizer). With replication tracking active
+        # (any leaf carries vma), divide each leaf's contribution by the
+        # sizes of the axes it is invariant over; with tracking off
+        # (check_vma=False — vma sets all empty) the correction cannot be
+        # inferred and the caller owns the contract (docstring).
+        axes = communicator.axis_name
+        axes = (axes,) if isinstance(axes, str) else tuple(axes)
+        # detect whether replication tracking is live: a pcast probe gets a
+        # varying-manner set iff check_vma is on (any(vmas) alone misses
+        # the ALL-replicated case, which is exactly the over-clip hazard)
+        try:
+            probe = jax.lax.pcast(jnp.zeros(()), axes, to="varying")
+            tracking = bool(frozenset(getattr(jax.typeof(probe), "vma",
+                                              frozenset()) or ()))
+        except Exception:
+            tracking = any(vmas)
+
+        def leaf_sq(g, vma):
+            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if tracking:
+                for ax in axes:
+                    if ax not in vma:
+                        s = s / communicator.mesh.shape[ax]
+            return s
+
+        local_sq = sum(leaf_sq(g, v) for g, v in zip(leaves, vmas))
         # through the communicator, not a raw lax.psum: split()
         # sub-communicators then reduce over THEIR group only, and
         # multi-axis meshes reduce over all their axes

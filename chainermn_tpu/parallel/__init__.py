@@ -24,6 +24,7 @@ from chainermn_tpu.parallel.tensor import (
     RowParallelDense,
     TensorParallelAttention,
     TensorParallelMLP,
+    reshard_tp_qkv,
 )
 from chainermn_tpu.parallel.sequence import (
     full_attention,
@@ -53,6 +54,7 @@ __all__ = [
     "RowParallelDense",
     "TensorParallelAttention",
     "TensorParallelMLP",
+    "reshard_tp_qkv",
     "full_attention",
     "ring_attention",
     "ulysses_attention",

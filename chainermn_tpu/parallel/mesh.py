@@ -83,6 +83,31 @@ def make_hierarchical_mesh(
     return Mesh(arr, (inter_axis, intra_axis))
 
 
+def _straddle_warning(shape, proc_counts: dict[int, int], n: int):
+    """Warning text when an auto-factored (dp, sp, tp) shape's inner axes
+    would straddle host boundaries, else None. Pure function of the chosen
+    shape and the per-process device counts so the policy is testable
+    without multi-host hardware."""
+    if len(proc_counts) <= 1:
+        return None  # host-local mesh: nothing can straddle
+    per_proc = min(proc_counts.values())
+    _, sp, tp = shape
+    if per_proc % tp:
+        straddler = f"tp={tp}"
+    elif sp * tp > per_proc and (sp * tp) % per_proc:
+        straddler = f"sp x tp = {sp * tp}"
+    else:
+        return None
+    return (
+        f"make_3d_mesh auto-factored {n} devices into dp x sp x tp = "
+        f"{tuple(shape)}, but {straddler} does not align with the "
+        f"{per_proc} devices per process ({len(proc_counts)} processes): "
+        "the inner axes will straddle host boundaries and their "
+        "collectives ride DCN — pass shape=(dp, sp, tp) with tp (and "
+        "ideally sp x tp) dividing the per-process device count"
+    )
+
+
 def make_3d_mesh(
     devices: Sequence[jax.Device] | None = None,
     dp_axis: str = "dp",
@@ -102,7 +127,8 @@ def make_3d_mesh(
     pass ``shape`` explicitly with ``tp`` (x ``sp``) dividing the
     per-process device count, or the innermost axes can straddle hosts and
     the per-block psums ride DCN (make_hierarchical_mesh aligns to process
-    boundaries automatically; this heuristic does not).
+    boundaries automatically; this heuristic does not — it WARNS when its
+    auto-chosen tp would straddle).
     """
     devs = _sorted_devices(devices)
     n = len(devs)
@@ -119,6 +145,21 @@ def make_3d_mesh(
                 if max(cand) - min(cand) < max(best) - min(best):
                     best = cand
         shape = best
+        # The balanced factorization is process-oblivious; on a multi-host
+        # pod inner axes that do not divide the per-process device count
+        # straddle hosts and their collectives ride DCN. Surface it instead
+        # of silently degrading (pass shape= to fix). Derive the per-process
+        # count from the devices actually passed (a host-local subset must
+        # not warn against the GLOBAL process count).
+        proc_counts: dict[int, int] = {}
+        for d in devs:
+            pi = getattr(d, "process_index", 0)
+            proc_counts[pi] = proc_counts.get(pi, 0) + 1
+        msg = _straddle_warning(shape, proc_counts, n)
+        if msg is not None:
+            import warnings
+
+            warnings.warn(msg, stacklevel=2)
     if int(np.prod(shape)) != n:
         raise ValueError(f"shape {shape} does not cover {n} devices")
     arr = np.array(devs).reshape(shape)

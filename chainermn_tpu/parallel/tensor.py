@@ -200,7 +200,10 @@ class TensorParallelAttention(nn.Module):
         # thereby DEFINED as (rank, 3, local_head, d_head)-major: rank r's
         # contiguous slice is its own (q, k, v) block for its own heads.
         # Init is i.i.d., so this ordering is as valid as torch/flax's
-        # (3, head, d_head); parity tests permute accordingly.
+        # (3, head, d_head); parity tests permute accordingly. NOTE this
+        # bakes the TP degree into the stored kernel — restoring a
+        # checkpoint at a DIFFERENT degree needs reshard_tp_qkv (restoring
+        # unpermuted silently scrambles q/k/v across heads).
         b, t = qkv.shape[0], qkv.shape[1]
         qkv = qkv.reshape(b, t, 3, local_h, d_head)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -221,6 +224,72 @@ class TensorParallelAttention(nn.Module):
             compute_dtype=self.compute_dtype, name="proj_tprow",
         )(o)
         return (out, new_cache) if kv_cache is not None else out
+
+
+def reshard_tp_qkv(tree, n_heads: int, d_head: int, old_tp: int,
+                   new_tp: int):
+    """Permute a :class:`TensorParallelAttention` checkpoint between TP
+    degrees.
+
+    The fused qkv kernel's column order is DEFINED as
+    ``(rank, 3, local_head, d_head)``-major (see the module body), which
+    bakes the tensor-axis size into the stored weights: restoring a
+    checkpoint trained at one TP degree into a different degree (or into a
+    dense block) silently scrambles q/k/v across heads. This helper
+    re-orders every ``qkv_tpcol`` kernel/bias in ``tree`` from the
+    ``old_tp`` layout to the ``new_tp`` layout via the degree-independent
+    canonical ``(3, head, d_head)`` order (head ownership is contiguous:
+    rank ``r`` owns heads ``[r*h/n, (r+1)*h/n)``). The row-parallel
+    ``proj_tprow`` needs no permutation — its rows are head-major at every
+    degree. Raises if either degree does not divide ``n_heads``.
+    """
+    import jax
+
+    if n_heads % old_tp or n_heads % new_tp:
+        raise ValueError(
+            f"n_heads {n_heads} must divide by both TP degrees "
+            f"({old_tp}, {new_tp})")
+    width = 3 * n_heads * d_head
+
+    def to_canonical(cols, n):
+        # [..., (rank, 3, lh, dh)] -> [..., (3, head, dh)]
+        lead = cols.shape[:-1]
+        c = cols.reshape(*lead, n, 3, n_heads // n, d_head)
+        c = jnp.moveaxis(c, -4, -3)          # [..., 3, n, lh, dh]
+        return c.reshape(*lead, 3, n_heads, d_head)
+
+    def from_canonical(c, n):
+        lead = c.shape[:-3]
+        c = c.reshape(*lead, 3, n, n_heads // n, d_head)
+        c = jnp.moveaxis(c, -3, -4)          # [..., n, 3, lh, dh]
+        return c.reshape(*lead, width)
+
+    n_fixed = 0
+
+    def fix(path, leaf):
+        nonlocal n_fixed
+        keys = jax.tree_util.keystr(path)
+        if "qkv_tpcol" not in keys:
+            return leaf
+        if leaf.shape[-1] != width:
+            # a silent skip here would reproduce the exact scramble this
+            # helper exists to prevent (wrong n_heads/d_head passed)
+            raise ValueError(
+                f"qkv_tpcol leaf at {keys} has last dim {leaf.shape[-1]} "
+                f"but n_heads={n_heads}, d_head={d_head} imply "
+                f"3*h*dh={width} — wrong head geometry for this checkpoint")
+        n_fixed += 1
+        return from_canonical(to_canonical(leaf, old_tp), new_tp)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = jax.tree_util.tree_unflatten(
+        treedef, [fix(p, l) for p, l in flat])
+    if n_fixed == 0:
+        raise ValueError(
+            "reshard_tp_qkv found no 'qkv_tpcol' leaves in the tree — "
+            "nothing was resharded (wrong tree, or a dense checkpoint that "
+            "needs no permutation)")
+    return out
 
 
 def vocab_parallel_cross_entropy(local_logits, targets, axis_name: str):

@@ -254,17 +254,24 @@ def main() -> None:
             native_it = NativeBatchLoader(base, ys, global_batch, rows=rows,
                                           shuffle=True, seed=1)
         except Exception as e:  # toolchain/build failure on THIS rank
-            if native_explicit:
-                raise  # an explicit opt-in must not silently degrade
             # per-rank diagnostic: rank 0's banner can't see this failure
             print(f"[rank {comm.rank}] native loader unavailable "
                   f"({type(e).__name__}: {e})", flush=True)
             native_it = None
         # the step/evaluate cadence is collective — every rank must take
         # the SAME input path, so agree before choosing (one rank's build
-        # failure would otherwise desync step counts and hang the job)
+        # failure would otherwise desync step counts and hang the job).
+        # ALWAYS agree first, even on the explicit-flag failure path: a
+        # lone rank raising before the collective would strand the others
+        # inside it — fail hard on every rank together instead.
         args.native_loader = comm.allreduce_obj(
             native_it is not None, lambda a, b: a and b)
+        if native_explicit and not args.native_loader:
+            raise SystemExit(
+                "--native-loader was explicitly requested but the native "
+                "extension is unavailable on at least one rank (see the "
+                "per-rank diagnostics above); an explicit opt-in must not "
+                "silently measure the numpy path")
         if args.native_loader:
             it = native_it
             batches = iter(it)

@@ -217,3 +217,27 @@ def test_zero_learns(comm):
         variables, opt_state, loss = step(variables, opt_state, images, labels)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_sharded_clip_replicated_grads_exact(comm):
+    """ADVICE r3: composed against REPLICATED gradients inside a traced
+    step, the sharded clip must not sum n identical replicas into a
+    sqrt(n)-inflated norm — with vma tracking on it detects invariant
+    leaves and matches plain optax clipping exactly."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.optimizers import clip_by_global_norm_sharded
+
+    grads = {"w": jnp.full((4,), 3.0), "b": jnp.full((2,), 1.0)}
+    want, _ = optax.clip_by_global_norm(1.0).update(grads, optax.EmptyState())
+
+    def body(g):
+        out, _ = clip_by_global_norm_sharded(1.0, comm).update(
+            g, optax.EmptyState())
+        return out
+
+    got = jax.jit(comm.shard_map(body, in_specs=(P(),), out_specs=P()))(grads)
+    for k in grads:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-6)

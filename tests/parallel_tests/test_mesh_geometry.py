@@ -87,3 +87,39 @@ def test_procs_per_host_contract():
         assert geo2 == geo
     finally:
         del os.environ["CHAINERMN_TPU_PROCS_PER_HOST"]
+
+
+def test_make_3d_mesh_straddle_policy():
+    """ADVICE r3: the auto-factorization is process-oblivious — the
+    straddle check (pure function, testable without multi-host hardware)
+    must flag a tp or sp x tp extent that does not align with the
+    per-process device count, and stay quiet for aligned or host-local
+    meshes."""
+    from chainermn_tpu.parallel.mesh import _straddle_warning
+
+    # host-local (one process): never warns, even with "bad" shapes
+    assert _straddle_warning((2, 2, 2), {0: 8}, 8) is None
+    # 4 processes x 2 devices: tp=2 aligns -> quiet
+    assert _straddle_warning((2, 2, 2), {i: 2 for i in range(4)}, 8) is None
+    # 8 processes x 1 device: tp=2 straddles -> warn, names tp
+    msg = _straddle_warning((2, 2, 2), {i: 1 for i in range(8)}, 8)
+    assert msg is not None and "tp=2" in msg and "straddle" in msg
+    # 8 processes x 4 devices, shape (2, 4, 4): tp divides but
+    # sp*tp=16 spans hosts unevenly -> warn, names sp x tp
+    msg = _straddle_warning((2, 4, 4), {i: 4 for i in range(8)}, 32)
+    assert msg is None or "sp x tp" in msg
+    # sp*tp=16 over per_proc=4: 16 % 4 == 0 -> whole hosts, acceptable
+    assert _straddle_warning((2, 4, 4), {i: 4 for i in range(8)}, 32) is None
+    # per_proc=3 (ragged): tp=2 does not divide 3 -> warn
+    assert _straddle_warning((2, 2, 2), {0: 3, 1: 5}, 8) is not None
+
+
+def test_make_3d_mesh_local_does_not_warn():
+    """The warning must not fire for this single-process CPU mesh."""
+    import warnings
+
+    from chainermn_tpu.parallel import make_3d_mesh
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        make_3d_mesh()

@@ -437,3 +437,37 @@ def test_hybrid_dp_tp_step_trains(comm):
         params, state, lval = jstep(params, state, xs, ys)
         losses.append(float(lval))
     assert losses[-1] < losses[0], losses
+
+
+def test_reshard_tp_qkv_between_degrees():
+    """ADVICE r3: the qkv kernel's column order bakes in the TP degree —
+    reshard_tp_qkv must permute a checkpoint so the serial qkv math at the
+    NEW degree reproduces the old degree's q/k/v exactly, and round-trip."""
+    from chainermn_tpu.parallel import reshard_tp_qkv
+
+    h, dh, d_in = 8, 4, 16
+    width = 3 * h * dh
+    kern = jax.random.normal(jax.random.PRNGKey(0), (d_in, width))
+    bias = jax.random.normal(jax.random.PRNGKey(1), (width,))
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 5, d_in))
+
+    def serial_qkv(k, b, n):
+        qkv = (x @ k + b).reshape(2, 5, n, 3, h // n, dh)
+        return tuple(
+            qkv[:, :, :, i].reshape(2, 5, h, dh) for i in range(3))
+
+    tree8 = {"attn": {"qkv_tpcol": {"kernel": kern, "bias": bias}}}
+    want = serial_qkv(kern, bias, 8)
+    for new in (1, 2, 4):
+        t2 = reshard_tp_qkv(tree8, h, dh, 8, new)
+        got = serial_qkv(t2["attn"]["qkv_tpcol"]["kernel"],
+                         t2["attn"]["qkv_tpcol"]["bias"], new)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6)
+        back = reshard_tp_qkv(t2, h, dh, new, 8)
+        np.testing.assert_array_equal(
+            np.asarray(back["attn"]["qkv_tpcol"]["kernel"]),
+            np.asarray(kern))
+    with pytest.raises(ValueError, match="divide"):
+        reshard_tp_qkv(tree8, h, dh, 8, 3)
