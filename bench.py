@@ -252,7 +252,11 @@ def child_main() -> None:
     deadline = time.time() + float(
         os.environ.get("CHAINERMN_TPU_BENCH_CHILD_BUDGET", "1200")
     )
-    batch = int(os.environ.get("CHAINERMN_TPU_BENCH_BATCH", "0")) or 128 * n_chips
+    # 256/chip, not 128: the AOT roofline (PERF.md round 4) shows this
+    # workload is HBM-bound and arithmetic intensity — batch — is the MFU
+    # lever (ceiling 27% at 128, 31% at 256, 35% at 512). The halving loop
+    # below still degrades gracefully on OOM, so bigger-first is safe.
+    batch = int(os.environ.get("CHAINERMN_TPU_BENCH_BATCH", "0")) or 256 * n_chips
     headline = None
     while batch >= 8:
         try:

@@ -353,12 +353,20 @@ def clip_by_global_norm_sharded(
         except Exception:
             tracking = any(vmas)
 
+        # split() sub-communicators reduce over their GROUP, not the full
+        # mesh axis — the replica count for an invariant leaf is the group
+        # size there (comm.size), the axis extent otherwise
+        group = (communicator.size
+                 if getattr(communicator, "_groups", None) is not None
+                 else None)
+
         def leaf_sq(g, vma):
             s = jnp.sum(jnp.square(g.astype(jnp.float32)))
             if tracking:
                 for ax in axes:
                     if ax not in vma:
-                        s = s / communicator.mesh.shape[ax]
+                        s = s / (group if group is not None
+                                 else communicator.mesh.shape[ax])
             return s
 
         local_sq = sum(leaf_sq(g, v) for g, v in zip(leaves, vmas))

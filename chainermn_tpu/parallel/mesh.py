@@ -92,10 +92,14 @@ def _straddle_warning(shape, proc_counts: dict[int, int], n: int):
         return None  # host-local mesh: nothing can straddle
     per_proc = min(proc_counts.values())
     _, sp, tp = shape
+    # aligned means the inner blocks tile host boundaries exactly: tp must
+    # divide per_proc, and the sp x tp block must either fit evenly inside
+    # a host (divide per_proc) or cover whole hosts (be a multiple of it)
+    sptp = sp * tp
     if per_proc % tp:
         straddler = f"tp={tp}"
-    elif sp * tp > per_proc and (sp * tp) % per_proc:
-        straddler = f"sp x tp = {sp * tp}"
+    elif per_proc % sptp and sptp % per_proc:
+        straddler = f"sp x tp = {sptp}"
     else:
         return None
     return (

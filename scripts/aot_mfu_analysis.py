@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""AOT where-the-time-goes analysis for the ResNet-50 MFU target — no chip
+needed.
+
+The axon tunnel can wedge for whole rounds (PERF.md hazard #2; rounds 3-4
+both lost chip time to it), which blocked every on-device MFU measurement.
+This script gets the analysis anyway: `jax.experimental.topologies` builds
+an abstract **TPU v5e** device, the real XLA TPU compiler AOT-compiles the
+actual training step against it, and the compiled module's cost analysis
+(FLOPs + HBM bytes accessed) feeds a roofline model:
+
+    t_compute = flops / peak_bf16        (v5e: 197 TFLOP/s)
+    t_memory  = bytes / hbm_bw           (v5e: 819 GB/s)
+    mfu_ceiling = t_compute / max(t_compute, t_memory)
+
+per (stem, batch) config. This is the COMPILER's own accounting of the
+exact program the bench runs — far stronger evidence than a CPU-backend
+proxy — though still a ceiling: it assumes perfect overlap inside the
+fused program and no host/runtime gaps (the r2 on-chip record, 25.9% MFU
+at a ~52% roofline ceiling, shows those gaps are the other half of the
+story).
+
+Prints one JSON line per config and a summary table; run result lands in
+``scripts/mfu_aot.jsonl``.
+"""
+
+import json
+import os
+import sys
+import time
+
+V5E_PEAK_BF16 = 197e12
+V5E_HBM_BW = 819e9
+
+CONFIGS = [
+    {"stem": "conv7", "batch": 128},
+    {"stem": "conv7", "batch": 192},
+    {"stem": "conv7", "batch": 256},
+    {"stem": "conv7", "batch": 512},  # the config whose ceiling crosses 35%
+    {"stem": "space_to_depth", "batch": 128},
+    {"stem": "space_to_depth", "batch": 192},
+    {"stem": "space_to_depth", "batch": 256},
+]
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import jax
+
+    # nothing here may touch a real backend (the axon tunnel may be wedged
+    # — that is the whole point of this script); any accidental eager op
+    # goes to CPU, and the AOT path below names its TPU target explicitly
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from chainermn_tpu.models import ResNet50
+
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    dev = np.array(topo.devices[:1])
+    mesh = Mesh(dev, ("x",))
+    repl = NamedSharding(mesh, P())
+    print(f"# AOT target: {topo.devices[0].device_kind} (abstract, 1 chip)",
+          file=sys.stderr)
+
+    out_path = os.path.join(os.path.dirname(__file__), "mfu_aot.jsonl")
+    results = []
+    for cfg in CONFIGS:
+        model = ResNet50(num_classes=1000, stem=cfg["stem"])
+        opt = optax.sgd(0.1, momentum=0.9)
+
+        def step(variables, opt_state, images, labels):
+            def loss_fn(p):
+                logits, updated = model.apply(
+                    {"params": p, **{k: v for k, v in variables.items()
+                                     if k != "params"}},
+                    images, mutable=["batch_stats"], train=True)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels).mean(), updated
+
+            (loss, updated), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(variables["params"])
+            updates, opt_state = opt.update(grads, opt_state,
+                                            variables["params"])
+            params = optax.apply_updates(variables["params"], updates)
+            return {"params": params, **updated}, opt_state, loss
+
+        # abstract avals with shardings on the AOT mesh (no real arrays)
+        img = jax.ShapeDtypeStruct((cfg["batch"], 224, 224, 3),
+                                   jnp.bfloat16, sharding=repl)
+        lbl = jax.ShapeDtypeStruct((cfg["batch"],), jnp.int32, sharding=repl)
+        # abstract rng too — a concrete PRNGKey would eagerly initialize
+        # the default backend
+        var_shapes = jax.eval_shape(
+            lambda k: model.init(k, jnp.zeros((2, 224, 224, 3), jnp.bfloat16),
+                                 train=True),
+            jax.ShapeDtypeStruct((2,), jnp.uint32))
+        to_aval = lambda t: jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=repl), t)
+        variables = to_aval(var_shapes)
+        opt_state = to_aval(jax.eval_shape(
+            opt.init, var_shapes["params"]))
+
+        t0 = time.time()
+        compiled = jax.jit(step).lower(variables, opt_state, img, lbl).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        byts = float(ca.get("bytes accessed", 0.0))
+        t_c = flops / V5E_PEAK_BF16
+        t_m = byts / V5E_HBM_BW
+        rec = {
+            "stem": cfg["stem"],
+            "batch": cfg["batch"],
+            "step_flops": flops,
+            "hbm_bytes": byts,
+            "arithmetic_intensity": round(flops / byts, 1) if byts else None,
+            "t_compute_ms": round(t_c * 1e3, 2),
+            "t_memory_ms": round(t_m * 1e3, 2),
+            "bound": "compute" if t_c >= t_m else "memory",
+            "mfu_ceiling": round(t_c / max(t_c, t_m), 4),
+            "roofline_step_ms": round(max(t_c, t_m) * 1e3, 2),
+            "img_per_sec_ceiling": round(cfg["batch"] / max(t_c, t_m), 0),
+            "compile_s": round(time.time() - t0, 1),
+        }
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    with open(out_path, "w") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")
+    print(f"\n# {'stem':>16} {'batch':>5} {'AI':>6} {'bound':>8} "
+          f"{'ceil ms':>8} {'MFU ceil':>8}", file=sys.stderr)
+    for r in results:
+        print(f"# {r['stem']:>16} {r['batch']:>5} "
+              f"{r['arithmetic_intensity']:>6} {r['bound']:>8} "
+              f"{r['roofline_step_ms']:>8} {r['mfu_ceiling']:>8}",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -241,3 +241,34 @@ def test_sharded_clip_replicated_grads_exact(comm):
     for k in grads:
         np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
                                    rtol=1e-6)
+
+
+def test_sharded_clip_replicated_grads_split_comm(comm):
+    """Same invariant-leaf correction on a split() sub-communicator: the
+    reduce covers the GROUP, so the replica divisor must be the group size
+    (dividing by the full mesh axis would under-clip)."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.optimizers import clip_by_global_norm_sharded
+
+    sub = comm.split([0] * comm.size)       # one group of everyone
+    halves = comm.split([r % 2 for r in range(comm.size)])  # two groups
+    for c in (sub, halves):
+        grads = {"w": jnp.full((4,), 3.0)}
+        want, _ = optax.clip_by_global_norm(1.0).update(
+            grads, optax.EmptyState())
+
+        def body(g):
+            out, _ = clip_by_global_norm_sharded(1.0, c).update(
+                g, optax.EmptyState())
+            # group-scoped psums leave replication statically unprovable
+            # for P() outputs; a full-axis mean of the (identical) values
+            # closes the inference without changing them
+            return jax.tree_util.tree_map(
+                lambda x: comm.allreduce(x, "mean"), out)
+
+        got = jax.jit(comm.shard_map(
+            body, in_specs=(P(),), out_specs=P()))(grads)
+        np.testing.assert_allclose(np.asarray(got["w"]),
+                                   np.asarray(want["w"]), rtol=1e-6)

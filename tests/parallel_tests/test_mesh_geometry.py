@@ -112,6 +112,13 @@ def test_make_3d_mesh_straddle_policy():
     assert _straddle_warning((2, 4, 4), {i: 4 for i in range(8)}, 32) is None
     # per_proc=3 (ragged): tp=2 does not divide 3 -> warn
     assert _straddle_warning((2, 2, 2), {0: 3, 1: 5}, 8) is not None
+    # 2 processes x 24 devices, shape (3, 4, 4): tp=4 divides 24 but
+    # sp*tp=16 neither divides 24 nor is a multiple of it -> the second
+    # block spans the host boundary -> warn (reviewer case)
+    msg = _straddle_warning((3, 4, 4), {0: 24, 1: 24}, 48)
+    assert msg is not None and "sp x tp" in msg
+    # (2, 3, 4) on 2 x 12: sp*tp=12 == per_proc -> aligned, quiet
+    assert _straddle_warning((2, 3, 4), {0: 12, 1: 12}, 24) is None
 
 
 def test_make_3d_mesh_local_does_not_warn():
