@@ -615,6 +615,7 @@ def ulysses_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    block_impl: str = "xla",
 ):
     """Exact attention via all-to-all head re-sharding (DeepSpeed-Ulysses
     collective shape, done with one XLA ``all_to_all`` each way).
@@ -623,12 +624,25 @@ def ulysses_attention(
     axis size; internally each device holds the FULL sequence for ``H/n``
     heads, so memory per device is ``T_global * H/n`` — choose ring
     attention instead when the full sequence per device is too large.
+
+    ``block_impl='flash'`` runs the local per-head attention through the
+    Pallas kernel (O(T) memory for the scores instead of the XLA path's
+    materialized ``[B, H/n, T, T]`` tile — at long T that tile, not the
+    K/V, is what OOMs first); the collectives are unchanged and
+    differentiation works through the kernel's custom VJP + the
+    ``all_to_all`` transpose. Off TPU the kernel runs interpreted (use
+    ``check_vma=False`` on the enclosing shard_map, like 'flash').
     """
     if not isinstance(axis_name, str):
         raise ValueError(
             f"ulysses_attention needs a single named mesh axis, got {axis_name!r} "
             "— use a flat communicator (e.g. 'tpu') for sequence parallelism"
         )
+    if block_impl not in ("xla", "flash"):
+        # a silent fallback to the XLA path would materialize the exact
+        # O(T^2) score tile the flag exists to avoid
+        raise ValueError(
+            f"block_impl must be 'xla' or 'flash', got {block_impl!r}")
     n = lax.axis_size(axis_name)
     h = q.shape[2]
     if h % n != 0:
@@ -640,9 +654,23 @@ def ulysses_attention(
     def to_seq(x):  # [B, n*T, H/n, D] -> [B, T, H, D]
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    out = full_attention(to_heads(q), to_heads(k), to_heads(v),
-                         causal=causal, scale=scale)
+    if block_impl == "flash":
+        from chainermn_tpu.ops import flash_attention
+
+        out = flash_attention(to_heads(q), to_heads(k), to_heads(v),
+                              causal=causal, scale=scale)
+    else:
+        out = full_attention(to_heads(q), to_heads(k), to_heads(v),
+                             causal=causal, scale=scale)
     return to_seq(out)
+
+
+def ulysses_flash_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                            scale: Optional[float] = None):
+    """:func:`ulysses_attention` with the Pallas flash kernel as the local
+    attention (``block_impl='flash'``)."""
+    return ulysses_attention(q, k, v, axis_name, causal=causal, scale=scale,
+                             block_impl="flash")
 
 
 def cached_attention(q, kbuf, vbuf, pos_offset, *, scale: Optional[float] = None):
@@ -732,15 +760,17 @@ def sequence_parallel_attention(
     if kind == "full" or axis_name is None:
         return functools.partial(full_attention, causal=causal, scale=scale)
     if kind not in ("ring", "ring_flash", "zigzag", "zigzag_flash",
-                    "ulysses"):
+                    "ulysses", "ulysses_flash"):
         raise ValueError(
             f"unknown attention kind {kind!r}; use "
-            "ring|ring_flash|zigzag|zigzag_flash|ulysses|full|flash"
+            "ring|ring_flash|zigzag|zigzag_flash|ulysses|ulysses_flash|"
+            "full|flash"
         )
     impl = {"ring": ring_attention, "ring_flash": ring_flash_attention,
             "zigzag": zigzag_ring_attention,
             "zigzag_flash": zigzag_flash_attention,
-            "ulysses": ulysses_attention}[kind]
+            "ulysses": ulysses_attention,
+            "ulysses_flash": ulysses_flash_attention}[kind]
 
     def f(q, k, v):
         try:

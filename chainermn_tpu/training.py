@@ -197,7 +197,8 @@ def _jit_tp_lm_train_step(
     if shard_sequence and seq_axis is None:
         raise ValueError(
             "shard_sequence=True with a TP model needs the model built with "
-            "sequence_axis (and attention='ring'|'zigzag'|'ulysses')"
+            "sequence_axis (and attention='ring'|'zigzag'|'ulysses' or a "
+            "_flash variant)"
         )
     if seq_axis is not None and (seq_axis == tensor_axis
                                  or seq_axis not in axes):
@@ -215,16 +216,19 @@ def _jit_tp_lm_train_step(
             "model without sequence_axis for batch-only sharding)"
         )
     if seq_axis is not None and getattr(model, "attention", None) not in (
-            "ring", "ring_flash", "zigzag", "zigzag_flash", "ulysses"):
+            "ring", "ring_flash", "zigzag", "zigzag_flash", "ulysses",
+            "ulysses_flash"):
         # 'full' under a sharded sequence silently computes block-diagonal
         # attention (each shard attends within its own chunk only)
         raise ValueError(
             f"sequence_axis={seq_axis!r} needs attention='ring'|'zigzag'|"
-            f"'ulysses'; got {getattr(model, 'attention', None)!r} — plain "
+            f"'ulysses' (or _flash); got "
+            f"{getattr(model, 'attention', None)!r} — plain "
             "'full' would attend within each sequence shard only"
         )
     if (getattr(model, "attention", None) in ("flash", "ring_flash",
-                                              "zigzag_flash")
+                                              "zigzag_flash",
+                                              "ulysses_flash")
             and jax.default_backend() != "tpu"):
         # The dense LM step works around interpret-mode Pallas by dropping
         # to check_vma=False; the TP step CANNOT (the global-objective
@@ -321,12 +325,12 @@ def jit_lm_train_step(
     if attn is not None:
         if shard_sequence:
             if (attn not in ("ring", "ring_flash", "zigzag", "zigzag_flash",
-                             "ulysses")
+                             "ulysses", "ulysses_flash")
                     or seq_axis != comm.axis_name):
                 raise ValueError(
                     f"shard_sequence=True needs the model built with "
                     f"attention='ring'|'ring_flash'|'zigzag'|'zigzag_flash'|"
-                    f"'ulysses' and sequence_axis={comm.axis_name!r}; got "
+                    f"'ulysses'(+_flash) and sequence_axis={comm.axis_name!r}; got "
                     f"attention={attn!r}, sequence_axis={seq_axis!r}"
                 )
         elif seq_axis is not None:
@@ -392,7 +396,8 @@ def jit_lm_train_step(
         # workaround); semantics are unchanged, only the static check is off.
         # Compiled TPU kernels don't need the workaround — keep the check on.
         # ZeRO's all_gather'd updates likewise defeat the static check.
-        check_vma=(attn not in ("flash", "ring_flash", "zigzag_flash")
+        check_vma=(attn not in ("flash", "ring_flash", "zigzag_flash",
+                            "ulysses_flash")
                    or jax.default_backend() == "tpu")
         and getattr(optimizer, "check_vma", True)
         and getattr(comm, "check_vma", True),

@@ -50,6 +50,91 @@ def markov_stream(n_tokens: int, vocab: int, order: int = 2, seed: int = 0):
     return out
 
 
+def _stream_data(args):
+    """(tokens, targets, n_seq) arrays from the Markov stream — shared by
+    every mode's data prep."""
+    stream = markov_stream(args.n_tokens, args.vocab)
+    n_seq = (len(stream) - 1) // args.seq_len
+    toks = stream[: n_seq * args.seq_len].reshape(n_seq, args.seq_len)
+    tgts = stream[1 : n_seq * args.seq_len + 1].reshape(n_seq, args.seq_len)
+    return toks, tgts, n_seq
+
+
+def _sequential_train_loop(args, comm, step, params, opt_state,
+                           toks, tgts, n_seq, batch):
+    """The shared strided train/telemetry loop for the pipeline and gspmd
+    modes (3-tuple steps, no shuffling): one place for the compile-time
+    exclusion, tok/s logging, and the final footer."""
+    t0, seen, first, loss = time.time(), 0, None, None
+    for it in range(1, args.iterations + 1):
+        i = (it * batch) % max(1, n_seq - batch)
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(toks[i : i + batch]),
+            jnp.asarray(tgts[i : i + batch]))
+        if it == 1:
+            jax.block_until_ready(loss)
+            first = float(loss)
+            t0, seen = time.time(), 0
+            if comm.rank == 0:
+                print(f"compiled; first loss {first:.3f}")
+        seen += batch * args.seq_len
+        if it % 20 == 0 and comm.rank == 0:
+            print(f"iter {it:4d}  loss {float(loss):.3f}  "
+                  f"{seen / (time.time() - t0):.0f} tok/s")
+    if comm.rank == 0 and loss is not None:
+        print(f"done: loss {first:.3f} -> {float(loss):.3f}")
+    return params, opt_state
+
+
+def run_gspmd(args, comm) -> None:
+    """Megatron weights-at-rest: the DENSE TransformerLM under plain jit,
+    params + optimizer state sharded ~1/n per device (parallel.gspmd);
+    MoE uses the gshard einsum-dispatch twin."""
+    from chainermn_tpu.parallel import (
+        gspmd_lm_train_step,
+        megatron_opt_shard,
+        megatron_shard,
+    )
+
+    model = TransformerLM(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers,
+        max_len=args.max_len or max(args.seq_len, 512),
+        attention=args.attention,  # 'full' or 'flash' (guarded in main)
+        moe_experts=args.moe_experts, moe_impl="gshard",
+        moe_top_k=args.moe_top_k,
+        compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+        else jnp.float32,
+    )
+    toks, tgts, n_seq = _stream_data(args)
+    batch = args.batchsize
+    if n_seq < batch:
+        raise SystemExit(f"need >= {batch} sequences, have {n_seq}")
+
+    params = megatron_shard(
+        model.init(jax.random.PRNGKey(0), jnp.asarray(toks[:1])), comm)
+    optimizer = optax.adam(args.lr)
+    opt_state = megatron_opt_shard(
+        optimizer, jax.jit(optimizer.init)(params), params, comm)
+    step = gspmd_lm_train_step(model, optimizer, comm)
+
+    def frac(tree):
+        tot = loc = 0
+        for _, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if hasattr(leaf, "sharding") and leaf.shape:
+                tot += leaf.size
+                loc += int(np.prod(leaf.sharding.shard_shape(leaf.shape)))
+        return loc / max(tot, 1)
+
+    if comm.rank == 0:
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        print(f"{n_params / 1e6:.2f}M params  gspmd megatron layout  "
+              f"per-device fraction: params {frac(params):.3f}, "
+              f"opt {frac(opt_state):.3f} (1/n = {1 / comm.size:.3f})")
+    _sequential_train_loop(args, comm, step, params, opt_state,
+                           toks, tgts, n_seq, batch)
+
+
 def run_pipeline(args, comm) -> None:
     """Pipeline-parallel LM: n_stages = mesh size, one causal transformer
     block resident per rank, stage params stacked P(axis); the GPipe
@@ -68,10 +153,7 @@ def run_pipeline(args, comm) -> None:
         compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
         else jnp.float32,
     )
-    stream = markov_stream(args.n_tokens, args.vocab)
-    n_seq = (len(stream) - 1) // args.seq_len
-    toks = stream[: n_seq * args.seq_len].reshape(n_seq, args.seq_len)
-    tgts = stream[1 : n_seq * args.seq_len + 1].reshape(n_seq, args.seq_len)
+    toks, tgts, n_seq = _stream_data(args)
     batch = args.batchsize * args.microbatches
     if n_seq < batch:
         raise SystemExit(f"need >= {batch} sequences, have {n_seq}")
@@ -88,24 +170,8 @@ def run_pipeline(args, comm) -> None:
         print(f"{n_params / 1e6:.2f}M params  pipeline stages={n_stages} "
               f"microbatches={args.microbatches} "
               f"(bubble fraction {bubble:.1%})")
-    t0, toks_seen, first = time.time(), 0, None
-    for it in range(1, args.iterations + 1):
-        i = (it * batch) % max(1, n_seq - batch)
-        tok = jnp.asarray(toks[i : i + batch])
-        tgt = jnp.asarray(tgts[i : i + batch])
-        params, opt_state, loss = step(params, opt_state, tok, tgt)
-        if it == 1:
-            jax.block_until_ready(loss)
-            first = float(loss)
-            t0, toks_seen = time.time(), 0
-            if comm.rank == 0:
-                print(f"compiled; first loss {first:.3f}")
-        toks_seen += tok.size
-        if it % 20 == 0 and comm.rank == 0:
-            print(f"iter {it:4d}  loss {float(loss):.3f}  "
-                  f"{toks_seen / (time.time() - t0):.0f} tok/s")
-    if comm.rank == 0:
-        print(f"done: loss {first:.3f} -> {float(loss):.3f}")
+    _sequential_train_loop(args, comm, step, params, opt_state,
+                           toks, tgts, n_seq, batch)
 
 
 def main() -> None:
@@ -119,10 +185,14 @@ def main() -> None:
                         help="per-rank batch (DP mode) / global batch (SP mode)")
     parser.add_argument("--iterations", type=int, default=100)
     parser.add_argument("--attention", default="full",
-                        choices=["full", "ring", "ulysses", "flash"])
+                        choices=["full", "ring", "ring_flash", "zigzag",
+                                 "zigzag_flash", "ulysses", "ulysses_flash",
+                                 "flash"])
     parser.add_argument("--seq-parallel", action="store_true",
                         help="shard the SEQUENCE axis over the mesh "
-                             "(context parallelism); needs ring/ulysses")
+                             "(context parallelism); needs ring/zigzag/"
+                             "ulysses (zigzag data is host-permuted "
+                             "automatically)")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="expert-parallel MoE FFN every 2nd block")
     parser.add_argument("--moe-top-k", type=int, default=1, choices=[1, 2],
@@ -131,6 +201,12 @@ def main() -> None:
                         help="Megatron-style TP: heads + FFN width sharded "
                              "over the mesh axis, batch replicated "
                              "(parallel.tensor; global-objective grads)")
+    parser.add_argument("--gspmd", action="store_true",
+                        help="GSPMD weights-at-rest: the dense model under "
+                             "plain jit with Megatron param layouts (params"
+                             "+opt ~1/n per device; parallel.gspmd). "
+                             "Combines with --moe-experts via the gshard "
+                             "einsum-dispatch MoE")
     parser.add_argument("--pipeline", action="store_true",
                         help="pipeline parallelism: one transformer block "
                              "per mesh rank (GPipe fill-drain microbatch "
@@ -156,6 +232,17 @@ def main() -> None:
         raise SystemExit("--pipeline uses the whole mesh axis for stages; "
                          "it does not combine with the other parallel "
                          "flags in this example")
+    if args.gspmd and (args.seq_parallel or args.tensor_parallel
+                       or args.pipeline):
+        raise SystemExit("--gspmd is its own layout (plain jit, partitioner "
+                         "collectives); it does not combine with "
+                         "--seq-parallel/--tensor-parallel/--pipeline")
+    if args.gspmd and args.attention not in ("full", "flash"):
+        raise SystemExit("--gspmd runs the dense model; --attention must be "
+                         "full or flash (sequence-sharded kinds need the "
+                         "shard_map step)")
+    if args.gspmd:
+        return run_gspmd(args, comm)
     if args.pipeline:
         if args.n_layers != parser.get_default("n_layers") and (
                 args.n_layers != comm.size):
@@ -164,8 +251,11 @@ def main() -> None:
                 f"({comm.size} here); --n-layers {args.n_layers} would be "
                 "silently ignored")
         return run_pipeline(args, comm)
-    if args.seq_parallel and args.attention not in ("ring", "ulysses"):
-        raise SystemExit("--seq-parallel needs --attention ring|ulysses")
+    if args.seq_parallel and args.attention not in (
+            "ring", "ring_flash", "zigzag", "zigzag_flash", "ulysses",
+            "ulysses_flash"):
+        raise SystemExit("--seq-parallel needs --attention "
+                         "ring|zigzag|ulysses (or a _flash variant)")
     if args.tensor_parallel and (args.seq_parallel or args.moe_experts):
         raise SystemExit("--tensor-parallel uses the whole flat mesh axis; "
                          "it does not combine with --seq-parallel or "
@@ -191,10 +281,15 @@ def main() -> None:
         else jnp.float32,
     )
 
-    stream = markov_stream(args.n_tokens, args.vocab)
-    n_seq = (len(stream) - 1) // args.seq_len
-    tokens_all = stream[: n_seq * args.seq_len].reshape(n_seq, args.seq_len)
-    targets_all = stream[1 : n_seq * args.seq_len + 1].reshape(n_seq, args.seq_len)
+    tokens_all, targets_all, n_seq = _stream_data(args)
+    if args.seq_parallel and args.attention.startswith("zigzag"):
+        # zigzag shards hold (early, late) chunk pairs: permute the data
+        # once on the host; the mean loss is permutation-invariant
+        from chainermn_tpu.parallel.sequence import zigzag_permutation
+
+        perm = np.asarray(zigzag_permutation(args.seq_len, comm.size))
+        tokens_all = tokens_all[:, perm]
+        targets_all = targets_all[:, perm]
 
     if args.seq_parallel or args.tensor_parallel:
         # SP: the sequence axis shards over the mesh. TP: the WEIGHTS shard

@@ -124,6 +124,16 @@ def test_train_lm_tensor_parallel():
     assert "done: 25 iterations" in proc.stdout
 
 
+def test_train_lm_gspmd():
+    proc = run_example(
+        "lm/train_lm.py",
+        ["--iterations", "25", "--gspmd", "--moe-experts", "8",
+         "--seq-len", "32", "--d-model", "32", "--n-tokens", "20000"],
+    )
+    assert "gspmd megatron layout" in proc.stdout
+    assert "done: loss" in proc.stdout
+
+
 def test_train_lm_pipeline():
     proc = run_example(
         "lm/train_lm.py",

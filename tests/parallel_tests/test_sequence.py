@@ -130,6 +130,34 @@ def test_ring_flash_gradients_match_full_attention(comm):
                                    atol=5e-4, rtol=5e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_matches_full_attention(comm, causal):
+    """Ulysses with the Pallas kernel as the local attention: same
+    collectives, O(T)-memory scores instead of the materialized
+    [B, H/n, T, T] tile."""
+    from chainermn_tpu.parallel.sequence import ulysses_flash_attention
+
+    q, k, v = _qkv(t=64)
+    want = full_attention(q, k, v, causal=causal)
+    spec = P(None, comm.axis_name)
+    f = jax.jit(comm.shard_map(
+        lambda q, k, v: ulysses_flash_attention(
+            q, k, v, comm.axis_name, causal=causal),
+        in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+    ))
+    got = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    g_got = jax.grad(lambda q, k, v: (f(q, k, v) ** 2).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(
+        lambda q, k, v: (full_attention(q, k, v, causal=causal) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
 def test_ring_flash_bf16(comm):
     """bf16 q/k/v feed the kernels; partials merge in f32 (out_dtype)."""
     q, k, v = _qkv(t=64)
