@@ -34,13 +34,22 @@ _COLLECTIVES = (
     "collective-permute", "collective-broadcast",
 )
 
-# `%name = TYPE op-name(` — TYPE is `f32[8,128]{...}` or a (tuple, of, them)
-_INSTR_RE = re.compile(
-    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)\s+([a-z0-9-]+)(?:\.[0-9]+)?\(")
+# `%name = TYPE op-name(` — TYPE is `f32[8,128]{...}` or a (tuple, of,
+# them). The type is captured LAZILY up to the first lowercase
+# word-followed-by-"(" — the op name — because real TPU layouts embed
+# parens inside the braces (`{1,0:T(8,128)(2,1)S(1)}`), which a greedy
+# "(...)" alternation cannot survive (that bug silently dropped every
+# collective-permute-start from round-3-era counts).
+_INSTR_RE = re.compile(r"=\s*(.*?)\s*([a-z][a-z0-9-]*(?:\.[0-9]+)?)\(")
 _SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 
 
-def _shape_bytes_list(type_str: str) -> list[int]:
+def _shape_list(type_str: str) -> list[tuple[int, bool]]:
+    """[(bytes, is_control), ...] for every array shape in a type string
+    (layout annotations are ignored). Control words — the u32[] scalars TPU
+    async-starts append to their tuples — are flagged BY DTYPE AND RANK so
+    they can be filtered from payload math; a genuinely scalar payload of
+    any other dtype (an f32[] loss psum) stays a payload."""
     out = []
     for dtype, dims in _SHAPE_RE.findall(type_str):
         if dtype not in _DTYPE_BYTES:
@@ -49,12 +58,12 @@ def _shape_bytes_list(type_str: str) -> list[int]:
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        out.append(n * _DTYPE_BYTES[dtype])
+        out.append((n * _DTYPE_BYTES[dtype], dtype == "u32" and dims == ""))
     return out
 
 
 def _type_bytes(type_str: str) -> int:
-    return sum(_shape_bytes_list(type_str))
+    return sum(b for b, _ in _shape_list(type_str))
 
 
 def parse_hlo_collectives(hlo: str) -> dict[str, Any]:
@@ -62,13 +71,18 @@ def parse_hlo_collectives(hlo: str) -> dict[str, Any]:
 
     Post-optimization TPU/GPU HLO rewrites collectives into async
     ``<op>-start`` / ``<op>-done`` pairs: the ``-start`` carries the payload
-    type and is counted under the base op name; ``-done`` is skipped so
-    pairs aren't double-counted.
+    type and is counted under the base op name (TPU starts append u32[]
+    control scalars to the tuple — filtered out of the payload math);
+    ``-done`` is skipped so pairs aren't double-counted. Collectives inside
+    a ``while`` body (e.g. a ring's per-step ppermute) count ONCE, not once
+    per iteration — this reports the program's collective *structure*; wire
+    volume per step multiplies by the trip count.
     """
     stats: dict[str, Any] = {}
     total = 0
     for m in _INSTR_RE.finditer(hlo):
         type_str, op = m.group(1), m.group(2)
+        op = op.split(".")[0]  # strip .N instance suffixes
         if op.endswith("-done"):
             continue
         is_start = op.endswith("-start")
@@ -76,7 +90,9 @@ def parse_hlo_collectives(hlo: str) -> dict[str, Any]:
         if base not in _COLLECTIVES:
             continue
         if is_start and type_str.startswith("("):
-            els = _shape_bytes_list(type_str)
+            els = [b for b, control in _shape_list(type_str) if not control]
+            if not els:
+                els = [b for b, _ in _shape_list(type_str)]
             if base == "all-reduce":
                 # all-reduce-start's tuple members are all RESULTS (XLA's
                 # all-reduce combiner emits variadic ops): count every one.

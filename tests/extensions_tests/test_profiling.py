@@ -157,3 +157,22 @@ def test_watchdog_quiet_on_fast_steps():
 def test_watchdog_rejects_bad_mode():
     with pytest.raises(ValueError):
         Watchdog(timeout=1, on_timeout="explode")
+
+
+def test_parse_hlo_collectives_tpu_layout_format():
+    """Real TPU HLO embeds parens inside layout braces (T(8,128)(2,1)) and
+    appends u32[] control scalars to async-start tuples — both broke the
+    round-3 parser (every collective-permute-start silently dropped)."""
+    from chainermn_tpu.extensions import parse_hlo_collectives
+
+    hlo = """
+  %collective-permute-start = (bf16[1,1024,8,64]{1,3,2,0:T(8,128)(2,1)}, bf16[1,1024,8,64]{1,3,2,0:T(8,128)(2,1)S(1)}, u32[]{:S(2)}, u32[]{:S(2)}) collective-permute-start(%copy.576), channel_id=1, source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+  %collective-permute-done = bf16[1,1024,8,64]{1,3,2,0:T(8,128)(2,1)} collective-permute-done(%collective-permute-start)
+  %psum = f32[47494400]{0:T(1024)} all-reduce(%dus.31), channel_id=2, replica_groups={{0,1,2,3}}, to_apply=%region_72.73
+"""
+    cs = parse_hlo_collectives(hlo)
+    # permute payload = ONE [1,1024,8,64] bf16 buffer (result half of the
+    # (operand, result) pair; u32 control words excluded)
+    assert cs["collective-permute"] == {"count": 1, "bytes": 1048576}, cs
+    assert cs["all-reduce"]["bytes"] == 47494400 * 4
+    assert cs["total_bytes"] == 1048576 + 47494400 * 4
