@@ -255,8 +255,12 @@ def child_main() -> None:
     # 256/chip, not 128: the AOT roofline (PERF.md round 4) shows this
     # workload is HBM-bound and arithmetic intensity — batch — is the MFU
     # lever (ceiling 27% at 128, 31% at 256, 35% at 512). The halving loop
-    # below still degrades gracefully on OOM, so bigger-first is safe.
-    batch = int(os.environ.get("CHAINERMN_TPU_BENCH_BATCH", "0")) or 256 * n_chips
+    # below degrades gracefully on OOM — EXCEPT when the batch was set
+    # explicitly (CHAINERMN_TPU_BENCH_BATCH): a sweep cell labeled
+    # batch=512 must fail on OOM rather than silently measure 256 under
+    # the wrong label (the next cell measures 256 on purpose).
+    explicit_batch = int(os.environ.get("CHAINERMN_TPU_BENCH_BATCH", "0"))
+    batch = explicit_batch or 256 * n_chips
     headline = None
     while batch >= 8:
         try:
@@ -276,6 +280,10 @@ def child_main() -> None:
             if any(s in full_msg for s in _RETRYABLE):
                 raise  # backend-level failure: let the parent retry fresh
             log(f"batch {batch} failed: {full_msg[:300]}")
+            if explicit_batch:
+                raise SystemExit(
+                    f"explicit batch {explicit_batch} failed; not halving "
+                    "(the measurement label must match the measured batch)")
             batch //= 2
     if headline is None:
         raise SystemExit("benchmark could not run at any batch size")

@@ -21,13 +21,20 @@ import sys
 import time
 
 CELLS = [
-    # (stem, batch) — conv7/96 and conv7/128 were measured earlier in round 3
-    # (PERF.md). space_to_depth first: it is the likeliest MFU winner.
-    ("space_to_depth", 128),
+    # (stem, batch) ordered by the round-4 AOT roofline (PERF.md): the
+    # workload is HBM-bound and batch is the MFU lever — ceiling 35.2% at
+    # conv7/512, 31.2% at 256, 27% at 128. space_to_depth is byte-identical
+    # to conv7 (NOT a bandwidth lever); one cell kept as the measured
+    # cross-check of that prediction. 512 first: it is the only config
+    # whose ceiling clears the 35% bar (fits in ~15.3 of 16 GB HBM per the
+    # AOT memory analysis). bench.py does NOT halve an explicitly-set
+    # batch, so an OOM here fails this cell and the sweep moves on to the
+    # next (conv7/256 is measured on purpose, once, under its own label).
+    ("conv7", 512),
+    ("conv7", 256),
+    ("conv7", 384),
     ("space_to_depth", 256),
     ("conv7", 192),
-    ("conv7", 256),
-    ("space_to_depth", 192),
 ]
 
 BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
