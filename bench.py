@@ -406,6 +406,12 @@ def parent_main() -> None:
     # backend that doesn't come up within ~12min per attempt won't come up
     # at 30min either.
     attempt_timeout = float(os.environ.get("CHAINERMN_TPU_BENCH_TIMEOUT", "720"))
+    # The child's internal sweep deadline must fire BEFORE this parent's
+    # attempt timeout, or a healthy child pacing its sweep against a larger
+    # default budget gets SIGTERMed mid-sweep and logged as a (phantom)
+    # backend hang. Derived per attempt (the timeout shrinks as the total
+    # budget drains) unless the caller pinned it explicitly.
+    child_budget_pinned = "CHAINERMN_TPU_BENCH_CHILD_BUDGET" in os.environ
     # And a TOTAL cap: a wedged single-tenant tunnel (PERF.md hazard #2)
     # hangs every attempt — unlimited retries would outlive any driver
     # budget and still emit nothing. Stop retrying once the cumulative spend
@@ -472,6 +478,12 @@ def parent_main() -> None:
             last_tail = last_tail or "total budget exhausted (tunnel wedged?)"
             break
         attempt_timeout = min(attempt_timeout, remaining)
+        if not child_budget_pinned:
+            # strictly inside the (possibly just-clamped) attempt timeout,
+            # for small timeouts too: 80% when the 90s margin would invert
+            os.environ["CHAINERMN_TPU_BENCH_CHILD_BUDGET"] = str(
+                max(30.0, min(attempt_timeout - 90.0, attempt_timeout * 0.8))
+            )
         attempts_run = i
         popen = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],

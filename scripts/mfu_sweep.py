@@ -16,6 +16,7 @@ Usage: python scripts/mfu_sweep.py [out.jsonl]
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -44,6 +45,29 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def main():
     out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "mfu_sweep.jsonl")
+    # Per-cell budget is overridable so a wrapper (scripts/chip_watch.sh) can
+    # keep its own timeout ABOVE n_cells * cell_timeout — a wrapper TERM that
+    # lands mid-cell would otherwise orphan a lease-holding bench child.
+    cell_timeout = int(os.environ.get("MFU_SWEEP_CELL_TIMEOUT", "2700"))
+
+    # Forward TERM to the running bench cell: `timeout` signals only THIS
+    # process; without forwarding, the bench parent (and its lease-holding
+    # grandchild) would outlive us and contend with whatever runs next on
+    # the single-tenant tunnel (PERF.md hazard #2).
+    current = [None]
+
+    def _on_term(signum, frame):
+        proc = current[0]
+        if proc is not None and proc.poll() is None:
+            proc.terminate()  # bench's parent handles TERM: salvages + unwinds
+            try:
+                proc.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                pass
+        sys.exit(143)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
     for stem, batch in CELLS:
         env = dict(os.environ,
                    CHAINERMN_TPU_BENCH_STEM=stem,
@@ -51,13 +75,16 @@ def main():
                    CHAINERMN_TPU_BENCH_SWEEP="0",
                    CHAINERMN_TPU_BENCH_STEPS="50",
                    CHAINERMN_TPU_BENCH_ATTEMPTS="1",
-                   CHAINERMN_TPU_BENCH_TIMEOUT="2700",
-                   CHAINERMN_TPU_BENCH_TOTAL_BUDGET="2760")
+                   CHAINERMN_TPU_BENCH_TIMEOUT=str(cell_timeout),
+                   CHAINERMN_TPU_BENCH_TOTAL_BUDGET=str(cell_timeout + 60))
         t0 = time.time()
         print(f"=== cell stem={stem} batch={batch}", file=sys.stderr, flush=True)
-        proc = subprocess.run([sys.executable, BENCH], env=env,
-                              stdout=subprocess.PIPE, text=True)
-        line = (proc.stdout or "").strip().splitlines()
+        proc = subprocess.Popen([sys.executable, BENCH], env=env,
+                                stdout=subprocess.PIPE, text=True)
+        current[0] = proc
+        stdout_txt, _ = proc.communicate()
+        current[0] = None
+        line = (stdout_txt or "").strip().splitlines()
         rec = {"stem": stem, "batch": batch, "rc": proc.returncode,
                "wall_s": round(time.time() - t0, 1)}
         if line:
