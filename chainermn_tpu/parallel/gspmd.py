@@ -62,54 +62,132 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase
 
 
-def _leaf_spec(path: str, shape, tp: str, n: int) -> P:
-    """Megatron spec for one dense-TransformerLM leaf (path rules above);
-    P() when the sharded dim would not divide by ``n``."""
+def _ends(parts, *names) -> bool:
+    """Whole-component suffix match: ``('pos_embed', 'embedding')`` does NOT
+    match ``('embed', 'embedding')`` — ``str.endswith`` would, silently
+    handing the position table the vocab-embedding spec and a cross-shard
+    gather per lookup."""
+    return tuple(parts[-len(names):]) == names
 
-    def ok(dim_idx):
-        return shape[dim_idx] % n == 0
 
-    if path.endswith("qkv/kernel") and len(shape) == 4:
-        return P(None, None, tp, None) if ok(2) else P()
-    if path.endswith("qkv/bias") and len(shape) == 3:
-        return P(None, tp, None) if ok(1) else P()
-    if path.endswith("proj/kernel") and len(shape) == 3:
-        return P(tp, None, None) if ok(0) else P()
-    if path.endswith("Dense_0/kernel") and len(shape) == 2:
-        return P(None, tp) if ok(1) else P()
-    if path.endswith("Dense_0/bias") and len(shape) == 1:
-        return P(tp) if ok(0) else P()
-    if path.endswith("Dense_1/kernel") and len(shape) == 2:
-        return P(tp, None) if ok(0) else P()
-    if path.endswith("lm_head/kernel") and len(shape) == 2:
-        return P(None, tp) if ok(1) else P()
-    if path.endswith("lm_head/bias") and len(shape) == 1:
-        return P(tp) if ok(0) else P()
-    if path.endswith("embed/embedding") and len(shape) == 2:
-        return P(tp, None) if ok(0) else P()
+# Leaves the Megatron layout stores replicated ON PURPOSE: norm vectors,
+# row-parallel output biases, the position table, the router. Sharding any
+# of these buys ~nothing (tiny) or costs a gather per use (pos_embed).
+def _known_replicated(parts) -> bool:
+    if any(p.startswith("LayerNorm") for p in parts):
+        return True
+    tail = tuple(parts[-2:])
+    return tail in {
+        ("pos_embed", "embedding"),
+        ("proj", "bias"),
+        ("Dense_1", "bias"),
+        ("gate", "kernel"),
+        ("gate", "bias"),
+    }
+
+
+def _leaf_rule(parts, shape, tp: str, n: int):
+    """``(spec, status)`` for one dense-TransformerLM leaf (rules in the
+    module docstring). Status distinguishes the three ways a leaf ends up
+    replicated: ``undividable`` (rule hit, dim % n != 0),
+    ``known_replicated`` (intentional), ``unmatched`` (NO rule knows this
+    leaf — the silent-layout-loss case :func:`megatron_param_specs` makes
+    loud)."""
+
+    def pick(spec, dim_idx):
+        if shape[dim_idx] % n == 0:
+            return spec, "sharded"
+        return P(), "undividable"
+
+    if _ends(parts, "qkv", "kernel") and len(shape) == 4:
+        return pick(P(None, None, tp, None), 2)
+    if _ends(parts, "qkv", "bias") and len(shape) == 3:
+        return pick(P(None, tp, None), 1)
+    if _ends(parts, "proj", "kernel") and len(shape) == 3:
+        return pick(P(tp, None, None), 0)
+    if _ends(parts, "Dense_0", "kernel") and len(shape) == 2:
+        return pick(P(None, tp), 1)
+    if _ends(parts, "Dense_0", "bias") and len(shape) == 1:
+        return pick(P(tp), 0)
+    if _ends(parts, "Dense_1", "kernel") and len(shape) == 2:
+        return pick(P(tp, None), 0)
+    if _ends(parts, "lm_head", "kernel") and len(shape) == 2:
+        return pick(P(None, tp), 1)
+    if _ends(parts, "lm_head", "bias") and len(shape) == 1:
+        return pick(P(tp), 0)
+    if _ends(parts, "embed", "embedding") and len(shape) == 2:
+        return pick(P(tp, None), 0)
     # GShard MoE expert stacks: shard the expert dim
-    for name in ("moe/w1", "moe/b1", "moe/w2", "moe/b2"):
-        if path.endswith(name):
-            return (P(tp, *(None,) * (len(shape) - 1))
-                    if shape and ok(0) else P())
-    return P()
+    for name in ("w1", "b1", "w2", "b2"):
+        if _ends(parts, "moe", name) and shape:
+            return pick(P(tp, *(None,) * (len(shape) - 1)), 0)
+    if _known_replicated(parts):
+        return P(), "known_replicated"
+    return P(), "unmatched"
 
 
-def _norm_path(path) -> str:
-    return "/".join(
+def _path_parts(path):
+    return tuple(
         str(getattr(k, "key", getattr(k, "idx", k))) for k in path
     )
 
 
-def megatron_param_specs(params, tp_axis: str, n_tp: int):
+def _leaf_bytes(leaf) -> int:
+    shape = jnp.shape(leaf)
+    size = 1
+    for d in shape:
+        size *= d
+    return size * jnp.dtype(getattr(leaf, "dtype", jnp.float32)).itemsize
+
+
+# Unmatched-replicated bytes above this trip the warning (strict mode raises
+# on ANY unmatched leaf). Norm-sized vectors stay under it at any real d.
+_UNMATCHED_WARN_BYTES = 1 << 20
+
+
+def megatron_param_specs(params, tp_axis: str, n_tp: int, *,
+                         strict: bool = False, report: bool = False):
     """Per-leaf ``PartitionSpec`` tree for a dense ``TransformerLM`` param
-    tree (or any tree using the same layer names)."""
-    flat = jax.tree_util.tree_flatten_with_path(params)
-    leaves = [
-        _leaf_spec(_norm_path(p), jnp.shape(l), tp_axis, n_tp)
-        for p, l in flat[0]
-    ]
-    return jax.tree_util.tree_unflatten(flat[1], leaves)
+    tree (or any tree using the same layer names).
+
+    Rule matching is by path NAME, so a renamed module would silently fall
+    back to replicated — the exact layout loss this module exists to
+    prevent. Defense: leaves matching no rule and not on the
+    known-replicated list are reported — ``strict=True`` raises on any;
+    otherwise a warning fires when they exceed ~1 MiB total.
+    ``report=True`` returns ``(specs, report_dict)`` with per-status paths
+    and byte totals.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    leaves, statuses = [], []
+    for p, l in flat:
+        spec, status = _leaf_rule(_path_parts(p), jnp.shape(l), tp_axis, n_tp)
+        leaves.append(spec)
+        statuses.append(status)
+    rep = {s: [] for s in
+           ("sharded", "undividable", "known_replicated", "unmatched")}
+    bytes_by = dict.fromkeys(rep, 0)
+    for (p, l), status in zip(flat, statuses):
+        path = "/".join(_path_parts(p))
+        rep[status].append(path)
+        bytes_by[status] += _leaf_bytes(l)
+    if rep["unmatched"]:
+        msg = (
+            f"megatron_param_specs: {len(rep['unmatched'])} leaves "
+            f"({bytes_by['unmatched']} bytes) matched no sharding rule and "
+            "are not known-replicated — they will be stored REPLICATED on "
+            f"every device: {rep['unmatched'][:8]}"
+        )
+        if strict:
+            raise ValueError(msg)
+        if bytes_by["unmatched"] > _UNMATCHED_WARN_BYTES:
+            import warnings
+
+            warnings.warn(msg, stacklevel=2)
+    specs = jax.tree_util.tree_unflatten(treedef, leaves)
+    if report:
+        return specs, {"paths": rep, "bytes": bytes_by}
+    return specs
 
 
 def _resolve_tp_axis(comm: CommunicatorBase, tp_axis: Optional[str]) -> str:

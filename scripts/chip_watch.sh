@@ -25,8 +25,10 @@ while true; do
     ( timeout -s TERM 1700 python bench.py > scripts/bench_stdout.txt 2> scripts/bench_stderr.txt; \
       echo "$(date +%FT%T) bench rc=$?" >> "$LOG" )
     # sweep: 5 cells x 1500s/cell max; results append per-cell so a timeout
-    # loses only remaining cells. Wrapper = 5*1500 + slack.
-    ( MFU_SWEEP_CELL_TIMEOUT=1500 timeout -s TERM 7800 python scripts/mfu_sweep.py >> "$LOG" 2>&1; \
+    # loses only remaining cells. Wrapper = 5*(1500 + ~180 teardown: bench's
+    # TERM wait + KILL wait + interpreter startup) + slack, so even five
+    # wedged cells exit on their own before this TERM lands.
+    ( MFU_SWEEP_CELL_TIMEOUT=1500 timeout -s TERM 8700 python scripts/mfu_sweep.py >> "$LOG" 2>&1; \
       echo "$(date +%FT%T) sweep rc=$?" >> "$LOG" )
     ( ONCHIP_FLASH_BUDGET=780 timeout -s TERM 900 python scripts/onchip_flash.py >> "$LOG" 2>&1; \
       echo "$(date +%FT%T) onchip_flash rc=$?" >> "$LOG" )

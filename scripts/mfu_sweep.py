@@ -53,8 +53,13 @@ def main():
     # Forward TERM to the running bench cell: `timeout` signals only THIS
     # process; without forwarding, the bench parent (and its lease-holding
     # grandchild) would outlive us and contend with whatever runs next on
-    # the single-tenant tunnel (PERF.md hazard #2).
-    current = [None]
+    # the single-tenant tunnel (PERF.md hazard #2). The in-flight cell gets
+    # a rc=143 record so "killed mid-measurement" is distinguishable from
+    # "never ran" (the bench child additionally salvages to its scratch
+    # file; we don't touch its stdout pipe here — the interrupted
+    # communicate() in the main frame owns it).
+    current = [None]       # running Popen
+    current_cell = [None]  # (stem, batch, t0)
 
     def _on_term(signum, frame):
         proc = current[0]
@@ -64,6 +69,13 @@ def main():
                 proc.wait(timeout=90)
             except subprocess.TimeoutExpired:
                 pass
+        if current_cell[0] is not None:
+            stem, batch, t0 = current_cell[0]
+            with open(out_path, "a") as f:
+                f.write(json.dumps({
+                    "stem": stem, "batch": batch, "rc": 143,
+                    "terminated_by": f"signal {signum}",
+                    "wall_s": round(time.time() - t0, 1)}) + "\n")
         sys.exit(143)
 
     signal.signal(signal.SIGTERM, _on_term)
@@ -79,11 +91,13 @@ def main():
                    CHAINERMN_TPU_BENCH_TOTAL_BUDGET=str(cell_timeout + 60))
         t0 = time.time()
         print(f"=== cell stem={stem} batch={batch}", file=sys.stderr, flush=True)
+        current_cell[0] = (stem, batch, t0)
         proc = subprocess.Popen([sys.executable, BENCH], env=env,
                                 stdout=subprocess.PIPE, text=True)
         current[0] = proc
         stdout_txt, _ = proc.communicate()
         current[0] = None
+        current_cell[0] = None
         line = (stdout_txt or "").strip().splitlines()
         rec = {"stem": stem, "batch": batch, "rc": proc.returncode,
                "wall_s": round(time.time() - t0, 1)}
