@@ -58,12 +58,19 @@ class TransformerBlock(nn.Module):
     def __call__(self, x, pos_offset=0, kv_cache=None):
         dt = self.compute_dtype
         d_head = self.d_model // self.n_heads
-        if kv_cache is not None and (self.moe_experts
-                                     or self.sequence_axis is not None):
-            raise ValueError(
-                "kv_cache decoding supports dense and tensor-parallel "
-                "blocks only (not MoE or sequence-sharded)"
-            )
+        if kv_cache is not None:
+            if self.sequence_axis is not None:
+                raise ValueError(
+                    "kv_cache decoding does not support sequence-sharded "
+                    "blocks — rebuild with sequence_axis=None for inference"
+                )
+            if self.moe_experts and self.moe_impl != "gshard":
+                raise ValueError(
+                    "kv_cache decoding supports MoE only via "
+                    "moe_impl='gshard' (plain-jit dispatch); the shard_map "
+                    "'ep' implementation needs an axis context the decode "
+                    "loop does not bind"
+                )
 
         h = nn.LayerNorm(dtype=dt)(x)
         if self.tensor_axis is not None:
@@ -136,6 +143,10 @@ class TransformerBlock(nn.Module):
                     top_k=self.moe_top_k,
                     compute_dtype=dt, name="moe",
                 )(h)
+            if kv_cache is not None:
+                # decode: the cache replaces the aux loss in the contract
+                # (inference adds no balance objective)
+                return x + y, new_cache
             return x + y, aux
         h = nn.Dense(self.d_ff, dtype=dt)(h)
         h = nn.gelu(h)
@@ -195,13 +206,19 @@ class TransformerLM(nn.Module):
             )
         if self.vocab_parallel_head and self.tensor_axis is None:
             raise ValueError("vocab_parallel_head needs tensor_axis")
-        if kv_caches is not None and (self.moe_experts
-                                      or self.sequence_axis is not None):
-            raise ValueError(
-                "kv_caches decoding supports dense and tensor-parallel "
-                "models only — rebuild without moe_experts/sequence_axis "
-                "for inference"
-            )
+        if kv_caches is not None:
+            if self.sequence_axis is not None:
+                raise ValueError(
+                    "kv_caches decoding does not support sequence-sharded "
+                    "models — rebuild with sequence_axis=None for inference"
+                )
+            if self.moe_experts and self.moe_impl != "gshard":
+                raise ValueError(
+                    "kv_caches decoding supports MoE only via "
+                    "moe_impl='gshard' — rebuild the model with "
+                    "moe_impl='gshard' for inference (same params: the "
+                    "expert stacks are identical)"
+                )
         d_ff = self.d_ff or 4 * self.d_model
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
@@ -276,6 +293,8 @@ def generate(
     n_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     rng=None,
     use_cache: bool = True,
     comm=None,
@@ -286,8 +305,11 @@ def generate(
 
     ``prompt [B, T0]`` ints; returns ``[B, T0 + n_tokens]``. ``temperature=0``
     is greedy (deterministic); otherwise softmax sampling at the given
-    temperature with ``rng``. Compiled per (model, shapes, temperature) —
-    repeat calls with the same shapes reuse the compile.
+    temperature with ``rng``, optionally truncated to the ``top_k`` most
+    probable tokens and/or the smallest set whose cumulative probability
+    reaches ``top_p`` (nucleus sampling; both filters compose, top-k
+    first). Compiled per (model, shapes, sampler config) — repeat calls
+    with the same shapes reuse the compile.
 
     ``use_cache=True`` (default): one full prefill over the prompt fills a
     static ``[B, T0+n_tokens]`` KV cache per layer, then each step runs ONE
@@ -301,17 +323,56 @@ def generate(
     on) — the whole decode loop then runs inside its ``shard_map`` with
     per-rank local-head caches; a vocab-parallel head's local logits are
     ``all_gather``\\ ed (one ``[B, vocab]`` row per step) for sampling.
-    Sequence-sharded and MoE models still need a dense rebuild for
-    inference.
+
+    MoE models decode with ``moe_impl='gshard'`` (plain-jit einsum
+    dispatch; an ``'ep'``-trained model rebuilds as gshard on the SAME
+    params — the expert stacks are identical). Use the cached path: the
+    cacheless reference routes the zero-padded buffer through the gate,
+    so with a tight ``capacity_factor`` padding competes with real tokens
+    for expert capacity and the two paths can diverge (a warning fires).
+    Sequence-sharded models still need a dense rebuild for inference.
+
+    GSPMD at-rest layouts decode as-is: the decode loop is plain jit, so
+    params placed by :func:`~chainermn_tpu.parallel.gspmd.megatron_shard`
+    run under the partitioner, which inserts the gathers the Megatron
+    layout needs (pinned by ``test_generate_with_megatron_layout``).
     """
-    if model.sequence_axis is not None or model.moe_experts:
+    if model.sequence_axis is not None:
         raise ValueError(
-            "generate() supports dense and tensor-parallel models: rebuild "
-            "without sequence_axis/moe_experts (attention='full') for "
-            "inference"
+            "generate() does not support sequence-sharded models: rebuild "
+            "with sequence_axis=None (attention='full') for inference"
+        )
+    if model.moe_experts and model.moe_impl != "gshard":
+        raise ValueError(
+            "generate() supports MoE only via moe_impl='gshard' — rebuild "
+            "the model with moe_impl='gshard' for inference (same params)"
         )
     if temperature and rng is None:
         raise ValueError("temperature sampling needs an rng key")
+    if (top_k or top_p < 1.0) and not temperature:
+        raise ValueError(
+            "top_k/top_p filter the sampling distribution; with "
+            "temperature=0 (greedy) they have no effect — pass a "
+            "temperature > 0"
+        )
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if not 0 <= top_k <= model.vocab_size:
+        raise ValueError(
+            f"top_k must be in [0, vocab_size={model.vocab_size}], got "
+            f"{top_k} (0 disables the filter)"
+        )
+    if model.moe_experts and not use_cache:
+        import warnings
+
+        warnings.warn(
+            "cacheless decode of an MoE model routes the zero-padded "
+            "buffer positions through the gate, so padding competes for "
+            "expert capacity: tokens can differ from the cached path "
+            "(which routes only real tokens) unless capacity_factor is "
+            "ample. Prefer use_cache=True for MoE decoding.",
+            stacklevel=2,
+        )
     b, t0 = prompt.shape
     if t0 + n_tokens > model.max_len:
         raise ValueError(
@@ -324,39 +385,57 @@ def generate(
                 "tensor-parallel generate() needs comm= and use_cache=True "
                 "(the decode loop runs inside the communicator's shard_map)"
             )
-        run = _generate_tp_fn(model, int(n_tokens), float(temperature), b,
-                              int(t0), jnp.dtype(prompt.dtype).name, comm)
+        run = _generate_tp_fn(model, int(n_tokens), float(temperature),
+                              int(top_k), float(top_p), b, int(t0),
+                              jnp.dtype(prompt.dtype).name, comm)
         return run(params, prompt, rng)
     fn = _generate_cached_fn if use_cache else _generate_fn
-    run = fn(model, int(n_tokens), float(temperature), b, int(t0),
-             jnp.dtype(prompt.dtype).name)
+    run = fn(model, int(n_tokens), float(temperature), int(top_k),
+             float(top_p), b, int(t0), jnp.dtype(prompt.dtype).name)
     return run(params, prompt, rng)
 
 
-def _sampler(temperature):
+def _sampler(temperature, top_k=0, top_p=1.0):
     """(logits [B, V], key) -> (token [B], key); the split sequence is
     identical between the cached and cacheless paths so sampled outputs
-    match too (given equal logits)."""
+    match too (given equal logits).
+
+    Filters compose in the standard order: temperature scaling, then top-k
+    truncation, then nucleus (top-p) truncation of what remains. Top-p
+    always keeps at least the most probable token (the mask keeps entries
+    whose cumulative probability BEFORE them is < p)."""
 
     def sample(lg, key):
         key, sub = jax.random.split(key)
-        if temperature:
-            return jax.random.categorical(sub, lg / temperature, axis=-1), key
-        return jnp.argmax(lg, axis=-1), key
+        if not temperature:
+            return jnp.argmax(lg, axis=-1), key
+        lg = lg / temperature
+        if top_k:
+            kth = lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        if top_p < 1.0:
+            srt = jnp.sort(lg, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+            cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                             keepdims=True)
+            lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+        return jax.random.categorical(sub, lg, axis=-1), key
 
     return sample
 
 
 @functools.lru_cache(maxsize=32)
-def _generate_cached_fn(model, n_tokens, temperature, b, t0, dtype_name):
+def _generate_cached_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
+                        dtype_name):
     """KV-cached decode: one prefill over the prompt, then one token per
-    step against the static cache. Compiled per (model, shape, temperature)
+    step against the static cache. Compiled per (model, shape, sampler)
     key. NOTE the lru_cache retains compiled programs closed over param
     SHAPES only (params are arguments), but each entry still holds a
     full decode executable — bounded by maxsize."""
     total = t0 + n_tokens
     dtype = jnp.dtype(dtype_name)
-    sample = _sampler(temperature)
+    sample = _sampler(temperature, top_k, top_p)
 
     @jax.jit
     def run(params, prompt, rng):
@@ -383,7 +462,8 @@ def _generate_cached_fn(model, n_tokens, temperature, b, t0, dtype_name):
 
 
 @functools.lru_cache(maxsize=8)
-def _generate_tp_fn(model, n_tokens, temperature, b, t0, dtype_name, comm):
+def _generate_tp_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
+                    dtype_name, comm):
     """Tensor-parallel cached decode: the same loop as
     :func:`_generate_cached_fn` traced INSIDE ``comm.shard_map`` — per-rank
     caches hold the rank's local heads, and a vocab-parallel head's local
@@ -394,7 +474,7 @@ def _generate_tp_fn(model, n_tokens, temperature, b, t0, dtype_name, comm):
 
     total = t0 + n_tokens
     dtype = jnp.dtype(dtype_name)
-    sample = _sampler(temperature)
+    sample = _sampler(temperature, top_k, top_p)
     axis = model.tensor_axis
     n_tp = comm.mesh.shape[axis]
     if model.n_heads % n_tp:
@@ -440,14 +520,16 @@ def _generate_tp_fn(model, n_tokens, temperature, b, t0, dtype_name, comm):
 
 
 @functools.lru_cache(maxsize=32)
-def _generate_fn(model, n_tokens, temperature, b, t0, dtype_name):
+def _generate_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
+                 dtype_name):
     """The cacheless reference decode (round-3 behavior): re-runs the full
     forward over the whole buffer per token — O(T^2) attention x T tokens.
     Kept as the independent correctness reference for the cached path.
-    One compiled decode program per (model, shape, temperature) key —
+    One compiled decode program per (model, shape, sampler) key —
     flax modules are frozen/hashable, so they key an lru_cache directly."""
     total = t0 + n_tokens
     dtype = jnp.dtype(dtype_name)
+    sample = _sampler(temperature, top_k, top_p)
 
     @jax.jit
     def run(params, prompt, rng):
@@ -458,13 +540,7 @@ def _generate_fn(model, n_tokens, temperature, b, t0, dtype_name):
             logits = model.apply(params, buf)      # [B, total, V]
             # the token at position i is predicted from the logits at i-1
             nxt_logits = lax.dynamic_slice_in_dim(logits, i - 1, 1, axis=1)[:, 0]
-            key, sub = jax.random.split(key)
-            if temperature:
-                nxt = jax.random.categorical(
-                    sub, nxt_logits / temperature, axis=-1
-                )
-            else:
-                nxt = jnp.argmax(nxt_logits, axis=-1)
+            nxt, key = sample(nxt_logits, key)
             buf = buf.at[:, i].set(nxt.astype(buf.dtype))
             return (buf, key), None
 

@@ -12,7 +12,11 @@ from chainermn_tpu.parallel.fsdp import (
     fsdp_spec,
     jit_fsdp_train_step,
 )
-from chainermn_tpu.parallel.moe import ExpertParallelMLP, GShardMoE
+from chainermn_tpu.parallel.moe import (
+    ExpertParallelMLP,
+    GShardMoE,
+    MoeStatsAccumulator,
+)
 from chainermn_tpu.parallel.gspmd import (
     gspmd_lm_train_step,
     megatron_opt_shard,
@@ -43,6 +47,7 @@ __all__ = [
     "make_3d_mesh",
     "ExpertParallelMLP",
     "GShardMoE",
+    "MoeStatsAccumulator",
     "gspmd_lm_train_step",
     "megatron_param_specs",
     "megatron_shard",

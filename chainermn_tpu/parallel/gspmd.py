@@ -253,7 +253,11 @@ def gspmd_lm_train_step(
     moe_aux_weight: float = 0.01,
 ) -> Callable:
     """Plain-jit Megatron-TP LM train step: ``step(params, opt_state,
-    tokens, targets) -> (params, opt_state, loss)``.
+    tokens, targets) -> (params, opt_state, loss, stats)`` — the same
+    uniform arity as :func:`chainermn_tpu.training.jit_lm_train_step`:
+    ``stats`` is ``{}`` for dense models and ``{'moe_drop_frac': ...}``
+    for gshard-MoE models (the capacity-drop telemetry is visible at
+    GSPMD scale too, not only under the shard_map step).
 
     ``params``/``opt_state`` should be placed with :func:`megatron_shard` /
     :func:`megatron_opt_shard` (the step re-constrains them each iteration,
@@ -311,21 +315,28 @@ def gspmd_lm_train_step(
 
         def loss_fn(p):
             if moe:
-                logits, aux = model.apply(p, tokens, 0, return_aux=True)
+                (logits, aux), sown = model.apply(
+                    p, tokens, 0, return_aux=True, mutable=["moe_stats"])
             else:
-                logits, aux = model.apply(p, tokens, 0), 0.0
+                logits, aux, sown = model.apply(p, tokens, 0), 0.0, {}
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets
             ).mean()
-            return ce + moe_aux_weight * aux
+            return ce + moe_aux_weight * aux, sown
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        (loss, sown), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
         grads = constrain(grads, specs)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = constrain(optax.apply_updates(params, updates), specs)
         opt_state = constrain(opt_state,
                               _opt_specs(optimizer, opt_state, specs))
-        return params, opt_state, loss
+        if moe:
+            from chainermn_tpu.parallel.moe import drop_frac_from_sown
+
+            return params, opt_state, loss, {
+                "moe_drop_frac": drop_frac_from_sown(sown)}
+        return params, opt_state, loss, {}
 
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)

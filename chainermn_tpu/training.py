@@ -265,7 +265,9 @@ def _jit_tp_lm_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, new_opt_state, loss
+        # uniform step arity with the dense/MoE path: stats is always there
+        # (TP models are dense, so it is always empty here)
+        return params, new_opt_state, loss, {}
 
     # batch dim over the dp axes, sequence dim over the model's seq axis
     data = P(dp_axes if dp_axes else None,
@@ -273,7 +275,7 @@ def _jit_tp_lm_train_step(
     sm = comm.shard_map(
         body,
         in_specs=(P(), P(), data, data),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), P(), P()),
     )
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(sm, donate_argnums=donate_argnums)
@@ -289,11 +291,13 @@ def jit_lm_train_step(
 ) -> Callable:
     """Jitted next-token-prediction step for :class:`TransformerLM`-shaped
     models. Call as ``step(params, opt_state, tokens, targets)`` ->
-    ``(params, opt_state, loss)`` — MoE models return a fourth element,
-    ``{'moe_drop_frac': ...}``: the globally-averaged fraction of expert
-    assignments dropped to the capacity bound this step (silent drops were
-    round 3's telemetry gap — log it; a persistently high value means the
-    gate is unbalanced or capacity_factor is too small).
+    ``(params, opt_state, loss, stats)``. ``stats`` is a dict — ``{}`` for
+    dense models; MoE models carry ``{'moe_drop_frac': ...}``: the
+    globally-averaged fraction of expert assignments dropped to the
+    capacity bound this step (silent drops were round 3's telemetry gap —
+    log it; a persistently high value means the gate is unbalanced or
+    capacity_factor is too small). The arity is uniform on purpose: it
+    does not change under the model config (round-4 advisor finding).
 
     ``shard_sequence=False``: batch axis sharded over the mesh (pure DP).
     ``shard_sequence=True``: the SEQUENCE axis is sharded (context
@@ -368,25 +372,18 @@ def jit_lm_train_step(
         params = optax.apply_updates(params, updates)
         loss = comm.allreduce(loss, "mean")
         if not moe_experts:
-            return params, new_opt_state, loss
+            return params, new_opt_state, loss, {}
         # routing telemetry: mean drop fraction over the MoE layers (each
-        # leaf is already pmean'd over the expert axis inside the module).
-        # sow() appends, so take the LAST leaf per (tuple-valued) entry in
-        # case the caller's variables carried stale stats in.
-        entries = [v for path, v in jax.tree_util.tree_flatten_with_path(
-            sown, is_leaf=lambda x: isinstance(x, tuple))[0]
-            if "drop_frac" in jax.tree_util.keystr(path)]
-        drops = [e[-1] if isinstance(e, tuple) else e for e in entries]
-        # moe_experts set but no layer actually MoE (e.g. n_layers=1 with
-        # moe_every=2): no assignments, no drops — report 0, don't crash
-        stats = {"moe_drop_frac": (jnp.mean(jnp.stack(drops)) if drops
-                                   else jnp.float32(0.0))}
-        return params, new_opt_state, loss, stats
+        # leaf is already pmean'd over the expert axis inside the module)
+        from chainermn_tpu.parallel.moe import drop_frac_from_sown
+
+        return params, new_opt_state, loss, {
+            "moe_drop_frac": drop_frac_from_sown(sown)}
 
     data = P(None, comm.axis_name) if shard_sequence else comm.data_spec
     opt_spec = getattr(optimizer, "state_spec", P())
-    out_specs = ((P(), opt_spec, P(), P()) if moe_experts
-                 else (P(), opt_spec, P()))
+    # 4th slot is the stats dict: {} for dense (P() applies to no leaves)
+    out_specs = (P(), opt_spec, P(), P())
     sm = comm.shard_map(
         body,
         in_specs=(P(), opt_spec, data, data),

@@ -63,14 +63,20 @@ def _stream_data(args):
 def _sequential_train_loop(args, comm, step, params, opt_state,
                            toks, tgts, n_seq, batch):
     """The shared strided train/telemetry loop for the pipeline and gspmd
-    modes (3-tuple steps, no shuffling): one place for the compile-time
-    exclusion, tok/s logging, and the final footer."""
+    modes (no shuffling): one place for the compile-time exclusion, tok/s
+    logging, MoE drop aggregation, and the final footer. Steps may return
+    3-tuples (pipeline) or the uniform 4-tuple (gspmd)."""
+    from chainermn_tpu.parallel import MoeStatsAccumulator
+
     t0, seen, first, loss = time.time(), 0, None, None
+    acc = MoeStatsAccumulator()
     for it in range(1, args.iterations + 1):
         i = (it * batch) % max(1, n_seq - batch)
-        params, opt_state, loss = step(
+        out = step(
             params, opt_state, jnp.asarray(toks[i : i + batch]),
             jnp.asarray(tgts[i : i + batch]))
+        params, opt_state, loss = out[:3]
+        acc.update(out[3] if len(out) > 3 else {})
         if it == 1:
             jax.block_until_ready(loss)
             first = float(loss)
@@ -82,7 +88,10 @@ def _sequential_train_loop(args, comm, step, params, opt_state,
             print(f"iter {it:4d}  loss {float(loss):.3f}  "
                   f"{seen / (time.time() - t0):.0f} tok/s")
     if comm.rank == 0 and loss is not None:
-        print(f"done: loss {first:.3f} -> {float(loss):.3f}")
+        s = acc.summary()
+        drop = (f"  moe_drop mean {s['moe_drop_frac_mean']:.1%} "
+                f"max {s['moe_drop_frac_max']:.1%}" if s["steps"] else "")
+        print(f"done: loss {first:.3f} -> {float(loss):.3f}{drop}")
     return params, opt_state
 
 
@@ -354,15 +363,18 @@ def main() -> None:
               f"seq_parallel={args.seq_parallel} moe={args.moe_experts} "
               f"tensor_parallel={args.tensor_parallel} devices={comm.size}")
 
+    from chainermn_tpu.parallel import MoeStatsAccumulator
+
     gen = batches()
     t0, toks = time.time(), 0
     first = last = None
+    acc = MoeStatsAccumulator()
     for it in range(1, args.iterations + 1):
         tok, tgt = next(gen)
-        out = step(params, opt_state, jnp.asarray(tok), jnp.asarray(tgt))
-        # MoE steps return routing telemetry as a 4th element
-        params, opt_state, loss = out[:3]
-        stats = out[3] if len(out) > 3 else {}
+        # uniform step arity: stats is {} for dense models
+        params, opt_state, loss, stats = step(
+            params, opt_state, jnp.asarray(tok), jnp.asarray(tgt))
+        acc.update(stats)
         if it == 1:
             jax.block_until_ready(loss)
             first = float(loss)
@@ -379,8 +391,11 @@ def main() -> None:
                   f"{toks / (time.time() - t0):.0f} tok/s{drop}")
     last = float(loss)
     if comm.rank == 0:
+        s = acc.summary()
+        drop = (f"  moe_drop mean {s['moe_drop_frac_mean']:.1%} "
+                f"max {s['moe_drop_frac_max']:.1%}" if s["steps"] else "")
         print(f"done: {args.iterations} iterations, "
-              f"loss {first:.3f} -> {last:.3f}")
+              f"loss {first:.3f} -> {last:.3f}{drop}")
 
 
 if __name__ == "__main__":

@@ -69,7 +69,7 @@ def test_flash_lm_train_step_data_parallel(comm):
     step = jit_lm_train_step(lm, opt, comm)
     losses = []
     for _ in range(3):
-        params, opt_state, loss = step(params, opt_state, tokens, tokens)
+        params, opt_state, loss, _ = step(params, opt_state, tokens, tokens)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
 
@@ -125,7 +125,7 @@ def test_zigzag_lm_train_step_learns(comm, kind):
 
     losses = []
     for _ in range(5):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        params, opt_state, loss, _ = step(params, opt_state, tokens, targets)
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
@@ -144,7 +144,7 @@ def test_ring_flash_lm_train_step_learns(comm):
     step = jit_lm_train_step(model, opt, comm, shard_sequence=True)
     losses = []
     for _ in range(4):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        params, opt_state, loss, _ = step(params, opt_state, tokens, targets)
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
@@ -163,7 +163,7 @@ def test_lm_train_step_sequence_parallel_learns(comm):
 
     losses = []
     for _ in range(5):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        params, opt_state, loss, _ = step(params, opt_state, tokens, targets)
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
@@ -178,8 +178,8 @@ def test_lm_train_step_data_parallel(comm):
     opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(1e-2), comm)
     opt_state = jax.device_put(opt.init(params), comm.named_sharding())
     step = jit_lm_train_step(model, opt, comm, shard_sequence=False)
-    p1, s1, l1 = step(params, opt_state, tokens, targets)
-    _, _, l2 = step(p1, s1, tokens, targets)
+    p1, s1, l1, _ = step(params, opt_state, tokens, targets)
+    _, _, l2, _ = step(p1, s1, tokens, targets)
     assert float(l2) < float(l1)
 
 

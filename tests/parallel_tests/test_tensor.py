@@ -153,7 +153,7 @@ def test_tp_transformer_lm_trains(comm):
     step = jit_lm_train_step(lm, opt, comm, donate=False)
     losses = []
     for _ in range(5):
-        params, state, lval = step(params, state, tokens, tokens)
+        params, state, lval, _ = step(params, state, tokens, tokens)
         losses.append(float(lval))
     assert losses[-1] < losses[0], losses
 
@@ -230,7 +230,7 @@ def test_tp_lm_vocab_parallel_head_trains(comm):
     step = jit_lm_train_step(lm, opt, comm, donate=False)
     losses = []
     for _ in range(5):
-        params, state, lval = step(params, state, tokens, tokens)
+        params, state, lval, _ = step(params, state, tokens, tokens)
         losses.append(float(lval))
     assert losses[-1] < losses[0], losses
 
@@ -329,7 +329,7 @@ def test_3d_dp_sp_tp_lm_trains(comm):
     step = jit_lm_train_step(lm, opt, c3, shard_sequence=True, donate=False)
     losses = []
     for _ in range(5):
-        params, state, lval = step(params, state, tokens, tokens)
+        params, state, lval, _ = step(params, state, tokens, tokens)
         losses.append(float(lval))
     assert losses[-1] < losses[0], losses
 
