@@ -479,10 +479,11 @@ def parent_main() -> None:
             break
         attempt_timeout = min(attempt_timeout, remaining)
         if not child_budget_pinned:
-            # strictly inside the (possibly just-clamped) attempt timeout,
-            # for small timeouts too: 80% when the 90s margin would invert
+            # strictly inside the (possibly just-clamped) attempt timeout:
+            # the 90s margin normally, 80% when the margin would over-shrink
+            # a small timeout — max() of two values each < attempt_timeout
             os.environ["CHAINERMN_TPU_BENCH_CHILD_BUDGET"] = str(
-                max(30.0, min(attempt_timeout - 90.0, attempt_timeout * 0.8))
+                max(attempt_timeout - 90.0, attempt_timeout * 0.8)
             )
         attempts_run = i
         popen = subprocess.Popen(

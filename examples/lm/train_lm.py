@@ -60,6 +60,16 @@ def _stream_data(args):
     return toks, tgts, n_seq
 
 
+def _drop_suffix(acc) -> str:
+    """Footer fragment for the aggregated MoE drop telemetry ('' when the
+    run had no MoE steps) — shared by every mode's final log line."""
+    s = acc.summary()
+    if not s["steps"]:
+        return ""
+    return (f"  moe_drop mean {s['moe_drop_frac_mean']:.1%} "
+            f"max {s['moe_drop_frac_max']:.1%}")
+
+
 def _sequential_train_loop(args, comm, step, params, opt_state,
                            toks, tgts, n_seq, batch):
     """The shared strided train/telemetry loop for the pipeline and gspmd
@@ -88,10 +98,8 @@ def _sequential_train_loop(args, comm, step, params, opt_state,
             print(f"iter {it:4d}  loss {float(loss):.3f}  "
                   f"{seen / (time.time() - t0):.0f} tok/s")
     if comm.rank == 0 and loss is not None:
-        s = acc.summary()
-        drop = (f"  moe_drop mean {s['moe_drop_frac_mean']:.1%} "
-                f"max {s['moe_drop_frac_max']:.1%}" if s["steps"] else "")
-        print(f"done: loss {first:.3f} -> {float(loss):.3f}{drop}")
+        print(f"done: loss {first:.3f} -> {float(loss):.3f}"
+              f"{_drop_suffix(acc)}")
     return params, opt_state
 
 
@@ -391,11 +399,8 @@ def main() -> None:
                   f"{toks / (time.time() - t0):.0f} tok/s{drop}")
     last = float(loss)
     if comm.rank == 0:
-        s = acc.summary()
-        drop = (f"  moe_drop mean {s['moe_drop_frac_mean']:.1%} "
-                f"max {s['moe_drop_frac_max']:.1%}" if s["steps"] else "")
         print(f"done: {args.iterations} iterations, "
-              f"loss {first:.3f} -> {last:.3f}{drop}")
+              f"loss {first:.3f} -> {last:.3f}{_drop_suffix(acc)}")
 
 
 if __name__ == "__main__":
