@@ -36,6 +36,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30
+# Row statistics (lse, delta) are stored lane-broadcast to this width so
+# their blocks satisfy Mosaic's (8, 128) tiling rule — the same layout the
+# reference jax.experimental.pallas TPU flash kernel uses for l/m.
+_LANE = 128
 
 
 def _smem_spec():
@@ -64,6 +68,21 @@ def _pick_block(t: int, preferred: int = 128) -> int:
 
 def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _out_vma(*xs) -> frozenset:
+    """Varying-manner annotation for kernel outputs: the union of the
+    inputs' vma sets. pallas_call does not infer vma, so under
+    ``shard_map(check_vma=True)`` — the default on real TPU — out_shapes
+    with ``vma=None`` fail at trace time. Caught by the round-5 AOT
+    schedule analysis (scripts/aot_ring_overlap.py); the CPU suite never
+    sees it because interpret-mode tests run with check_vma=False."""
+    vma = frozenset()
+    for x in xs:
+        v = getattr(jax.typeof(x), "vma", None)
+        if v:
+            vma |= v
+    return vma
 
 
 def _fold_args(b, h, d, *xs):
@@ -126,8 +145,13 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # rows with no visible keys get lse = -inf-ish; backward masks them out
-    lse_ref[0] = jnp.where(l == 0.0, _NEG_BIG, m + jnp.log(l_safe))
+    # rows with no visible keys get lse = -inf-ish; backward masks them out.
+    # lse is written lane-broadcast [block_q, _LANE]: a [1, block_q] block
+    # violates Mosaic's sublane rule (dim -2 divisible by 8 or equal to the
+    # array dim), so the row statistic rides a 128-lane tile like the
+    # reference TPU flash kernel's l/m
+    lse = jnp.where(l == 0.0, _NEG_BIG, m + jnp.log(l_safe))
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
@@ -151,18 +175,20 @@ def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             # out_dtype=f32 lets ring callers merge partial block outputs
             # without a bf16 round-trip (q/k/v still feed the MXU in their
             # input dtype; the kernel accumulates f32 regardless)
-            jax.ShapeDtypeStruct((bh, tq, d), out_dtype or q.dtype),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq, d), out_dtype or q.dtype,
+                                 vma=_out_vma(q, k, v)),
+            jax.ShapeDtypeStruct((bh, tq, _LANE), jnp.float32,
+                                 vma=_out_vma(q, k, v)),
         ],
         interpret=interpret,
     )(qo, ko, q, k, v)
-    return out, lse
+    return out, lse[..., 0]
 
 
 # --------------------------------------------------------------------------- #
@@ -180,8 +206,8 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, :, 0]     # lane-broadcast [block_q, _LANE]; see _fwd
+    delta = delta_ref[0, :, 0]
     q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(j, dq):
@@ -233,8 +259,8 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk, dv = carry
         qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -279,6 +305,9 @@ def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
     (which pass ``grad_dtype=f32`` to accumulate across blocks losslessly)."""
     bh, tq, d = q.shape
     tk = k.shape[1]
+    # lane-broadcast the row stats to the Mosaic-tileable layout (see _fwd)
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANE))
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANE))
     smem = _smem_spec()
     return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -290,11 +319,12 @@ def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
             pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), grad_dtype or q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), grad_dtype or q.dtype,
+                                       vma=_out_vma(q, k, v, do)),
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)
 
@@ -305,6 +335,8 @@ def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
     :func:`_dq_call`."""
     bh, tq, d = q.shape
     tk = k.shape[1]
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANE))
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANE))
     smem = _smem_spec()
     return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -316,16 +348,18 @@ def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, tq), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, tq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, tq, _LANE), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, tq, _LANE), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or k.dtype),
-            jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or v.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or k.dtype,
+                                 vma=_out_vma(q, k, v, do)),
+            jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or v.dtype,
+                                 vma=_out_vma(q, k, v, do)),
         ],
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)

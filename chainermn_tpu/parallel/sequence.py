@@ -40,6 +40,17 @@ from jax import lax
 _NEG_BIG = -1e30  # finite "minus infinity": avoids inf-inf NaNs in masked rows
 
 
+def _vary_to(x, vma):
+    """pcast ``x`` to varying over exactly the axes in ``vma`` it does not
+    already vary on. A plain ``pcast(..., to='varying')`` on a value that
+    already carries some of the axes raises ("Unsupported pcast
+    from=varying, to='varying'") — hit once the flash kernels started
+    propagating input vma to their outputs (round 5)."""
+    need = tuple(a for a in vma if a not in jax.typeof(x).vma)
+    return lax.pcast(x, need, to="varying") if need else x
+
+
+
 def _block_attend(q, k, v, *, scale, mask, m, l, o):
     """One flash-style block update.
 
@@ -101,7 +112,7 @@ def ring_attention(
     # the vma sets are empty and this degenerates to the ring axis alone.
     vma = (frozenset({axis_name}) | jax.typeof(q).vma
            | jax.typeof(k).vma | jax.typeof(v).vma)
-    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
+    _vary = lambda x: _vary_to(x, vma)
     m0 = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
     l0 = _vary(jnp.zeros((b, h, t), jnp.float32))
     o0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
@@ -199,7 +210,7 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal, scale):
     perm = [(i, (i + 1) % n) for i in range(n)]
     vma = (frozenset({axis_name}) | jax.typeof(q).vma
            | jax.typeof(k).vma | jax.typeof(v).vma)
-    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
+    _vary = lambda x: _vary_to(x, vma)
     o0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
     lse0 = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
 
@@ -238,7 +249,7 @@ def _ring_flash_bwd_rule(axis_name, causal, scale, res, do):
     ).transpose(0, 2, 1)
     vma = (jax.typeof(q).vma | jax.typeof(do).vma
            | frozenset({axis_name}))
-    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
+    _vary = lambda x: _vary_to(x, vma)
     dq0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
     dk0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
     dv0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
@@ -384,7 +395,7 @@ def _zigzag_flash_bwd_rule(axis_name, scale, res, do):
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     ).transpose(0, 2, 1)
     vma = jax.typeof(q).vma | jax.typeof(do).vma | frozenset({axis_name})
-    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
+    _vary = lambda x: _vary_to(x, vma)
     off_e, off_l = my * c, (2 * n - 1 - my) * c
 
     def grads(qs, ks, vs, dos, lses, deltas, *, causal, q_off=0, k_off=0):
@@ -413,6 +424,15 @@ def _zigzag_flash_bwd_rule(axis_name, scale, res, do):
 
     def body(step, carry):
         dq, dka, dva, kb, vb = carry
+        # NOTE (round-5 AOT schedule analysis, scripts/aot_ring_overlap.py):
+        # unlike the ring backward, these four permutes SERIALIZE after the
+        # conditional on real-TPU schedules — XLA will not hoist a
+        # collective start across the lax.cond that holds all of this
+        # body's compute, and issuing the k/v permutes before the cond in
+        # program order does not change the schedule (tried; the scheduler
+        # sinks them back). Cost bound and the structural fix (vector
+        # position offsets to fold both branches into one kernel call) are
+        # documented in PERF.md "Ring overlap".
 
         def from_earlier(args):
             dq, dka, dva = args
@@ -558,7 +578,7 @@ def zigzag_ring_attention(
     q32 = q.astype(jnp.float32)
     vma = (frozenset({axis_name}) | jax.typeof(q).vma
            | jax.typeof(k).vma | jax.typeof(v).vma)
-    _vary = lambda x: lax.pcast(x, tuple(vma), to="varying")
+    _vary = lambda x: _vary_to(x, vma)
     m = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
     l = _vary(jnp.zeros((b, h, t), jnp.float32))
     o = _vary(jnp.zeros((b, t, h, d), jnp.float32))

@@ -11,8 +11,6 @@ import socket
 import subprocess
 import sys
 
-import pytest
-
 import chainermn_tpu
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -22,6 +20,9 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 
 
 def _free_port() -> int:
+    # bind-close-reuse has an inherent race (another process can claim the
+    # port in the gap); if it ever fires, the failure surfaces with full
+    # worker logs via the TimeoutExpired path below
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
@@ -66,7 +67,21 @@ def test_multicontroller_traced_training(tmp_path):
     outs = []
     try:
         for p, log in zip(procs, logs):
-            p.wait(timeout=600)
+            try:
+                p.wait(timeout=600)
+            except subprocess.TimeoutExpired:
+                # a hung worker is the canonical multi-controller failure:
+                # fail with every rank's log tail, not a bare timeout
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                tails = []
+                for r, lg in enumerate(logs):
+                    lg.seek(0)
+                    tails.append(f"--- rank {r} log tail ---\n"
+                                 f"{lg.read()[-2000:]}")
+                raise AssertionError(
+                    "worker hung (600s); logs:\n" + "\n".join(tails))
             log.seek(0)
             outs.append(log.read())
     finally:
