@@ -182,9 +182,9 @@ def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
             # without a bf16 round-trip (q/k/v still feed the MXU in their
             # input dtype; the kernel accumulates f32 regardless)
             jax.ShapeDtypeStruct((bh, tq, d), out_dtype or q.dtype,
-                                 vma=_out_vma(q, k, v)),
+                                 vma=_out_vma(qo, ko, q, k, v)),
             jax.ShapeDtypeStruct((bh, tq, _LANE), jnp.float32,
-                                 vma=_out_vma(q, k, v)),
+                                 vma=_out_vma(qo, ko, q, k, v)),
         ],
         interpret=interpret,
     )(qo, ko, q, k, v)
@@ -324,7 +324,7 @@ def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), grad_dtype or q.dtype,
-                                       vma=_out_vma(q, k, v, do)),
+                                       vma=_out_vma(qo2, ko2, q, k, v, do)),
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)
 
@@ -357,9 +357,9 @@ def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or k.dtype,
-                                 vma=_out_vma(q, k, v, do)),
+                                 vma=_out_vma(qo2, ko2, q, k, v, do)),
             jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or v.dtype,
-                                 vma=_out_vma(q, k, v, do)),
+                                 vma=_out_vma(qo2, ko2, q, k, v, do)),
         ],
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)

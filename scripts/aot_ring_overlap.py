@@ -61,30 +61,37 @@ def analyze_schedule(text: str):
 
 
 def _analyze_body(comp_name, body):
-    ops = []  # (index, result_name, opcode)
+    ops = []  # (index, result_name, opcode, raw_line)
     for idx, l in enumerate(body):
         m = re.match(r"\s*(?:ROOT\s+)?(\S+)\s*=\s*.*?\b([a-z][\w-]*)\(", l)
         if not m:
             continue
-        ops.append((idx, m.group(1).lstrip("%"), m.group(2)))
-    starts = {name: i for i, name, op in ops
+        ops.append((idx, m.group(1).lstrip("%"), m.group(2), l))
+    starts = {name: i for i, name, op, _ in ops
               if op == "collective-permute-start"}
     if not starts:
         return None
-    dones = {}
-    for i, name, op in ops:
+    # pair each done with its start by OPERAND (the done's argument names
+    # the start op) — name-suffix pairing breaks on .remat/.clone suffixes
+    # and would silently drop pairs, letting an un-analyzed schedule read
+    # as "all overlapped"
+    done_for_start = {}
+    for i, name, op, raw in ops:
         if op == "collective-permute-done":
-            # done's operand is the start; name them by suffix pairing
-            suffix = name.replace("collective-permute-done", "")
-            dones[suffix] = i
-    heavy = [(i, name, op) for i, name, op in ops
+            mo = re.search(r"collective-permute-done\(\s*%?([\w.-]+)", raw)
+            if mo:
+                done_for_start[mo.group(1)] = i
+    heavy = [(i, name, op) for i, name, op, _ in ops
              if any(op == h or op.startswith(h) for h in _HEAVY)
              and "collective-permute" not in op]
     pairs = []
     for sname, si in starts.items():
-        suffix = sname.replace("collective-permute-start", "")
-        di = dones.get(suffix)
+        di = done_for_start.get(sname)
         if di is None:
+            # unmatched start: loud failure, never a silent drop
+            pairs.append({"start": sname, "start_pos": si,
+                          "done_pos": None, "heavy_between": [],
+                          "overlapped": False, "unmatched_done": True})
             continue
         between = [f"{op}:{name[:40]}" for i, name, op in heavy
                    if si < i < di]
@@ -176,10 +183,11 @@ def main():
         try:
             compiled = fn.lower(*avals).compile()
             comps = analyze_schedule(compiled.as_text())
+            verdicts = [c["all_overlapped"] for c in comps
+                        if c["all_overlapped"] is not None]
+            # no analyzed pairs at all -> None (inconclusive), never True
             rec = {"case": name, "computations": comps,
-                   "all_overlapped": all(
-                       c["all_overlapped"] for c in comps
-                       if c["all_overlapped"] is not None) if comps else None}
+                   "all_overlapped": all(verdicts) if verdicts else None}
         except Exception as e:
             rec = {"case": name, "error": f"{type(e).__name__}: {e}"[:400]}
         results.append(rec)
