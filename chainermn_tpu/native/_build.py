@@ -18,8 +18,10 @@ import sys
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def build_and_load(src_basename: str, stem: str) -> ctypes.CDLL:
-    """Compile ``<native>/<src_basename>`` (if needed) and dlopen it."""
+def build_and_load(src_basename: str, stem: str,
+                   extra_flags: tuple = ()) -> ctypes.CDLL:
+    """Compile ``<native>/<src_basename>`` (if needed) and dlopen it.
+    ``extra_flags`` append to the g++ line (e.g. ``("-ljpeg",)``)."""
     src = os.path.join(_DIR, src_basename)
     lib_path = os.path.join(
         _DIR, f"_{stem}_py{sys.version_info[0]}{sys.version_info[1]}.so"
@@ -34,7 +36,7 @@ def build_and_load(src_basename: str, stem: str) -> ctypes.CDLL:
                 tmp = lib_path + ".tmp"
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     "-pthread", src, "-o", tmp],
+                     "-pthread", src, "-o", tmp, *extra_flags],
                     check=True, capture_output=True, text=True,
                 )
                 os.replace(tmp, lib_path)

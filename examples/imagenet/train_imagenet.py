@@ -133,6 +133,11 @@ def main() -> None:
     parser.add_argument("--mnbn", action="store_true",
                         help="multi-node BatchNorm (cross-replica statistics)")
     parser.add_argument("--train-npz", default=None)
+    parser.add_argument("--train-dir", default=None,
+                        help="directory of JPEGs in class subfolders "
+                             "(root/<class>/*.jpg): decoded by the native "
+                             "libjpeg pipeline (PIL fallback), classes "
+                             "inferred from the tree")
     parser.add_argument("--n-synthetic", type=int, default=100000)
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--classes", type=int, default=1000)
@@ -181,8 +186,8 @@ def main() -> None:
             args.warmup_epochs = 5.0
         if args.label_smoothing is None:
             args.label_smoothing = 0.1
-        if args.val_frac is None:
-            args.val_frac = 0.02
+        if args.val_frac is None and not args.train_dir:
+            args.val_frac = 0.02  # --train-dir has no array split to hold out
     # None = unspecified: the recipe defaults the native loader ON (the
     # measured ~3x assembly win, PERF.md); an explicit True keeps hard
     # errors, an explicit False (--no-native-loader) forces numpy
@@ -211,10 +216,37 @@ def main() -> None:
               f"wire-dtype={wire} double_buffering={args.double_buffering} "
               f"devices={comm.size}")
 
-    dataset = (NpzImageNet(args.train_npz) if args.train_npz
-               else SyntheticImageNet(args.n_synthetic, args.image_size, args.classes))
-    val = None
-    if args.val_frac:
+    jpeg_it = None
+    if args.train_dir:
+        # JPEG-directory input: the loader shards the FILE LIST per process
+        # and decodes via the native libjpeg pipeline (chainermn_tpu.native
+        # .jpeg), so the array-dataset scatter machinery is bypassed.
+        if args.train_npz:
+            raise SystemExit("--train-dir and --train-npz are exclusive")
+        if args.val_frac:
+            raise SystemExit("--val-frac needs an array dataset and was "
+                             "passed explicitly; with --train-dir hold out "
+                             "a separate val/ tree instead")
+        from chainermn_tpu.native import jpeg as jpeg_mod
+
+        jpeg_it = jpeg_mod.JpegDirectoryLoader(
+            args.train_dir, args.batchsize * comm.size,
+            image_size=args.image_size, shuffle=True, seed=1,
+            rank=jax.process_index(), size=comm.process_size,
+        )
+        args.classes = len(jpeg_it.class_names)  # labels come from the tree
+        if comm.rank == 0:
+            print(f"input pipeline: JPEG directory, "
+                  f"{'native libjpeg' if jpeg_mod.native_available() else 'PIL fallback'}"
+                  f", {args.classes} classes, "
+                  f"{len(jpeg_it) * args.batchsize * comm.size} imgs/shard-epoch")
+        dataset = val = train = val_shard = None
+    else:
+        dataset = (NpzImageNet(args.train_npz) if args.train_npz
+                   else SyntheticImageNet(args.n_synthetic, args.image_size,
+                                          args.classes))
+        val = None
+    if dataset is not None and args.val_frac:
         # hold out the tail as the eval shard (deterministic split so every
         # process agrees before scattering)
         from chainermn_tpu.datasets import SubDataset
@@ -222,9 +254,11 @@ def main() -> None:
         n_val = max(1, int(len(dataset) * args.val_frac))
         val = SubDataset(dataset, range(len(dataset) - n_val, len(dataset)))
         dataset = SubDataset(dataset, range(len(dataset) - n_val))
-    train = chainermn_tpu.scatter_dataset(dataset, comm, shuffle=True, seed=0)
-    val_shard = (chainermn_tpu.scatter_dataset(val, comm, shuffle=False)
-                 if val is not None else None)
+    if dataset is not None:
+        train = chainermn_tpu.scatter_dataset(dataset, comm, shuffle=True,
+                                              seed=0)
+        val_shard = (chainermn_tpu.scatter_dataset(val, comm, shuffle=False)
+                     if val is not None else None)
 
     model_fn = ARCHS[args.arch]
     model = model_fn(args.classes)
@@ -243,49 +277,58 @@ def main() -> None:
             model = chainermn_tpu.create_mnbn_model(model, comm)
 
     global_batch = args.batchsize * comm.size
-    ensure_batch_fits(train, global_batch, comm.size)
-    if args.native_loader:
-        try:
-            from chainermn_tpu.native.dataloader import NativeBatchLoader
-
-            # zero-copy view of the shard: the C++ path gathers rows from
-            # the base array, fuses the normalize, prefetches a batch ahead
-            base, rows, ys = record_source(train)
-            native_it = NativeBatchLoader(base, ys, global_batch, rows=rows,
-                                          shuffle=True, seed=1)
-        except Exception as e:  # toolchain/build failure on THIS rank
-            # per-rank diagnostic: rank 0's banner can't see this failure
-            print(f"[rank {comm.rank}] native loader unavailable "
-                  f"({type(e).__name__}: {e})", flush=True)
-            native_it = None
-        # the step/evaluate cadence is collective — every rank must take
-        # the SAME input path, so agree before choosing (one rank's build
-        # failure would otherwise desync step counts and hang the job).
-        # ALWAYS agree first, even on the explicit-flag failure path: a
-        # lone rank raising before the collective would strand the others
-        # inside it — fail hard on every rank together instead.
-        args.native_loader = comm.allreduce_obj(
-            native_it is not None, lambda a, b: a and b)
-        if native_explicit and not args.native_loader:
-            raise SystemExit(
-                "--native-loader was explicitly requested but the native "
-                "extension is unavailable on at least one rank (see the "
-                "per-rank diagnostics above); an explicit opt-in must not "
-                "silently measure the numpy path")
+    if jpeg_it is not None:
+        # the JPEG loader yields ready float32 batches just like
+        # NativeBatchLoader -> the loop's pre-normalized branch
+        it = jpeg_it
+        batches = iter(it)
+        pre_normalized = True
+    else:
+        ensure_batch_fits(train, global_batch, comm.size)
         if args.native_loader:
-            it = native_it
-            batches = iter(it)
-    if not args.native_loader:
-        it = chainermn_tpu.SerialIterator(train, global_batch, shuffle=True, seed=1)
-    if comm.rank == 0:
-        print(f"input pipeline: "
-              f"{'native C++ prefetch' if args.native_loader else 'numpy'}")
+            try:
+                from chainermn_tpu.native.dataloader import NativeBatchLoader
+
+                # zero-copy view of the shard: the C++ path gathers rows from
+                # the base array, fuses the normalize, prefetches a batch ahead
+                base, rows, ys = record_source(train)
+                native_it = NativeBatchLoader(base, ys, global_batch, rows=rows,
+                                              shuffle=True, seed=1)
+            except Exception as e:  # toolchain/build failure on THIS rank
+                # per-rank diagnostic: rank 0's banner can't see this failure
+                print(f"[rank {comm.rank}] native loader unavailable "
+                      f"({type(e).__name__}: {e})", flush=True)
+                native_it = None
+            # the step/evaluate cadence is collective — every rank must take
+            # the SAME input path, so agree before choosing (one rank's build
+            # failure would otherwise desync step counts and hang the job).
+            # ALWAYS agree first, even on the explicit-flag failure path: a
+            # lone rank raising before the collective would strand the others
+            # inside it — fail hard on every rank together instead.
+            args.native_loader = comm.allreduce_obj(
+                native_it is not None, lambda a, b: a and b)
+            if native_explicit and not args.native_loader:
+                raise SystemExit(
+                    "--native-loader was explicitly requested but the native "
+                    "extension is unavailable on at least one rank (see the "
+                    "per-rank diagnostics above); an explicit opt-in must not "
+                    "silently measure the numpy path")
+            if args.native_loader:
+                it = native_it
+                batches = iter(it)
+        if not args.native_loader:
+            it = chainermn_tpu.SerialIterator(train, global_batch, shuffle=True, seed=1)
+        pre_normalized = args.native_loader
+        if comm.rank == 0:
+            print(f"input pipeline: "
+                  f"{'native C++ prefetch' if args.native_loader else 'numpy'}")
 
     sample = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.bfloat16)
     variables = comm.bcast_data(
         model.init(jax.random.PRNGKey(0), sample, train=True)
     )
-    steps_per_epoch = max(1, (len(train) * comm.process_size) // global_batch)
+    steps_per_epoch = (max(1, len(it)) if jpeg_it is not None else
+                       max(1, (len(train) * comm.process_size) // global_batch))
     if args.warmup_epochs:
         # linear scaling rule + warmup (arXiv:1711.04325): ramp to
         # lr x global_batch/256 over the warmup span, cosine-decay to 0.
@@ -368,7 +411,7 @@ def main() -> None:
     imgs = 0
     loss = jnp.float32(0)  # stays 0 if every batch is a ragged tail
     while it.epoch < args.epoch:
-        if args.native_loader:
+        if pre_normalized:
             images, labels = next(batches)  # pre-normalized, never ragged
         else:
             images, labels = collate(next(it), np.float32)
