@@ -353,22 +353,40 @@ def _zigzag_flash_fwd_pass(q, k, v, axis_name, scale):
     kb = lax.ppermute(k, axis_name, perm)
     vb = lax.ppermute(v, axis_name, perm)
 
+    # BRANCH-FREE ring steps (round 5): the round-5 AOT schedule analysis
+    # showed XLA will not hoist collective starts across a lax.cond, so a
+    # cond-shaped body serializes the ring's permutes against the kernels
+    # (PERF.md "Ring overlap"). Both former branches decompose into the
+    # SAME two fully-visible (c x c) kernel calls with selected operands —
+    # earlier-rank block: (q_e x k_e) + (q_l x k_e); later-rank block:
+    # (q_l x k_e) + (q_l x k_l) — equal FLOPs (the balance property), no
+    # control flow, so the scheduler overlaps the permutes like the plain
+    # ring's. Only the cheap elementwise merges are select-routed.
     def body(step, carry):
         o, lse, kb, vb = carry
+        earlier = my >= step  # the held block came from an earlier rank
+        ke, ve, kl, vl = kb[:, :c], vb[:, :c], kb[:, c:], vb[:, c:]
+        q_e, q_l = q[:, :c], q[:, c:]
 
-        def from_earlier(args):
-            o, lse = args
-            ob, lse_b = block(q, kb[:, :c], vb[:, :c], causal=False)
-            return _zz_merge(o, lse, ob, lse_b)
+        ob1, lse_b1 = block(jnp.where(earlier, q_e, q_l), ke, ve,
+                            causal=False)
+        ob2, lse_b2 = block(q_l, jnp.where(earlier, ke, kl),
+                            jnp.where(earlier, ve, vl), causal=False)
 
-        def from_later(args):
-            o, lse = args
-            ob, lse_b = block(q[:, c:], kb, vb, causal=False)
-            ol, lse_l = _zz_merge(o[:, c:], lse[:, :, c:], ob, lse_b)
-            return (jnp.concatenate([o[:, :c], ol], axis=1),
-                    jnp.concatenate([lse[:, :, :c], lse_l], axis=2))
+        o_e, lse_e = o[:, :c], lse[:, :, :c]
+        o_l, lse_l = o[:, c:], lse[:, :, c:]
+        # call 1 merges into the half its q rows came from
+        oe_m, lsee_m = _zz_merge(o_e, lse_e, ob1, lse_b1)
+        ol_m, lsel_m = _zz_merge(o_l, lse_l, ob1, lse_b1)
+        o_e = jnp.where(earlier, oe_m, o_e)
+        lse_e = jnp.where(earlier, lsee_m, lse_e)
+        o_l = jnp.where(earlier, o_l, ol_m)
+        lse_l = jnp.where(earlier, lse_l, lsel_m)
+        # call 2's q rows are always the late half
+        o_l, lse_l = _zz_merge(o_l, lse_l, ob2, lse_b2)
 
-        o, lse = lax.cond(my >= step, from_earlier, from_later, (o, lse))
+        o = jnp.concatenate([o_e, o_l], axis=1)
+        lse = jnp.concatenate([lse_e, lse_l], axis=2)
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
         return o, lse, kb, vb
@@ -422,37 +440,44 @@ def _zigzag_flash_bwd_rule(axis_name, scale, res, do):
     dka = lax.ppermute(dka, axis_name, perm)
     dva = lax.ppermute(dva, axis_name, perm)
 
+    # Branch-free like the forward (see _zigzag_flash_fwd_pass): a lax.cond
+    # body would serialize all four permutes against the kernels (XLA will
+    # not hoist collective starts across control flow — round-5 AOT
+    # schedule analysis, PERF.md "Ring overlap"). The two former branches
+    # are the same two (c x c) kernel calls with selected operands:
+    #   earlier: (q_e x k_e) + (q_l x k_e)   later: (q_l x k_e) + (q_l x k_l)
+    # Only the cheap gradient scatter-adds are select-routed.
     def body(step, carry):
         dq, dka, dva, kb, vb = carry
-        # NOTE (round-5 AOT schedule analysis, scripts/aot_ring_overlap.py):
-        # unlike the ring backward, these four permutes SERIALIZE after the
-        # conditional on real-TPU schedules — XLA will not hoist a
-        # collective start across the lax.cond that holds all of this
-        # body's compute, and issuing the k/v permutes before the cond in
-        # program order does not change the schedule (tried; the scheduler
-        # sinks them back). Cost bound and the structural fix (vector
-        # position offsets to fold both branches into one kernel call) are
-        # documented in PERF.md "Ring overlap".
+        earlier = my >= step
+        ke, ve, kl, vl = kb[:, :c], vb[:, :c], kb[:, c:], vb[:, c:]
+        q_e, q_l = q[:, :c], q[:, c:]
+        do_e, do_l = do[:, :c], do[:, c:]
+        lse_e, lse_l = lse[:, :, :c], lse[:, :, c:]
+        de, dl = delta[:, :, :c], delta[:, :, c:]
 
-        def from_earlier(args):
-            dq, dka, dva = args
-            dqb, dkb, dvb = grads(q, kb[:, :c], vb[:, :c], do, lse, delta,
-                                  causal=False)
-            zeros = jnp.zeros((b, c, h, d), jnp.float32)
-            return (dq + dqb,
-                    dka + jnp.concatenate([dkb, zeros], axis=1),
-                    dva + jnp.concatenate([dvb, zeros], axis=1))
+        dq1, dk1, dv1 = grads(jnp.where(earlier, q_e, q_l), ke, ve,
+                              jnp.where(earlier, do_e, do_l),
+                              jnp.where(earlier, lse_e, lse_l),
+                              jnp.where(earlier, de, dl), causal=False)
+        dq2, dk2, dv2 = grads(q_l, jnp.where(earlier, ke, kl),
+                              jnp.where(earlier, ve, vl),
+                              do_l, lse_l, dl, causal=False)
 
-        def from_later(args):
-            dq, dka, dva = args
-            dqb, dkb, dvb = grads(q[:, c:], kb, vb, do[:, c:],
-                                  lse[:, :, c:], delta[:, :, c:],
-                                  causal=False)
-            dq = jnp.concatenate([dq[:, :c], dq[:, c:] + dqb], axis=1)
-            return dq, dka + dkb, dva + dvb
-
-        dq, dka, dva = lax.cond(my >= step, from_earlier, from_later,
-                                (dq, dka, dva))
+        zc = jnp.zeros((b, c, h, d), jnp.float32)
+        # dq: call 1's rows are q_e (earlier) or q_l (later); call 2's
+        # rows are always q_l
+        dq = dq + jnp.concatenate(
+            [jnp.where(earlier, dq1, zc),
+             jnp.where(earlier, dq2, dq1 + dq2)], axis=1)
+        # dk/dv: call 1 always hits the early K half; call 2 hits the
+        # early half (earlier) or the late half (later)
+        dka = dka + jnp.concatenate(
+            [dk1 + jnp.where(earlier, dk2, zc),
+             jnp.where(earlier, zc, dk2)], axis=1)
+        dva = dva + jnp.concatenate(
+            [dv1 + jnp.where(earlier, dv2, zc),
+             jnp.where(earlier, zc, dv2)], axis=1)
         kb, vb, dka, dva = (lax.ppermute(x, axis_name, perm)
                             for x in (kb, vb, dka, dva))
         return dq, dka, dva, kb, vb
