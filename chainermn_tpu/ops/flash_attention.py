@@ -23,6 +23,18 @@ Numerical contract: identical to
 :func:`chainermn_tpu.parallel.sequence.full_attention` (tested to fp
 tolerance, values and grads). Off TPU the kernels run in Pallas interpret
 mode, so the same code path is unit-testable on the CPU mesh.
+
+Single-call sequence ceiling (AOT-measured against the v5e compiler,
+round 5): fwd+bwd compiles to T = 8192 at 8 heads; at T >= 16384 XLA
+stack-allocates the kernels' (large, lane-broadcast) outputs in scoped
+VMEM and compilation dies with RESOURCE_EXHAUSTED — a buffer-assignment
+behavior on the OUTPUTS, observed with dead-lse compiles succeeding at
+the same T. Kernel-internal pressure differs per kernel: the dkv kernel
+is O(block) per cell after the round-5 grid restructure, while the fwd
+and dq kernels still hold full-length (1, tk, d) K/V blocks per cell
+(O(T), ~2 MB each at T=8192/d=64). Longer contexts are the ring's job:
+:mod:`chainermn_tpu.parallel.sequence` shards T so each per-shard kernel
+call stays at or under the ceiling.
 """
 
 from __future__ import annotations
@@ -186,6 +198,12 @@ def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
             jax.ShapeDtypeStruct((bh, tq, _LANE), jnp.float32,
                                  vma=_out_vma(qo, ko, q, k, v)),
         ],
+        # declared grid semantics keep the (large) outputs HBM-resident:
+        # without them XLA stack-allocates consumed kernel outputs in VMEM
+        # and long-T compiles die with RESOURCE_EXHAUSTED (AOT-verified:
+        # T=16384 fails undeclared, compiles declared)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(qo, ko, q, k, v)
     return out, lse[..., 0]
@@ -243,34 +261,45 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, *, scale: float, causal: bool,
-                    block_q: int):
+                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale: float, causal: bool, n_q: int):
+    """Grid ``(bh, k-block, q-chunk)``, q-chunk INNERMOST: the dk/dv output
+    block for (b, k-block) stays VMEM-resident across the whole q sweep,
+    accumulating in the f32 scratch, and flushes once at the last chunk.
+
+    The previous form held the FULL [tq, d] q/do and [tq, 128] lse/delta
+    blocks per grid cell and streamed q inside a fori_loop — its VMEM
+    footprint grew linearly with tq and OOM'd the v5e backward at
+    T = 16384 (AOT-verified); chunked via the grid, per-cell VMEM is
+    O(block_q + block_k) regardless of tq."""
     bk, d = k_ref.shape[1], k_ref.shape[2]
-    tq = q_ref.shape[1]
-    nq = tq // block_q
-    q_off = qo_ref[0, 0]
+    bq = q_ref.shape[1]
+    i = pl.program_id(2)
+    q_off = qo_ref[0, 0] + i * bq
     k_off = ko_ref[0, 0] + pl.program_id(1) * bk
 
-    kb = k_ref[0].astype(jnp.float32)
-    vb = v_ref[0].astype(jnp.float32)
-    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+    def compute():
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        qb = q_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            q_pos = (q_off + i * block_q
-                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
+            q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
         p = jnp.where(s <= _NEG_BIG / 2, 0.0, jnp.exp(s - lse[:, None]))
-        dv = dv + jax.lax.dot_general(
+        dv_acc[...] += jax.lax.dot_general(
             p, dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -279,23 +308,21 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(
+        dk_acc[...] += jax.lax.dot_general(
             ds, qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
 
     if causal:
-        # q blocks strictly before this k block see nothing of it
-        lo = jnp.clip(
-            jnp.floor_divide(k_off - q_off, jnp.int32(block_q)), 0, nq
-        )
+        # q chunks wholly before this k block see nothing of it
+        pl.when(q_off + bq - 1 >= k_off)(compute)
     else:
-        lo = 0
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, nq, body, (z, z))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        compute()
+
+    @pl.when(i == n_q - 1)
+    def _flush():
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
@@ -325,6 +352,8 @@ def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), grad_dtype or q.dtype,
                                        vma=_out_vma(qo2, ko2, q, k, v, do)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)
 
@@ -338,22 +367,25 @@ def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
     lse = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANE))
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANE))
     smem = _smem_spec()
+    n_q = tq // block_q
     return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
-        grid=(bh, tk // block_k),
+                          n_q=n_q),
+        # q-chunk is the INNERMOST grid dim: the (b, j) output block stays
+        # resident while the scratch accumulates over every q chunk
+        grid=(bh, tk // block_k, n_q),
         in_specs=[
             smem, smem,
-            pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, tq, _LANE), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, tq, _LANE), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or k.dtype,
@@ -361,6 +393,13 @@ def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
             jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or v.dtype,
                                  vma=_out_vma(qo2, ko2, q, k, v, do)),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        # the q-chunk dim accumulates into the scratch -> sequential
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)
 
