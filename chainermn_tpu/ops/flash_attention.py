@@ -4,15 +4,19 @@ The reference has no attention ops at all (SURVEY.md S2.16: it predates
 them); this kernel is the TPU-native hot-op for the long-context extension
 (:mod:`chainermn_tpu.parallel.sequence`). Design per the Pallas TPU guide:
 
-- one grid cell per ``(batch*heads, q_block)``; K/V rows stream through the
-  MXU in ``block_k`` tiles inside a ``fori_loop`` with the online-softmax
-  (m, l, acc) recurrence carried as loop values — attention scores are never
+- every kernel grids over ``(batch*heads, outer-seq-block,
+  reduction-chunk)`` with the reduction chunk innermost; the running state
+  (online-softmax (m, l, acc) forward; dq / (dk, dv) accumulators
+  backward) lives in f32 VMEM scratch across the sweep and flushes to the
+  output block once at the last chunk — attention scores are never
   materialized in HBM, so memory is O(T) instead of O(T^2);
 - causal masking is computed from *global* positions: ``q_offset`` /
   ``k_offset`` arrive as SMEM scalars so sequence-sharded callers (ring
   attention shards, ``pos_offset`` in the LM) can pass traced offsets;
-- the causal path clamps the K-loop trip count to the last visible block —
-  the standard ~2x FLOP saving — with a dynamic (traced) bound;
+- fully-masked (future) chunks skip their COMPUTE via ``pl.when`` — the
+  standard ~2x causal FLOP saving — but their K/V block DMAs still
+  stream; skipping the traffic too is the ring layer's job (its
+  block-level masking decides which whole blocks to visit);
 - backward is the standard two-kernel flash backward: ``dq`` gridded over
   q-blocks and ``(dk, dv)`` gridded over k-blocks, both recomputing scores
   from the saved row logsumexp (``lse``) instead of storing P;
@@ -24,17 +28,16 @@ Numerical contract: identical to
 tolerance, values and grads). Off TPU the kernels run in Pallas interpret
 mode, so the same code path is unit-testable on the CPU mesh.
 
-Single-call sequence ceiling (AOT-measured against the v5e compiler,
-round 5): fwd+bwd compiles to T = 8192 at 8 heads; at T >= 16384 XLA
-stack-allocates the kernels' (large, lane-broadcast) outputs in scoped
-VMEM and compilation dies with RESOURCE_EXHAUSTED — a buffer-assignment
-behavior on the OUTPUTS, observed with dead-lse compiles succeeding at
-the same T. Kernel-internal pressure differs per kernel: the dkv kernel
-is O(block) per cell after the round-5 grid restructure, while the fwd
-and dq kernels still hold full-length (1, tk, d) K/V blocks per cell
-(O(T), ~2 MB each at T=8192/d=64). Longer contexts are the ring's job:
-:mod:`chainermn_tpu.parallel.sequence` shards T so each per-shard kernel
-call stays at or under the ceiling.
+All three kernels grid over BOTH sequence dims with the reduction dim
+innermost and f32 VMEM scratch carrying the running state (online-softmax
+m/l/acc forward; dq / dk+dv accumulators backward) — per-cell VMEM is
+O(block_q + block_k) regardless of T. This structure is load-bearing:
+the earlier form held full-length [T, d] K/V (or q/do) blocks per grid
+cell, and XLA's scoped-VMEM accounting killed fwd+bwd compilation at
+T >= 16384 on v5e; chunked, the same program AOT-compiles to T = 131072
+(AOT-verified round 5, 8 heads, d=64 — HBM, not VMEM, is then the
+binding limit, and beyond it the ring in
+:mod:`chainermn_tpu.parallel.sequence` shards T across devices).
 """
 
 from __future__ import annotations
@@ -58,15 +61,6 @@ def _smem_spec():
     """Spec for the (1, 1) int32 offset scalars (SMEM on TPU; the guide's
     'scalars must be 2D in SMEM' rule)."""
     return pl.BlockSpec(memory_space=pltpu.SMEM)
-
-
-def _causal_hi(last_q, k_off, block_k: int, nk: int):
-    """Number of k-blocks any row of this q-block can see (traced ok).
-    floor_divide, not lax.div: toward-zero rounding overcounts by one when
-    last_q < k_off."""
-    return jnp.clip(
-        jnp.floor_divide(last_q - k_off, jnp.int32(block_k)) + 1, 0, nk
-    )
 
 
 def _pick_block(t: int, preferred: int = 128) -> int:
@@ -108,27 +102,39 @@ def _fold_args(b, h, d, *xs):
 # --------------------------------------------------------------------------- #
 
 def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                *, scale: float, causal: bool, block_k: int):
+                m_acc, l_acc, o_acc, *, scale: float, causal: bool,
+                n_k: int):
+    """Grid ``(bh, q-block, k-chunk)``, k-chunk INNERMOST: the online-
+    softmax state (m, l, acc) lives in f32 VMEM scratch across the k sweep
+    and the o/lse output blocks flush once at the last chunk — per-cell
+    VMEM is O(block_q + block_k) regardless of T (the previous form held
+    the full [tk, d] K/V blocks per cell). Fully-masked chunks skip their
+    compute via pl.when (the former dynamic trip-count clamp)."""
     bq, d = q_ref.shape[1], q_ref.shape[2]
-    tk = k_ref.shape[1]
-    nk = tk // block_k
+    bk = k_ref.shape[1]
+    j = pl.program_id(2)
     q_off = qo_ref[0, 0] + pl.program_id(1) * bq
-    k_off = ko_ref[0, 0]
+    k_off = ko_ref[0, 0] + j * bk
 
-    q = q_ref[0].astype(jnp.float32)
-    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    @pl.when(j == 0)
+    def _init():
+        m_acc[...] = jnp.full_like(m_acc, _NEG_BIG)
+        l_acc[...] = jnp.zeros_like(l_acc)
+        o_acc[...] = jnp.zeros_like(o_acc)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0]
+        vb = v_ref[0]
+        m = m_acc[:, 0]
+        l = l_acc[:, 0]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            k_pos = (k_off + j * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         corr = jnp.exp(m - m_new)
@@ -136,58 +142,62 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         # a VISITED block, s == m_new == the sentinel and exp(s - m_new)
         # would be 1, polluting l/acc with mean-of-V garbage
         p = jnp.where(s <= _NEG_BIG / 2, 0.0, jnp.exp(s - m_new[:, None]))
-        l = l * corr + jnp.sum(p, axis=-1)
         pv = jax.lax.dot_general(
             p, vb.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        acc = acc * corr[:, None] + pv
-        return m_new, l, acc
+        m_acc[...] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
+        l_acc[...] = jnp.broadcast_to(
+            (l * corr + jnp.sum(p, axis=-1))[:, None], l_acc.shape)
+        o_acc[...] = o_acc[...] * corr[:, None] + pv
 
     if causal:
-        # blocks whose first position is beyond the last q position never
-        # contribute: clamp the trip count (dynamic — offsets are traced)
-        hi = _causal_hi(q_off + bq - 1, k_off, block_k, nk)
+        # chunks whose first position is beyond the last q position never
+        # contribute — skip the math (the DMA still streams; same traffic
+        # as the old full-block fetch)
+        pl.when(q_off + bq - 1 >= k_off)(compute)
     else:
-        hi = nk
-    m0 = jnp.full((bq,), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    a0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, a0))
+        compute()
 
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # rows with no visible keys get lse = -inf-ish; backward masks them out.
-    # lse is written lane-broadcast [block_q, _LANE]: a [1, block_q] block
-    # violates Mosaic's sublane rule (dim -2 divisible by 8 or equal to the
-    # array dim), so the row statistic rides a 128-lane tile like the
-    # reference TPU flash kernel's l/m
-    lse = jnp.where(l == 0.0, _NEG_BIG, m + jnp.log(l_safe))
-    lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+    @pl.when(j == n_k - 1)
+    def _flush():
+        m = m_acc[:, 0]
+        l = l_acc[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (o_acc[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # rows with no visible keys get lse = -inf-ish; backward masks them
+        # out. lse rides a lane-broadcast [block_q, _LANE] tile (a
+        # [1, block_q] block violates Mosaic's sublane rule), like the
+        # reference TPU flash kernel's l/m.
+        lse = jnp.where(l == 0.0, _NEG_BIG, m + jnp.log(l_safe))
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
          interpret, out_dtype=None):
     bh, tq, d = q.shape
     tk = k.shape[1]
-    grid = (bh, tq // block_q)
+    n_k = tk // block_k
+    # k-chunk INNERMOST (sequential: the online-softmax scratch accumulates
+    # over it); o/lse blocks are indexed by (b, i) only and flush once
+    grid = (bh, tq // block_q, n_k)
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
     smem = _smem_spec()
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          n_k=n_k),
         grid=grid,
         in_specs=[
             smem,
             smem,
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             # out_dtype=f32 lets ring callers merge partial block outputs
@@ -198,12 +208,13 @@ def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
             jax.ShapeDtypeStruct((bh, tq, _LANE), jnp.float32,
                                  vma=_out_vma(qo, ko, q, k, v)),
         ],
-        # declared grid semantics keep the (large) outputs HBM-resident:
-        # without them XLA stack-allocates consumed kernel outputs in VMEM
-        # and long-T compiles die with RESOURCE_EXHAUSTED (AOT-verified:
-        # T=16384 fails undeclared, compiles declared)
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),       # unnormalized acc
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qo, ko, q, k, v)
     return out, lse[..., 0]
@@ -214,30 +225,36 @@ def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
 # --------------------------------------------------------------------------- #
 
 def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, *, scale: float, causal: bool,
-                   block_k: int):
+                   delta_ref, dq_ref, dq_acc, *, scale: float, causal: bool,
+                   n_k: int):
+    """Grid ``(bh, q-block, k-chunk)``, k-chunk INNERMOST: dq accumulates
+    in f32 VMEM scratch across the k sweep and flushes once — per-cell
+    VMEM is O(block) regardless of T (see _fwd_kernel / _bwd_dkv_kernel;
+    all three kernels share the structure)."""
     bq, d = q_ref.shape[1], q_ref.shape[2]
-    tk = k_ref.shape[1]
-    nk = tk // block_k
+    bk = k_ref.shape[1]
+    j = pl.program_id(2)
     q_off = qo_ref[0, 0] + pl.program_id(1) * bq
-    k_off = ko_ref[0, 0]
+    k_off = ko_ref[0, 0] + j * bk
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0]     # lane-broadcast [block_q, _LANE]; see _fwd
-    delta = delta_ref[0, :, 0]
-    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]     # lane-broadcast [block_q, _LANE]
+        delta = delta_ref[0, :, 0]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            k_pos = (k_off + j * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
         # masked entries must not resurrect when lse is the -inf sentinel
         # (fully-masked row): exp(-1e30 - (-1e30)) == 1 otherwise
@@ -247,17 +264,20 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(
+        dq_acc[...] += jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
     if causal:
-        hi = _causal_hi(q_off + bq - 1, k_off, block_k, nk)
+        # chunks wholly after the last q position contribute nothing
+        pl.when(q_off + bq - 1 >= k_off)(compute)
     else:
-        hi = nk
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+        compute()
+
+    @pl.when(j == n_k - 1)
+    def _flush():
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -336,24 +356,26 @@ def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
     lse = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANE))
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANE))
     smem = _smem_spec()
+    n_k = tk // block_k
     return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
-        grid=(bh, tq // block_q),
+                          n_k=n_k),
+        grid=(bh, tq // block_q, n_k),
         in_specs=[
             smem, smem,
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), grad_dtype or q.dtype,
                                        vma=_out_vma(qo2, ko2, q, k, v, do)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)
 
@@ -530,8 +552,10 @@ def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
     callers pass f32 to merge without a bf16 round-trip), ``lse [B, H, Tq]``
     (f32; fully-masked rows hold the -1e30 sentinel, which the lse-weighted
     merge turns into a zero contribution). Causal masking uses global
-    positions via the (possibly traced) offsets, and the kernel's k-loop
-    clamp skips fully-masked blocks — a future block costs ~nothing."""
+    positions via the (possibly traced) offsets; fully-masked chunks skip
+    their compute (pl.when) but still pay their K/V DMA — ring callers
+    that KNOW a whole block is invisible should skip the call, not lean
+    on the kernel."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if scale is None:
