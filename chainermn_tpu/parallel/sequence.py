@@ -617,31 +617,44 @@ def zigzag_ring_attention(
     kb = lax.ppermute(k, axis_name, perm)
     vb = lax.ppermute(v, axis_name, perm)
 
+    # Branch-free ring steps, like _zigzag_flash_fwd_pass: a lax.cond body
+    # serializes the permutes against the block compute on TPU schedules
+    # (XLA will not hoist collective starts across control flow — PERF.md
+    # "Ring overlap"). Both former branches are the SAME two unmasked
+    # (c x c) block updates with selected operands; the online-softmax
+    # update is exact in any order, so chaining two updates equals the old
+    # single wider update.
     def body(step, carry):
         m, l, o, kb, vb = carry
-
         # src = (my - step) % n; for step in [1, n) src < my <=> my >= step
-        def from_earlier(mlo):
-            # every local query sees the whole early chunk, nothing of the
-            # late chunk — unmasked [t, c] update
-            return _block_attend(
-                q32, kb[:, :c], vb[:, :c], scale=scale, mask=None,
-                m=mlo[0], l=mlo[1], o=mlo[2]
-            )
+        earlier = my >= step
+        ke, ve, kl, vl = kb[:, :c], vb[:, :c], kb[:, c:], vb[:, c:]
+        m_e, m_l = m[:, :, :c], m[:, :, c:]
+        l_e, l_l = l[:, :, :c], l[:, :, c:]
+        o_e, o_l = o[:, :c], o[:, c:]
+        q_e, q_l = q32[:, :c], q32[:, c:]
 
-        def from_later(mlo):
-            # only the local late chunk attends, and it sees the whole
-            # incoming block — unmasked [c, t] update into rows [c:]
-            m, l, o = mlo
-            m2, l2, o2 = _block_attend(
-                q32[:, c:], kb, vb, scale=scale, mask=None,
-                m=m[:, :, c:], l=l[:, :, c:], o=o[:, c:]
-            )
-            return (jnp.concatenate([m[:, :, :c], m2], axis=2),
-                    jnp.concatenate([l[:, :, :c], l2], axis=2),
-                    jnp.concatenate([o[:, :c], o2], axis=1))
-
-        m, l, o = lax.cond(my >= step, from_earlier, from_later, (m, l, o))
+        # call 1: (q_e x k_e) on the early state (earlier-rank block) or
+        # (q_l x k_e) on the late state (later-rank block)
+        m1, l1, o1 = _block_attend(
+            jnp.where(earlier, q_e, q_l), ke, ve, scale=scale, mask=None,
+            m=jnp.where(earlier, m_e, m_l),
+            l=jnp.where(earlier, l_e, l_l),
+            o=jnp.where(earlier, o_e, o_l),
+        )
+        # call 2 always updates the late state: from the ORIGINAL late
+        # state when call 1 touched the early half, or chained on call 1's
+        # output when both calls are late-row updates
+        m2, l2, o2 = _block_attend(
+            q_l, jnp.where(earlier, ke, kl), jnp.where(earlier, ve, vl),
+            scale=scale, mask=None,
+            m=jnp.where(earlier, m_l, m1),
+            l=jnp.where(earlier, l_l, l1),
+            o=jnp.where(earlier, o_l, o1),
+        )
+        m = jnp.concatenate([jnp.where(earlier, m1, m_e), m2], axis=2)
+        l = jnp.concatenate([jnp.where(earlier, l1, l_e), l2], axis=2)
+        o = jnp.concatenate([jnp.where(earlier, o1, o_e), o2], axis=1)
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
         return m, l, o, kb, vb

@@ -130,6 +130,7 @@ def main():
         ring_attention,
         ring_flash_attention,
         zigzag_flash_attention,
+        zigzag_ring_attention,
     )
 
     # Force COMPILED pallas lowering during AOT tracing: default_backend()
@@ -151,6 +152,9 @@ def main():
 
     def zigzag_flash(q, k, v):
         return zigzag_flash_attention(q, k, v, "sp")
+
+    def zigzag_xla(q, k, v):
+        return zigzag_ring_attention(q, k, v, "sp", causal=True)
 
     def fwd(inner):
         def f(q, k, v):
@@ -175,6 +179,7 @@ def main():
         ("ring_flash_fwd", jax.jit(fwd(ring_flash))),
         ("ring_flash_fwdbwd", jax.jit(fwdbwd(ring_flash))),
         ("zigzag_flash_fwdbwd", jax.jit(fwdbwd(zigzag_flash))),
+        ("zigzag_xla_fwdbwd", jax.jit(fwdbwd(zigzag_xla))),
     ]
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "ring_overlap_aot.jsonl")
