@@ -13,7 +13,11 @@ COMPILED on the real TPU and records:
      1-device mesh (ppermute is identity at world 1, but the kernels and
      the ring-level custom VJP lower and execute compiled);
   5. flash-vs-full wall-clock at T in {2048, 4096, 8192} fwd+bwd — the
-     measured counterpart of the AOT 4.3x prediction (PERF.md round 4).
+     measured counterpart of the AOT 4.3x prediction (PERF.md round 4);
+  6. flash-only long-context cells at T in {16384, 32768} — sizes where
+     full attention cannot materialize scores and which only compile at
+     all after the round-5 kernel grid restructure (context ceiling
+     8k -> 128k, PERF.md).
 
 Appends one JSON record per result to scripts/onchip_flash.jsonl the moment
 it lands (wedge protocol: partial evidence must survive a teardown).
@@ -174,6 +178,24 @@ def main():
                       "error": f"{type(e).__name__}: {e}"[:400],
                       "wall_s": round(time.time() - t0, 1)})
 
+    def time_grad_step(fn, q, k, v, n):
+        """ms/step for jit(grad(sum fn^2)) — warm, enqueue n, close with a
+        device->host FETCH (tunnel-safe; see bench.py's note on
+        block_until_ready through the relay). One home for the timing
+        idiom so every cell measures identically."""
+
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        g = step(q, k, v)  # compile + warm
+        float(jnp.sum(g[0].astype(jnp.float32)))
+        t0 = time.time()
+        for _ in range(n):
+            g = step(q, k, v)
+        float(jnp.sum(g[0].astype(jnp.float32)))
+        return round((time.time() - t0) / n * 1e3, 3)
+
     # ---- 5: flash vs full wall-clock (fwd+bwd), bf16 ------------------- #
     for t_len in (2048, 4096, 8192):
         if time.time() > deadline:
@@ -188,24 +210,36 @@ def main():
             ("full", functools.partial(full_attention, causal=True)),
         ):
             try:
-                def loss(q, k, v):
-                    return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
-
-                step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-                g = step(q, k, v)  # compile + warm
-                float(jnp.sum(g[0].astype(jnp.float32)))
-                n = 20
-                t0 = time.time()
-                for _ in range(n):
-                    g = step(q, k, v)
-                # device->host fetch closes the timing (tunnel-safe; see
-                # bench.py's note on block_until_ready through the relay)
-                float(jnp.sum(g[0].astype(jnp.float32)))
-                rec[f"{name}_ms"] = round((time.time() - t0) / n * 1e3, 3)
+                rec[f"{name}_ms"] = time_grad_step(fn, q, k, v, n=20)
             except Exception as e:
                 rec[f"{name}_error"] = f"{type(e).__name__}: {e}"[:300]
         if "flash_ms" in rec and "full_ms" in rec:
             rec["full_over_flash"] = round(rec["full_ms"] / rec["flash_ms"], 3)
+        emit(rec)
+
+    # ---- 6: flash-only long-context (post-restructure capability) ------ #
+    for t_len in (16384, 32768):
+        if time.time() > deadline:
+            emit({"test": "timing_long", "seq_len": t_len,
+                  "skipped": "budget"})
+            continue
+        b, h, d = 1, 8, 64
+        q, k, v = mk(b, t_len, h, d, jnp.bfloat16)
+        rec = {"test": "timing_long", "seq_len": t_len,
+               "shape": [b, t_len, h, d]}
+        try:
+            rec["flash_ms"] = time_grad_step(
+                functools.partial(flash_attention, causal=True,
+                                  interpret=False), q, k, v, n=10)
+            # causal fwd+bwd FLOPs per (b,h): fwd = 2 matmuls x (T^2/2
+            # visible pairs) x d x 2 FLOP/MAC = 2*T^2*d; bwd ~ 2.5x fwd
+            # (5 matmuls) -> total ~ 7*T^2*d. Same FLOP (not MAC)
+            # convention as bench.py / PERF.md vs the 197 TFLOP/s peak.
+            flops = 7.0 * b * h * t_len * t_len * d
+            rec["achieved_tflops"] = round(
+                flops / (rec["flash_ms"] / 1e3) / 1e12, 2)
+        except Exception as e:
+            rec["error"] = f"{type(e).__name__}: {e}"[:300]
         emit(rec)
 
     emit({"test": "done"})
