@@ -127,3 +127,14 @@ def test_directory_loader_rejects_empty(tmp_path):
     (tmp_path / "empty_class").mkdir()
     with pytest.raises(ValueError, match="JPEG files"):
         jpeg.scan_image_directory(str(tmp_path))
+
+
+def test_producer_failure_reaches_consumer(jpeg_tree):
+    """A producer-thread failure (file deleted after scan) must surface as
+    an exception on the consuming side, not hang the training loop."""
+    it = jpeg.JpegDirectoryLoader(jpeg_tree, 4, image_size=16, repeat=False)
+    for p in it._paths:
+        os.remove(p)
+    with pytest.raises(RuntimeError, match="producer failed"):
+        for _ in it:
+            pass
