@@ -674,6 +674,7 @@ def ulysses_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     block_impl: str = "xla",
+    head_chunks: int = 1,
 ):
     """Exact attention via all-to-all head re-sharding (DeepSpeed-Ulysses
     collective shape, done with one XLA ``all_to_all`` each way).
@@ -690,6 +691,18 @@ def ulysses_attention(
     differentiation works through the kernel's custom VJP + the
     ``all_to_all`` transpose. Off TPU the kernel runs interpreted (use
     ``check_vma=False`` on the enclosing shard_map, like 'flash').
+
+    ``head_chunks > 1`` splits the local heads into that many groups and
+    runs the exchange+attend+exchange pipeline per group, UNROLLED: group
+    g+1's all_to_alls have no data dependency on group g's attention —
+    the plain form's all_to_alls are provably un-hideable (exchange ->
+    attend -> exchange are sequentially dependent). Exact for any
+    chunking (heads are independent); per-group working memory drops by
+    the same factor. NOTE the overlap is structural readiness, not a
+    measured win on this toolchain: the current XLA TPU build lowers
+    all_to_all synchronously (no -start/-done pair to schedule around;
+    AOT-verified, PERF.md "Ring overlap"), so today the chunking buys
+    memory granularity and future async toolchains the opportunity.
     """
     if not isinstance(axis_name, str):
         raise ValueError(
@@ -705,22 +718,33 @@ def ulysses_attention(
     h = q.shape[2]
     if h % n != 0:
         raise ValueError(f"heads ({h}) must be divisible by axis size ({n})")
+    if head_chunks < 1 or h % head_chunks or (h // head_chunks) % n:
+        raise ValueError(
+            f"head_chunks={head_chunks} must partition the {h} heads into "
+            f"groups divisible by the axis size ({n})"
+        )
 
-    def to_heads(x):  # [B, T, H, D] -> [B, n*T, H/n, D]
+    def to_heads(x):  # [B, T, Hg, D] -> [B, n*T, Hg/n, D]
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
-    def to_seq(x):  # [B, n*T, H/n, D] -> [B, T, H, D]
+    def to_seq(x):  # [B, n*T, Hg/n, D] -> [B, T, Hg, D]
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    if block_impl == "flash":
-        from chainermn_tpu.ops import flash_attention
+    def attend(qg, kg, vg):
+        if block_impl == "flash":
+            from chainermn_tpu.ops import flash_attention
 
-        out = flash_attention(to_heads(q), to_heads(k), to_heads(v),
-                              causal=causal, scale=scale)
-    else:
-        out = full_attention(to_heads(q), to_heads(k), to_heads(v),
-                             causal=causal, scale=scale)
-    return to_seq(out)
+            return flash_attention(qg, kg, vg, causal=causal, scale=scale)
+        return full_attention(qg, kg, vg, causal=causal, scale=scale)
+
+    hg = h // head_chunks
+    outs = []
+    for g in range(head_chunks):  # unrolled: groups are independent
+        sl = slice(g * hg, (g + 1) * hg)
+        outs.append(to_seq(attend(
+            to_heads(q[:, :, sl]), to_heads(k[:, :, sl]),
+            to_heads(v[:, :, sl]))))
+    return outs[0] if head_chunks == 1 else jnp.concatenate(outs, axis=2)
 
 
 def ulysses_flash_attention(q, k, v, axis_name: str, *, causal: bool = False,

@@ -162,6 +162,9 @@ def main():
     def ulysses(q, k, v):
         return ulysses_attention(q, k, v, "sp", causal=True)
 
+    def ulysses_hc2(q, k, v):
+        return ulysses_attention(q, k, v, "sp", causal=True, head_chunks=2)
+
     def fwd(inner):
         def f(q, k, v):
             return shard_map(inner, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
@@ -187,6 +190,7 @@ def main():
         ("zigzag_flash_fwdbwd", jax.jit(fwdbwd(zigzag_flash))),
         ("zigzag_xla_fwdbwd", jax.jit(fwdbwd(zigzag_xla))),
         ("ulysses_fwdbwd", jax.jit(fwdbwd(ulysses))),
+        ("ulysses_hc2_fwdbwd", jax.jit(fwdbwd(ulysses_hc2))),
     ]
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "ring_overlap_aot.jsonl")
