@@ -2,8 +2,8 @@
 # Chip watcher (round 5): probe the TPU on a timer; the FIRST time it responds,
 # run the full measurement battery in that window, in priority order:
 #   1. bench.py            -> scripts/bench_stdout.txt (headline MFU record)
-#   2. mfu_sweep.py        -> scripts/mfu_sweep.jsonl (batch/strategy sweep)
-#   3. onchip_flash.py     -> scripts/onchip_flash.jsonl (Pallas compiled parity)
+#   2. onchip_flash.py     -> scripts/onchip_flash.jsonl (Pallas compiled parity)
+#   3. mfu_sweep.py        -> scripts/mfu_sweep.jsonl (batch/strategy sweep)
 # Wedge protocol (PERF.md): TERM-capped probes, never KILL first; keep probing
 # all round. Timeout budgets are consistent top-down: each wrapper timeout
 # exceeds its child's internal budget so the child always winds down first
@@ -24,14 +24,18 @@ while true; do
     # wrapper adds headroom so the internal deadline always fires first.
     ( timeout -s TERM 1700 python bench.py > scripts/bench_stdout.txt 2> scripts/bench_stderr.txt; \
       echo "$(date +%FT%T) bench rc=$?" >> "$LOG" )
-    # sweep: 5 cells x 1500s/cell max; results append per-cell so a timeout
-    # loses only remaining cells. Wrapper = 5*(1500 + ~180 teardown: bench's
-    # TERM wait + KILL wait + interpreter startup) + slack, so even five
-    # wedged cells exit on their own before this TERM lands.
-    ( MFU_SWEEP_CELL_TIMEOUT=1500 timeout -s TERM 8700 python scripts/mfu_sweep.py >> "$LOG" 2>&1; \
-      echo "$(date +%FT%T) sweep rc=$?" >> "$LOG" )
+    # onchip flash battery BEFORE the sweep: it is the round-5 evidence
+    # the verdict asked for and fits a short window
     ( ONCHIP_FLASH_BUDGET=780 timeout -s TERM 900 python scripts/onchip_flash.py >> "$LOG" 2>&1; \
       echo "$(date +%FT%T) onchip_flash rc=$?" >> "$LOG" )
+    # sweep: capped to the 3 highest-value cells (512/256/space_to_depth)
+    # so a late-opening chip window cannot leave a sweep running into the
+    # driver's own round-end bench on the single-tenant tunnel. 1500s/cell
+    # (a contended conv7 compile has exceeded 1200s — PERF.md); wrapper =
+    # 3*(1500 + ~180 teardown) + slack.
+    ( MFU_SWEEP_CELL_TIMEOUT=1500 MFU_SWEEP_MAX_CELLS=3 \
+      timeout -s TERM 5400 python scripts/mfu_sweep.py >> "$LOG" 2>&1; \
+      echo "$(date +%FT%T) sweep rc=$?" >> "$LOG" )
     echo "$(date +%FT%T) battery done" >> "$LOG"
     exit 0
   fi
