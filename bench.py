@@ -137,7 +137,9 @@ def _measure(model, comm, batch, *, double_buffering, n_steps, warmup=3,
         rng, (batch, image_size, image_size, 3), jnp.bfloat16
     )
     labels = jnp.zeros((batch,), jnp.int32)
+    t_init = time.time()
     variables = comm.bcast_data(model.init(rng, images[:2], train=True))
+    log(f"model.init done in {time.time() - t_init:.1f}s (batch={batch})")
     opt = chainermn_tpu.create_multi_node_optimizer(
         optax.sgd(0.1, momentum=0.9), comm, double_buffering=double_buffering
     )

@@ -1,44 +1,65 @@
 #!/bin/bash
-# Chip watcher (round 5): probe the TPU on a timer; the FIRST time it responds,
-# run the full measurement battery in that window, in priority order:
+# Chip watcher (round 5, rev 2): probe the TPU on a timer; the FIRST time it
+# responds, run the measurement battery in that window, in priority order:
 #   1. bench.py            -> scripts/bench_stdout.txt (headline MFU record)
 #   2. onchip_flash.py     -> scripts/onchip_flash.jsonl (Pallas compiled parity)
-#   3. mfu_sweep.py        -> scripts/mfu_sweep.jsonl (batch/strategy sweep)
-# Wedge protocol (PERF.md): TERM-capped probes, never KILL first; keep probing
-# all round. Timeout budgets are consistent top-down: each wrapper timeout
-# exceeds its child's internal budget so the child always winds down first
-# and releases the single-tenant device lease (mfu_sweep.py forwards TERM to
-# its running bench cell for the same reason). Writes status lines to
-# scripts/chip_watch.log.
+#   3. onchip_lm.py        -> scripts/onchip_lm.jsonl (LM train MFU, flash vs full)
+#   4. mfu_sweep.py        -> scripts/mfu_sweep.jsonl (batch/strategy sweep)
+#
+# Rev-2 budget lesson (2026-07-31, the first live chip window in 3 rounds):
+# a cold conv7 ResNet-50 compile through the axon tunnel takes >11 min —
+# longer than the 720s/attempt the rev-1 battery allowed. Both attempts were
+# TERMed mid-compile, ignored TERM (main thread blocked in the remote-compile
+# C call, so the SystemExit handler never ran), got SIGKILLed, and the orphaned
+# lease wedged the tunnel for the NEXT stage — the exact hazard-#2 spiral the
+# budgets were meant to avoid. Rev 2 therefore gives bench ONE attempt with a
+# 2400s window (compile ~12 min + 50 measured steps fits several times over),
+# relies on the persistent compilation cache (bench.py) to make any LATER run
+# nearly compile-free, and probes the chip between stages so a stage never
+# inherits a wedged tunnel from its predecessor.
 set -u
 cd /root/repo
 LOG=scripts/chip_watch.log
-echo "$(date +%FT%T) chip_watch start" >> "$LOG"
-while true; do
+echo "$(date +%FT%T) chip_watch(rev2) start" >> "$LOG"
+
+probe() {
   timeout -s TERM 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >/dev/null 2>&1
-  rc=$?
-  if [ $rc -eq 0 ]; then
-    echo "$(date +%FT%T) CHIP ALIVE — running battery" >> "$LOG"
-    touch scripts/.chip_alive
-    # bench.py: internal total budget 1500s (its own parent enforces it);
-    # wrapper adds headroom so the internal deadline always fires first.
-    ( timeout -s TERM 1700 python bench.py > scripts/bench_stdout.txt 2> scripts/bench_stderr.txt; \
-      echo "$(date +%FT%T) bench rc=$?" >> "$LOG" )
-    # onchip flash battery BEFORE the sweep: it is the round-5 evidence
-    # the verdict asked for and fits a short window
-    ( ONCHIP_FLASH_BUDGET=780 timeout -s TERM 900 python scripts/onchip_flash.py >> "$LOG" 2>&1; \
-      echo "$(date +%FT%T) onchip_flash rc=$?" >> "$LOG" )
-    # sweep: capped to the 3 highest-value cells (512/256/space_to_depth)
-    # so a late-opening chip window cannot leave a sweep running into the
-    # driver's own round-end bench on the single-tenant tunnel. 1500s/cell
-    # (a contended conv7 compile has exceeded 1200s — PERF.md); wrapper =
-    # 3*(1500 + ~180 teardown) + slack.
-    ( MFU_SWEEP_CELL_TIMEOUT=1500 MFU_SWEEP_MAX_CELLS=3 \
-      timeout -s TERM 5400 python scripts/mfu_sweep.py >> "$LOG" 2>&1; \
-      echo "$(date +%FT%T) sweep rc=$?" >> "$LOG" )
-    echo "$(date +%FT%T) battery done" >> "$LOG"
-    exit 0
-  fi
-  echo "$(date +%FT%T) probe rc=$rc (wedged)" >> "$LOG"
-  sleep 420
-done
+}
+
+wait_alive() {
+  # Probe until the chip responds; single-tenant leases clear in minutes.
+  while true; do
+    if probe; then return 0; fi
+    echo "$(date +%FT%T) probe wedged" >> "$LOG"
+    sleep 240
+  done
+}
+
+wait_alive
+echo "$(date +%FT%T) CHIP ALIVE — bench (one 2400s attempt)" >> "$LOG"
+touch scripts/.chip_alive
+( CHAINERMN_TPU_BENCH_ATTEMPTS=1 \
+  CHAINERMN_TPU_BENCH_TIMEOUT=2400 \
+  CHAINERMN_TPU_BENCH_TOTAL_BUDGET=2500 \
+  timeout -k 120 -s TERM 2700 python bench.py > scripts/bench_stdout.txt 2> scripts/bench_stderr.txt; \
+  echo "$(date +%FT%T) bench rc=$?" >> "$LOG" )
+
+wait_alive
+echo "$(date +%FT%T) CHIP ALIVE — onchip_flash" >> "$LOG"
+( ONCHIP_FLASH_BUDGET=1100 timeout -k 120 -s TERM 1300 python scripts/onchip_flash.py >> "$LOG" 2>&1; \
+  echo "$(date +%FT%T) onchip_flash rc=$?" >> "$LOG" )
+
+wait_alive
+echo "$(date +%FT%T) CHIP ALIVE — onchip_lm" >> "$LOG"
+( ONCHIP_LM_BUDGET=1500 timeout -k 120 -s TERM 1700 python scripts/onchip_lm.py >> "$LOG" 2>&1; \
+  echo "$(date +%FT%T) onchip_lm rc=$?" >> "$LOG" )
+
+wait_alive
+echo "$(date +%FT%T) CHIP ALIVE — sweep" >> "$LOG"
+# 3 highest-value cells (conv7/512, conv7/256, space_to_depth/256); each cell
+# is one bench attempt whose compile either hits the cache (same graph as the
+# headline) or pays its own cold compile — 2400s covers both.
+( MFU_SWEEP_CELL_TIMEOUT=2500 MFU_SWEEP_MAX_CELLS=3 \
+  timeout -k 180 -s TERM 8100 python scripts/mfu_sweep.py >> "$LOG" 2>&1; \
+  echo "$(date +%FT%T) sweep rc=$?" >> "$LOG" )
+echo "$(date +%FT%T) battery done" >> "$LOG"

@@ -31,8 +31,9 @@ import signal
 import sys
 import time
 
-OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "onchip_flash.jsonl")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root (run from anywhere)
+OUT = os.path.join(_HERE, "onchip_flash.jsonl")
 
 
 def emit(rec):
