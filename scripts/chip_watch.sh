@@ -15,12 +15,16 @@
 # budgets were meant to avoid. Rev 2 therefore gives bench ONE attempt with a
 # 2400s window (compile ~12 min + 50 measured steps fits several times over),
 # relies on the persistent compilation cache (bench.py) to make any LATER run
-# nearly compile-free, and probes the chip between stages so a stage never
-# inherits a wedged tunnel from its predecessor.
+# nearly compile-free, probes the chip between stages so a stage never
+# inherits a wedged tunnel from its predecessor, and gives the WHOLE battery
+# a deadline (default 6h) so a long wedge cannot leave a stage running into
+# the driver's own round-end bench on the single-tenant tunnel.
 set -u
 cd /root/repo
 LOG=scripts/chip_watch.log
-echo "$(date +%FT%T) chip_watch(rev2) start" >> "$LOG"
+START=$(date +%s)
+BATTERY_DEADLINE=${CHIP_WATCH_DEADLINE:-21600}   # seconds from start
+echo "$(date +%FT%T) chip_watch(rev2) start (deadline ${BATTERY_DEADLINE}s)" >> "$LOG"
 
 probe() {
   timeout -s TERM 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >/dev/null 2>&1
@@ -28,38 +32,47 @@ probe() {
 
 wait_alive() {
   # Probe until the chip responds; single-tenant leases clear in minutes.
+  # Returns 1 (skip remaining stages) once the battery deadline passes.
   while true; do
+    if [ $(( $(date +%s) - START )) -gt "$BATTERY_DEADLINE" ]; then
+      echo "$(date +%FT%T) battery deadline passed; skipping remaining stages" >> "$LOG"
+      return 1
+    fi
     if probe; then return 0; fi
     echo "$(date +%FT%T) probe wedged" >> "$LOG"
     sleep 240
   done
 }
 
-wait_alive
-echo "$(date +%FT%T) CHIP ALIVE — bench (one 2400s attempt)" >> "$LOG"
-touch scripts/.chip_alive
-( CHAINERMN_TPU_BENCH_ATTEMPTS=1 \
-  CHAINERMN_TPU_BENCH_TIMEOUT=2400 \
-  CHAINERMN_TPU_BENCH_TOTAL_BUDGET=2500 \
-  timeout -k 120 -s TERM 2700 python bench.py > scripts/bench_stdout.txt 2> scripts/bench_stderr.txt; \
-  echo "$(date +%FT%T) bench rc=$?" >> "$LOG" )
+if wait_alive; then
+  echo "$(date +%FT%T) CHIP ALIVE — bench (one 2400s attempt)" >> "$LOG"
+  touch scripts/.chip_alive
+  ( CHAINERMN_TPU_BENCH_ATTEMPTS=1 \
+    CHAINERMN_TPU_BENCH_TIMEOUT=2400 \
+    CHAINERMN_TPU_BENCH_TOTAL_BUDGET=2500 \
+    timeout -k 120 -s TERM 2700 python bench.py > scripts/bench_stdout.txt 2> scripts/bench_stderr.txt; \
+    echo "$(date +%FT%T) bench rc=$?" >> "$LOG" )
+fi
 
-wait_alive
-echo "$(date +%FT%T) CHIP ALIVE — onchip_flash" >> "$LOG"
-( ONCHIP_FLASH_BUDGET=1100 timeout -k 120 -s TERM 1300 python scripts/onchip_flash.py >> "$LOG" 2>&1; \
-  echo "$(date +%FT%T) onchip_flash rc=$?" >> "$LOG" )
+if wait_alive; then
+  echo "$(date +%FT%T) CHIP ALIVE — onchip_flash" >> "$LOG"
+  ( ONCHIP_FLASH_BUDGET=1100 timeout -k 120 -s TERM 1300 python scripts/onchip_flash.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) onchip_flash rc=$?" >> "$LOG" )
+fi
 
-wait_alive
-echo "$(date +%FT%T) CHIP ALIVE — onchip_lm" >> "$LOG"
-( ONCHIP_LM_BUDGET=1500 timeout -k 120 -s TERM 1700 python scripts/onchip_lm.py >> "$LOG" 2>&1; \
-  echo "$(date +%FT%T) onchip_lm rc=$?" >> "$LOG" )
+if wait_alive; then
+  echo "$(date +%FT%T) CHIP ALIVE — onchip_lm" >> "$LOG"
+  ( ONCHIP_LM_BUDGET=1500 timeout -k 120 -s TERM 1700 python scripts/onchip_lm.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) onchip_lm rc=$?" >> "$LOG" )
+fi
 
-wait_alive
-echo "$(date +%FT%T) CHIP ALIVE — sweep" >> "$LOG"
-# 3 highest-value cells (conv7/512, conv7/256, space_to_depth/256); each cell
-# is one bench attempt whose compile either hits the cache (same graph as the
-# headline) or pays its own cold compile — 2400s covers both.
-( MFU_SWEEP_CELL_TIMEOUT=2500 MFU_SWEEP_MAX_CELLS=3 \
-  timeout -k 180 -s TERM 8100 python scripts/mfu_sweep.py >> "$LOG" 2>&1; \
-  echo "$(date +%FT%T) sweep rc=$?" >> "$LOG" )
+if wait_alive; then
+  echo "$(date +%FT%T) CHIP ALIVE — sweep" >> "$LOG"
+  # 3 highest-value cells (conv7/512, conv7/256, space_to_depth/256); each cell
+  # is one bench attempt whose compile either hits the cache (same graph as the
+  # headline) or pays its own cold compile — 2400s covers both.
+  ( MFU_SWEEP_CELL_TIMEOUT=2500 MFU_SWEEP_MAX_CELLS=3 \
+    timeout -k 180 -s TERM 8100 python scripts/mfu_sweep.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) sweep rc=$?" >> "$LOG" )
+fi
 echo "$(date +%FT%T) battery done" >> "$LOG"

@@ -22,7 +22,6 @@ lands (wedge protocol: partial evidence survives teardown). Exits 0 with a
 "skipped" record if no TPU is attached.
 """
 
-import functools
 import json
 import os
 import signal
@@ -33,7 +32,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))  # repo root (run from anywhere)
 OUT = os.path.join(_HERE, "onchip_lm.jsonl")
 
-from bench import _chip_peak  # one peak-FLOPs table for the whole battery
+# one peak-FLOPs table and one cache setup for the whole battery
+from bench import _chip_peak, enable_compilation_cache
 
 
 def emit(rec):
@@ -52,17 +52,7 @@ def main():
     plat = os.environ.get("CHAINERMN_TPU_BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
-    # Same persistent compilation cache as bench.py: a re-run (or the next
-    # chip window) skips the multi-minute remote compile.
-    cache_dir = os.environ.get(
-        "CHAINERMN_TPU_BENCH_CACHE", "/tmp/chainermn_tpu_jax_cache")
-    if cache_dir:
-        try:
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              10.0)
-        except Exception as e:
-            print(f"cache unavailable: {e}", file=sys.stderr)
+    enable_compilation_cache(jax)
 
     import jax.numpy as jnp
     import optax
@@ -97,9 +87,27 @@ def main():
     rng = jax.random.PRNGKey(0)
 
     this_run = []  # records from THIS process only (ratio pairing below)
+    # Starting a cell means starting a compile, and a remote compile cannot
+    # be preempted (SIGTERM defers while blocked in the C call; the
+    # follow-up SIGKILL orphans the single-tenant lease). So gate each
+    # cell on a pessimistic cost estimate, like bench.py's ladder: a warm
+    # previous compile predicts warm neighbors (same earlier process, same
+    # cell list); cold needs the full floor.
+    cell_floor = float(os.environ.get("ONCHIP_LM_CELL_FLOOR", "700"))
+    prev_wall = prev_compile = None
     for t_len, batch, attn in cells:
-        if time.time() > deadline:
-            emit({"cell": [t_len, batch, attn], "skipped": "budget"})
+        remaining = deadline - time.time()
+        if prev_wall is None:
+            need = 0.0 if tiny else min(cell_floor, remaining + 1)
+            # first cell: the budget is the operator's statement that one
+            # cell fits; no history to gate on
+        elif prev_compile is not None and prev_compile < 60:
+            need = max(3 * prev_wall, 120.0)
+        else:
+            need = cell_floor
+        if remaining < need:
+            emit({"cell": [t_len, batch, attn], "skipped": "budget",
+                  "remaining_s": round(remaining, 1), "need_s": need})
             continue
         rec = {"cell": [t_len, batch, attn], "seq_len": t_len,
                "batch": batch, "attention": attn,
@@ -111,7 +119,9 @@ def main():
                 n_layers=n_layers, max_len=max(t_len, 2048),
                 attention=attn, compute_dtype=jnp.bfloat16)
             tokens = jax.random.randint(rng, (batch, t_len), 0, vocab)
-            targets = jax.random.randint(rng, (batch, t_len), 0, vocab)
+            # real next-token objective (same key would make targets ==
+            # tokens: a trivial copy task whose loss collapses)
+            targets = jnp.roll(tokens, -1, axis=1)
             params = comm.bcast_data(model.init(rng, tokens))
             opt_state = jax.jit(opt.init)(params)
             n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
@@ -159,6 +169,8 @@ def main():
         except Exception as e:
             rec["error"] = f"{type(e).__name__}: {e}"[:400]
         rec["wall_s"] = round(time.time() - t_start, 1)
+        prev_wall = rec["wall_s"]
+        prev_compile = rec.get("compile_plus_first_step_s")  # None => cold
         this_run.append(rec)
         emit(rec)
 
