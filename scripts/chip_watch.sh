@@ -30,6 +30,14 @@ probe() {
   timeout -s TERM 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >/dev/null 2>&1
 }
 
+can_fit() {
+  # A stage starts only if its ENTIRE default window fits before the battery
+  # deadline: a clamped/partial window would TERM a child mid-remote-compile
+  # (un-preemptable; the follow-up KILL orphans the lease), and a stage
+  # running past the deadline collides with the driver's round-end bench.
+  [ $(( BATTERY_DEADLINE - ( $(date +%s) - START ) )) -ge "$1" ]
+}
+
 wait_alive() {
   # Probe until the chip responds; single-tenant leases clear in minutes.
   # Returns 1 (skip remaining stages) once the battery deadline passes.
@@ -44,7 +52,7 @@ wait_alive() {
   done
 }
 
-if wait_alive; then
+if wait_alive && can_fit 2700; then
   echo "$(date +%FT%T) CHIP ALIVE — bench (one 2400s attempt)" >> "$LOG"
   touch scripts/.chip_alive
   ( CHAINERMN_TPU_BENCH_ATTEMPTS=1 \
@@ -54,19 +62,19 @@ if wait_alive; then
     echo "$(date +%FT%T) bench rc=$?" >> "$LOG" )
 fi
 
-if wait_alive; then
+if wait_alive && can_fit 1300; then
   echo "$(date +%FT%T) CHIP ALIVE — onchip_flash" >> "$LOG"
   ( ONCHIP_FLASH_BUDGET=1100 timeout -k 120 -s TERM 1300 python scripts/onchip_flash.py >> "$LOG" 2>&1; \
     echo "$(date +%FT%T) onchip_flash rc=$?" >> "$LOG" )
 fi
 
-if wait_alive; then
+if wait_alive && can_fit 1700; then
   echo "$(date +%FT%T) CHIP ALIVE — onchip_lm" >> "$LOG"
   ( ONCHIP_LM_BUDGET=1500 timeout -k 120 -s TERM 1700 python scripts/onchip_lm.py >> "$LOG" 2>&1; \
     echo "$(date +%FT%T) onchip_lm rc=$?" >> "$LOG" )
 fi
 
-if wait_alive; then
+if wait_alive && can_fit 8100; then
   echo "$(date +%FT%T) CHIP ALIVE — sweep" >> "$LOG"
   # 3 highest-value cells (conv7/512, conv7/256, space_to_depth/256); each cell
   # is one bench attempt whose compile either hits the cache (same graph as the

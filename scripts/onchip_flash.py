@@ -35,6 +35,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))  # repo root (run from anywhere)
 OUT = os.path.join(_HERE, "onchip_flash.jsonl")
 
+from bench import enable_compilation_cache  # battery-wide compile cache
+
 
 def emit(rec):
     rec["t"] = round(time.time(), 1)
@@ -55,6 +57,7 @@ def main():
     plat = os.environ.get("CHAINERMN_TPU_BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
+    enable_compilation_cache(jax)
 
     import jax.numpy as jnp
     import numpy as np

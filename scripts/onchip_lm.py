@@ -98,9 +98,9 @@ def main():
     for t_len, batch, attn in cells:
         remaining = deadline - time.time()
         if prev_wall is None:
-            need = 0.0 if tiny else min(cell_floor, remaining + 1)
             # first cell: the budget is the operator's statement that one
             # cell fits; no history to gate on
+            need = 0.0
         elif prev_compile is not None and prev_compile < 60:
             need = max(3 * prev_wall, 120.0)
         else:

@@ -1,0 +1,80 @@
+"""Crash/resume fault injection under real processes (SURVEY.md S5
+"failure detection / elastic recovery": fail-fast + fail-and-restart).
+
+Launch 1 trains with per-step snapshots and rank 1 dies mid-run with
+``os._exit(1)`` — no cleanup, no distributed shutdown. Launch 2 is a fresh
+world (new coordinator) over the same snapshot directory: the multi-node
+checkpointer must agree on the newest COMMON iteration (discarding the
+orphan snapshot rank 0 wrote after the crash), resume, and reach exactly
+the state of an uninterrupted run. The reference exercises recovery by
+deleting a snapshot file in-process; this drives the real thing — an
+abrupt process death and a cross-launch resume."""
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "worker_resume.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch(phase: str, tmpdir: str, size: int = 2, timeout: float = 240.0):
+    port = _free_port()
+    # Strip XLA_FLAGS (the conftest's 8-device forcing is for THIS process)
+    # and CHAINERMN_TPU_OBJSTORE (an ambient native-sidecar address from an
+    # earlier test must not redirect these KV-transport workers) — same
+    # reasoning as test_multiprocess._launch_world.
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "CHAINERMN_TPU_OBJSTORE")}
+    procs = []
+    for r in range(size):
+        env = dict(
+            env_base,
+            MP_TEST_RANK=str(r),
+            MP_TEST_SIZE=str(size),
+            MP_TEST_PORT=str(port),
+            MP_TEST_TMPDIR=tmpdir,
+            MP_TEST_PHASE=phase,
+            PYTHONPATH=_REPO + os.pathsep + env_base.get("PYTHONPATH", ""),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+def test_crash_then_resume(tmp_path):
+    tmpdir = str(tmp_path)
+
+    procs, outs = _launch("crash", tmpdir)
+    assert procs[0].returncode == 0, f"rank 0:\n{outs[0][-4000:]}"
+    assert "WORKER_CRASH_PHASE_OK 0" in outs[0], outs[0][-4000:]
+    # the injected fault: rank 1 must have died abruptly with rc=1
+    assert procs[1].returncode == 1, (
+        f"rank 1 should have crashed (rc={procs[1].returncode}):\n"
+        f"{outs[1][-4000:]}")
+
+    procs, outs = _launch("resume", tmpdir)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"resume rank {r} failed (rc={p.returncode}):\n{out[-4000:]}")
+        assert f"WORKER_OK {r}" in out, f"resume rank {r}:\n{out[-4000:]}"

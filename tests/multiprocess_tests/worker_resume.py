@@ -1,0 +1,120 @@
+"""Subprocess body for the crash/resume fault-injection test.
+
+The reference's resilience story is fail-fast + fail-and-restart recovery
+(global except hook + multi-node checkpointer, SURVEY.md S5 "failure
+detection / elastic recovery"): a lost rank kills the job, the relaunch
+resumes from the newest snapshot every rank HAS. This worker drives that
+story end to end under real processes:
+
+  phase=crash : train a deterministic quadratic under eager device
+      collectives, checkpointing every step; after step CRASH_AT rank 1
+      dies with ``os._exit(1)`` — no finalize, no distributed shutdown,
+      the genuine article — while rank 0 saves one iteration it is
+      "ahead" by (as if it noticed the peer's death later) and exits.
+  phase=resume : a FRESH world (new coordinator) over the same snapshot
+      dir; ``maybe_load`` must agree on the newest COMMON iteration
+      (CRASH_AT, not rank 0's orphan), then training continues to
+      N_STEPS and the final weights must equal an uninterrupted run —
+      computed in-process, closed form, no tolerance games.
+
+Run via ``test_fault_recovery.py``, not directly.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+N_STEPS = 6
+CRASH_AT = 3
+LR = 0.1
+
+
+def targets_for(rank: int) -> np.ndarray:
+    return np.full((4,), float(rank + 1))
+
+
+def reference_weights(size: int, n_steps: int) -> np.ndarray:
+    """Uninterrupted training, computed locally: w <- w - lr * mean_r
+    2*(w - target_r)."""
+    w = np.ones((4,))
+    mean_target = np.mean([targets_for(r) for r in range(size)], axis=0)
+    for _ in range(n_steps):
+        w = w - LR * 2.0 * (w - mean_target)
+    return w
+
+
+def check(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+
+
+def train_step(comm, w: np.ndarray, size: int) -> np.ndarray:
+    # Eager collectives take rank-major global arrays (every process passes
+    # the same [size, ...] host array; row r is rank r's contribution —
+    # the documented contract, see MeshCommunicator._eager). w is identical
+    # on every rank after each allreduce, so each process can build the
+    # full stack.
+    grads = np.stack([2.0 * (w - targets_for(r)) for r in range(size)])
+    mean_grad = comm.allreduce(grads.astype(np.float32), "mean")
+    local = np.asarray(mean_grad.addressable_data(0))[0]
+    return w - LR * local
+
+
+def main():
+    rank = int(os.environ["MP_TEST_RANK"])
+    size = int(os.environ["MP_TEST_SIZE"])
+    port = os.environ["MP_TEST_PORT"]
+    tmpdir = os.environ["MP_TEST_TMPDIR"]
+    phase = os.environ["MP_TEST_PHASE"]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=size,
+        process_id=rank,
+    )
+
+    import chainermn_tpu
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+
+    comm = chainermn_tpu.create_communicator("naive")
+    cp = create_multi_node_checkpointer("resume", comm, path=tmpdir)
+
+    if phase == "crash":
+        w, start = np.ones((4,)), 0
+    else:
+        loaded, it = cp.maybe_load()
+        check(it == CRASH_AT,
+              f"agreement must resume at the newest COMMON iteration "
+              f"{CRASH_AT} (rank 0's orphan save must lose), got {it}")
+        w, start = loaded["w"], int(loaded["step"])
+        check(start == CRASH_AT, f"stale step in snapshot: {start}")
+
+    for step in range(start, N_STEPS):
+        w = train_step(comm, w, size)
+        cp.save({"w": w, "step": step + 1}, iteration=step + 1)
+        comm.barrier()
+        if phase == "crash" and step + 1 == CRASH_AT:
+            if rank == 1:
+                os._exit(1)  # the fault: no cleanup, no shutdown
+            # rank 0 "got ahead" before noticing the peer died: an orphan
+            # snapshot the resume agreement must discard
+            cp.save({"w": w, "step": step + 1}, iteration=CRASH_AT + 1)
+            print(f"WORKER_CRASH_PHASE_OK {rank}", flush=True)
+            # skip jax.distributed's atexit shutdown barrier: the peer is
+            # dead, the barrier can only time out (observed: ~90s stall,
+            # then a heartbeat-timeout error flips the exit code)
+            os._exit(0)
+
+    ref = reference_weights(size, N_STEPS)
+    check(np.allclose(w, ref, atol=1e-5),  # grads ride float32 on device
+          f"resumed training diverged from uninterrupted run: {w} vs {ref}")
+    cp.finalize()
+    print(f"WORKER_OK {rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
