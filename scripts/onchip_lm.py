@@ -99,8 +99,11 @@ def main():
         remaining = deadline - time.time()
         if prev_wall is None:
             # first cell: the budget is the operator's statement that one
-            # cell fits; no history to gate on
-            need = 0.0
+            # cell fits; no history to gate on — but a startup that already
+            # drained the deadline (wedged-tunnel attach) must still skip,
+            # or the un-preemptable compile starts with no window left and
+            # the outer TERM/KILL orphans the lease.
+            need = 60.0
         elif prev_compile is not None and prev_compile < 60:
             need = max(3 * prev_wall, 120.0)
         else:

@@ -380,3 +380,19 @@ def test_tpu_compressed_allreduce_dtype():
     out = comm.multi_node_mean_grad(grads)
     assert out["w"].dtype == np.float32  # cast back after the wire
     np.testing.assert_allclose(np.asarray(out["w"])[0], (n - 1) / 2.0, rtol=2e-2)
+
+
+def test_tpu_wire_dtype_skipped_at_world_one():
+    """A size-1 axis has no wire: the bf16 round-trip must be skipped so
+    gradients pass through bitwise-exact (and the casts' ~2.5ms/step cost —
+    measured, PERF.md round 5 — is not paid)."""
+    comm = create_communicator("tpu", allreduce_grad_dtype="bfloat16")
+    singleton = comm.split(list(range(comm.size)))  # every rank its own color
+    assert singleton.size == 1
+    # 1 + 2**-12 is not representable in bfloat16 (8 mantissa bits): it
+    # survives only if the wire cast is skipped.
+    val = np.float32(1.0) + np.float32(2.0**-12)
+    grads = {"w": np.full((comm.size, 3), val, dtype=np.float32)}
+    out = np.asarray(singleton.multi_node_mean_grad(grads)["w"])
+    assert out.dtype == np.float32
+    assert np.all(out == val), (out, val)

@@ -11,55 +11,16 @@ deleting a snapshot file in-process; this drives the real thing — an
 abrupt process death and a cross-launch resume."""
 
 import os
-import socket
-import subprocess
-import sys
+
+from .test_multiprocess import _launch_world
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "worker_resume.py")
-_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _launch(phase: str, tmpdir: str, size: int = 2, timeout: float = 240.0):
-    port = _free_port()
-    # Strip XLA_FLAGS (the conftest's 8-device forcing is for THIS process)
-    # and CHAINERMN_TPU_OBJSTORE (an ambient native-sidecar address from an
-    # earlier test must not redirect these KV-transport workers) — same
-    # reasoning as test_multiprocess._launch_world.
-    env_base = {k: v for k, v in os.environ.items()
-                if k not in ("XLA_FLAGS", "CHAINERMN_TPU_OBJSTORE")}
-    procs = []
-    for r in range(size):
-        env = dict(
-            env_base,
-            MP_TEST_RANK=str(r),
-            MP_TEST_SIZE=str(size),
-            MP_TEST_PORT=str(port),
-            MP_TEST_TMPDIR=tmpdir,
-            MP_TEST_PHASE=phase,
-            PYTHONPATH=_REPO + os.pathsep + env_base.get("PYTHONPATH", ""),
-        )
-        procs.append(subprocess.Popen(
-            [sys.executable, _WORKER], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        ))
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    return procs, outs
+    return _launch_world(size, tmpdir, timeout=timeout, worker=_WORKER,
+                         extra_env={"MP_TEST_PHASE": phase})
 
 
 def test_crash_then_resume(tmp_path):

@@ -26,7 +26,9 @@ def _free_port() -> int:
 
 
 def _launch_world(size: int, tmpdir: str, timeout: float = 240.0,
-                  transport: str = "kv"):
+                  transport: str = "kv", worker: str = None,
+                  extra_env: dict = None):
+    worker = worker or _WORKER
     port = _free_port()
     env_base = {
         k: v for k, v in os.environ.items()
@@ -56,8 +58,9 @@ def _launch_world(size: int, tmpdir: str, timeout: float = 240.0,
                 MP_TEST_TRANSPORT=transport,
                 PYTHONPATH=_REPO + os.pathsep + env_base.get("PYTHONPATH", ""),
             )
+            env.update(extra_env or {})
             procs.append(subprocess.Popen(
-                [sys.executable, _WORKER],
+                [sys.executable, worker],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True,
             ))
