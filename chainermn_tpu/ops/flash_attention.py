@@ -91,6 +91,16 @@ def _out_vma(*xs) -> frozenset:
     return vma
 
 
+def _prec(*xs):
+    """HIGHEST precision for f32 MXU operands: Mosaic's default f32 dot
+    (like XLA's) may round operands through bf16 passes; flash in f32 is a
+    correctness surface (the CPU oracle path), not a perf path, so pay for
+    exactness. bf16 operands are single-pass exact either way -> None keeps
+    the fast path untouched."""
+    return (jax.lax.Precision.HIGHEST
+            if any(x.dtype == jnp.float32 for x in xs) else None)
+
+
 def _fold_args(b, h, d, *xs):
     """Model layout ``[B, T, H, D]`` -> kernel layout ``[B*H, T, D]``."""
     return tuple(x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
@@ -123,14 +133,19 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         o_acc[...] = jnp.zeros_like(o_acc)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)
+        # MXU inputs stay in their storage dtype: bf16 x bf16 -> f32 is the
+        # MXU's native full-rate mode, while a pre-cast to f32 forces the
+        # multi-pass f32 path (~3-6x slower; measured round 5 — the kernel
+        # sat at ~6.5 TFLOP/s with the casts). preferred_element_type keeps
+        # the ACCUMULATION in f32 either way, which is all flash needs.
+        q = q_ref[0]
         kb = k_ref[0]
         vb = v_ref[0]
         m = m_acc[:, 0]
         l = l_acc[:, 0]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_prec(q, kb),
         ) * scale
         if causal:
             q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -142,9 +157,12 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         # a VISITED block, s == m_new == the sentinel and exp(s - m_new)
         # would be 1, polluting l/acc with mean-of-V garbage
         p = jnp.where(s <= _NEG_BIG / 2, 0.0, jnp.exp(s - m_new[:, None]))
+        # p rides the MXU in v's dtype (f32 p x bf16 v would hit the slow
+        # path); the f32->bf16 rounding of p is the same concession every
+        # production TPU flash kernel makes, and the accumulator stays f32
         pv = jax.lax.dot_general(
-            p, vb.astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(vb),
         )
         m_acc[...] = jnp.broadcast_to(m_new[:, None], m_acc.shape)
         l_acc[...] = jnp.broadcast_to(
@@ -242,15 +260,16 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # storage-dtype MXU inputs, f32 accumulation — see _fwd_kernel
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0]     # lane-broadcast [block_q, _LANE]
         delta = delta_ref[0, :, 0]
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
+        kb = k_ref[0]
+        vb = v_ref[0]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_prec(q, kb),
         ) * scale
         if causal:
             q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -261,12 +280,12 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         p = jnp.where(s <= _NEG_BIG / 2, 0.0, jnp.exp(s - lse[:, None]))
         dp = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_prec(do, vb),
         )
         ds = p * (dp - delta[:, None])
         dq_acc[...] += jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(kb),
         )
 
     if causal:
@@ -304,15 +323,16 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def compute():
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
-        qb = q_ref[0].astype(jnp.float32)
-        dob = do_ref[0].astype(jnp.float32)
+        # storage-dtype MXU inputs, f32 accumulation — see _fwd_kernel
+        kb = k_ref[0]
+        vb = v_ref[0]
+        qb = q_ref[0]
+        dob = do_ref[0]
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_prec(qb, kb),
         ) * scale
         if causal:
             q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -320,17 +340,17 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
         p = jnp.where(s <= _NEG_BIG / 2, 0.0, jnp.exp(s - lse[:, None]))
         dv_acc[...] += jax.lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(dob),
         )
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_prec(dob, vb),
         )
         ds = p * (dp - delta[:, None])
         dk_acc[...] += jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(qb),
         )
 
     if causal:

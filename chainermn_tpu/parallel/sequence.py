@@ -796,14 +796,21 @@ def update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
     return out, {"k": kbuf, "v": vbuf}
 
 
-def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
+                   precision=None):
     """Single-device exact attention, same layout/semantics — the reference
     implementation the parallel variants are tested against, and the
-    fallback when no sequence axis is sharded."""
+    fallback when no sequence axis is sharded.
+
+    ``precision``: forwarded to the einsums. TPU matmuls at the default
+    precision round f32 operands through bf16 passes (~1e-3 abs error) —
+    oracle uses (e.g. the on-chip parity battery) pass ``"highest"`` so the
+    reference is actually f32-accurate."""
     d = q.shape[-1]
     if scale is None:
         scale = d ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32,
+                   precision=precision)
     s = s * scale
     if causal:
         t, tk = s.shape[-2], s.shape[-1]
@@ -811,7 +818,7 @@ def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = No
         s = jnp.where(mask[None, None, :, :], s, _NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
-                     preferred_element_type=jnp.float32)
+                     preferred_element_type=jnp.float32, precision=precision)
     return out.astype(q.dtype)
 
 
