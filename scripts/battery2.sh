@@ -1,0 +1,67 @@
+#!/bin/bash
+# Round-5 battery 2: re-measure the flash stack after the storage-dtype MXU
+# fix (commit ce1ad92), in priority order:
+#   1. flash_tune.py    -> flash_tune.jsonl  (block-size sweep, NEW kernels)
+#   2. onchip_flash.py  -> onchip_flash.jsonl (parity w/ highest-prec oracle
+#                          + flash-vs-full timing, NEW kernels)
+#   3. onchip_lm.py     -> onchip_lm.jsonl   (LM MFU cells, NEW kernels;
+#                          includes the 2048-full cell that hit a transient
+#                          HTTP 500 in window 1)
+#   4. space_to_depth/256 bench retry (window-1 cell died UNAVAILABLE).
+# Same wedge protocol as chip_watch.sh (probe between stages, whole-window
+# stage gates, one attempt per stage, battery deadline).
+set -u
+cd /root/repo
+LOG=scripts/battery2.log
+START=$(date +%s)
+BATTERY_DEADLINE=${BATTERY2_DEADLINE:-14400}
+echo "$(date +%FT%T) battery2 start (deadline ${BATTERY_DEADLINE}s)" >> "$LOG"
+
+probe() {
+  timeout -s TERM 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >/dev/null 2>&1
+}
+
+can_fit() {
+  [ $(( BATTERY_DEADLINE - ( $(date +%s) - START ) )) -ge "$1" ]
+}
+
+wait_alive() {
+  while true; do
+    if [ $(( $(date +%s) - START )) -gt "$BATTERY_DEADLINE" ]; then
+      echo "$(date +%FT%T) battery2 deadline passed" >> "$LOG"
+      return 1
+    fi
+    if probe; then return 0; fi
+    echo "$(date +%FT%T) probe wedged" >> "$LOG"
+    sleep 240
+  done
+}
+
+if wait_alive && can_fit 1500; then
+  echo "$(date +%FT%T) CHIP ALIVE — flash_tune" >> "$LOG"
+  ( FLASH_TUNE_BUDGET=1300 timeout -k 120 -s TERM 1500 python scripts/flash_tune.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) flash_tune rc=$?" >> "$LOG" )
+fi
+
+if wait_alive && can_fit 1700; then
+  echo "$(date +%FT%T) CHIP ALIVE — onchip_flash (post-fix)" >> "$LOG"
+  ( ONCHIP_FLASH_BUDGET=1500 timeout -k 120 -s TERM 1700 python scripts/onchip_flash.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) onchip_flash rc=$?" >> "$LOG" )
+fi
+
+if wait_alive && can_fit 1700; then
+  echo "$(date +%FT%T) CHIP ALIVE — onchip_lm (post-fix)" >> "$LOG"
+  ( ONCHIP_LM_BUDGET=1500 timeout -k 120 -s TERM 1700 python scripts/onchip_lm.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) onchip_lm rc=$?" >> "$LOG" )
+fi
+
+if wait_alive && can_fit 2000; then
+  echo "$(date +%FT%T) CHIP ALIVE — space_to_depth/256 retry" >> "$LOG"
+  ( CHAINERMN_TPU_BENCH_STEM=space_to_depth CHAINERMN_TPU_BENCH_BATCH=256 \
+    CHAINERMN_TPU_BENCH_SWEEP=0 CHAINERMN_TPU_BENCH_STEPS=50 \
+    CHAINERMN_TPU_BENCH_ATTEMPTS=1 CHAINERMN_TPU_BENCH_TIMEOUT=1800 \
+    CHAINERMN_TPU_BENCH_TOTAL_BUDGET=1860 \
+    timeout -k 120 -s TERM 2000 python bench.py > scripts/s2d_retry.json 2>> "$LOG"; \
+    echo "$(date +%FT%T) s2d retry rc=$?" >> "$LOG" )
+fi
+echo "$(date +%FT%T) battery2 done" >> "$LOG"
