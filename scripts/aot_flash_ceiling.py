@@ -32,7 +32,6 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # host only; target is abstract
-    import functools
 
     import jax.numpy as jnp
     import numpy as np
