@@ -63,13 +63,38 @@ def _smem_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _pick_block(t: int, preferred: int = 128) -> int:
+def _pick_block(t: int, preferred: int = 512) -> int:
     """Largest divisor of ``t`` that is <= preferred (kernel blocks must
-    tile the sequence exactly; callers fall back to XLA otherwise)."""
-    b = min(preferred, t)
-    while t % b:
-        b -= 1
-    return b
+    tile the sequence exactly; callers fall back to XLA otherwise).
+
+    The 512 default follows production TPU flash kernels: per-cell fixed
+    work (mask iota, scratch flush, grid bookkeeping) amortizes over 4x
+    more MXU work than the original 128, and VMEM per cell stays O(block)
+    — ~1.5 MB at block 512, d=64, far under the ~128 MB budget (the
+    T = 131072 ceiling re-verified at this block size,
+    scripts/aot_flash_ceiling.jsonl). scripts/flash_tune.py measures
+    {128, 256, 512, 1024} on-chip to refine this from data.
+
+    Blocks respect the 8-row sublane granularity (Mosaic's (8, 128)
+    tiling rule): candidates step down in multiples of 8, and a length
+    with no such divisor returns 1, which is below every caller's
+    usable-block floor — flash_attention falls back to XLA, ring callers
+    raise their pad-the-shard error. (The pre-round-5 picker accepted any
+    divisor, so e.g. t=251 with a >=251 preferred would have produced one
+    251-row block that only works in interpret mode.) A sub-8 ``preferred``
+    on a t > 8 sequence rounds UP to the hardware-minimum 8-row block
+    (a 4-row block cannot tile on the MXU regardless of the request);
+    t <= 8 keeps the plain largest-divisor-<=-preferred search (tiny test
+    shapes, where interpret mode has no tiling rule)."""
+    if t <= 8:
+        b = max(1, min(preferred, t))
+        while t % b:
+            b -= 1
+        return b
+    b = max(8, min(preferred, t) - min(preferred, t) % 8)
+    while b >= 8 and t % b:
+        b -= 8
+    return b if b >= 8 else 1
 
 
 def _interpret_default() -> bool:
@@ -496,8 +521,8 @@ def flash_attention(
     scale: Optional[float] = None,
     q_offset=0,
     k_offset=0,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
 ):
     """Blockwise (flash) attention, layout ``[B, T, H, D]`` like
@@ -564,7 +589,7 @@ def _check_blocks(bq, bk, tq, tk):
 
 
 def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
-                       k_offset=0, block_q=128, block_k=128, interpret=None,
+                       k_offset=0, block_q=512, block_k=512, interpret=None,
                        out_dtype=None):
     """Primal-only flash forward returning ``(out, lse)``.
 
@@ -596,7 +621,7 @@ def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
 
 
 def flash_block_grads(q, k, v, do, lse, delta, *, causal=False, scale=None,
-                      q_offset=0, k_offset=0, block_q=128, block_k=128,
+                      q_offset=0, k_offset=0, block_q=512, block_k=512,
                       interpret=None, grad_dtype=jnp.float32):
     """One block's gradient contributions ``(dq, dk, dv)`` given the FINAL
     (globally merged) ``lse [B, H, Tq]`` and ``delta = rowsum(do * out)
