@@ -499,7 +499,8 @@ def _failure_record(err_class: str, detail: str, attempts_run: int) -> dict:
                     last = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if isinstance(last, dict) and last.get("value") is not None:
+                if (isinstance(last, dict) and last.get("value") is not None
+                        and "TPU" in str(last.get("device_kind", ""))):
                     rec["last_measured"] = last
                     rec["last_measured_age_s"] = round(
                         time.time() - os.path.getmtime(path), 1
@@ -516,11 +517,17 @@ _LAST_MEASURED_PATH = os.path.join(
 
 
 def _persist_measured(json_line: str) -> None:
-    """Keep the newest successful measurement in a file no launcher
-    redirection can truncate, for _failure_record's evidence embed."""
+    """Keep the newest successful TPU measurement in a file no launcher
+    redirection can truncate, for _failure_record's evidence embed.
+
+    TPU-only on purpose: the CI smoke test runs this same parent on a tiny
+    CPU mesh in the repo cwd, and its record must never displace the
+    round's real-chip evidence (it did once — caught when the suite
+    overwrote the window-1 record with value=102 img/s, device=cpu)."""
     try:
         rec = json.loads(json_line)
-        if isinstance(rec, dict) and rec.get("value") is not None:
+        if (isinstance(rec, dict) and rec.get("value") is not None
+                and "TPU" in str(rec.get("device_kind", ""))):
             with open(_LAST_MEASURED_PATH, "w") as f:
                 f.write(json_line.strip() + "\n")
     except Exception:

@@ -64,8 +64,10 @@ def _smem_spec():
 
 
 def _pick_block(t: int, preferred: int = 512) -> int:
-    """Largest divisor of ``t`` that is <= preferred (kernel blocks must
-    tile the sequence exactly; callers fall back to XLA otherwise).
+    """Largest hardware-legal divisor of ``t`` near ``preferred`` (kernel
+    blocks must tile the sequence exactly; callers fall back to XLA
+    otherwise). "Near": sub-8 requests on t > 8 round UP to the 8-row
+    hardware minimum, so the result can exceed ``preferred``.
 
     The 512 default follows production TPU flash kernels: per-cell fixed
     work (mask iota, scratch flush, grid bookkeeping) amortizes over 4x
@@ -86,15 +88,12 @@ def _pick_block(t: int, preferred: int = 512) -> int:
     (a 4-row block cannot tile on the MXU regardless of the request);
     t <= 8 keeps the plain largest-divisor-<=-preferred search (tiny test
     shapes, where interpret mode has no tiling rule)."""
-    if t <= 8:
-        b = max(1, min(preferred, t))
-        while t % b:
-            b -= 1
-        return b
-    b = max(8, min(preferred, t) - min(preferred, t) % 8)
-    while b >= 8 and t % b:
-        b -= 8
-    return b if b >= 8 else 1
+    step = 1 if t <= 8 else 8
+    m = min(preferred, t)
+    b = max(step, m - m % step)
+    while b >= step and t % b:
+        b -= step
+    return b if b >= step else 1
 
 
 def _interpret_default() -> bool:

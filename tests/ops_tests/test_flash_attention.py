@@ -152,3 +152,25 @@ def test_flash_kind_rejects_sharded_axis():
     from chainermn_tpu.parallel.sequence import sequence_parallel_attention
     with pytest.raises(ValueError, match="ring"):
         sequence_parallel_attention("flash", "ranks")
+
+
+def test_pick_block_contract():
+    """Sublane-granular block picker (round 5): candidates are multiples of
+    8 for t > 8 (Mosaic's tiling rule — the old picker could emit e.g. one
+    251-row block that only lowers in interpret mode), sub-8 requests on
+    t > 8 round up to the hardware-minimum 8, no-divisor lengths return 1
+    (the callers' fallback/raise sentinel), and t <= 8 keeps the plain
+    largest-divisor-<=-preferred search."""
+    from chainermn_tpu.ops.flash_attention import _pick_block
+
+    assert _pick_block(1024, 512) == 512       # default path
+    assert _pick_block(2048, 512) == 512
+    assert _pick_block(64, 512) == 64          # whole (multiple-of-8) block
+    assert _pick_block(24, 512) == 24
+    assert _pick_block(16, 512) == 16
+    assert _pick_block(251, 512) == 1          # prime: fallback sentinel
+    assert _pick_block(12, 512) == 1           # no multiple-of-8 divisor
+    assert _pick_block(64, 4) == 8             # sub-8 request rounds up
+    assert _pick_block(8, 4) == 4              # t <= 8: plain divisor search
+    assert _pick_block(6, 512) == 6
+    assert _pick_block(4, 512) == 4

@@ -51,3 +51,44 @@ def test_bench_smoke_tiny_cpu():
         if "skipped" not in s:
             assert s["collective_bytes_per_step"] > 0, s
     assert "double_buffering_speedup" in rec
+
+
+def test_persist_measured_is_tpu_only(tmp_path, monkeypatch):
+    """The evidence file must only ever hold real-chip records: a tiny-CPU
+    smoke run (this very suite) once displaced the round's TPU measurement.
+    Also pins _failure_record's embed chain: primary file, then reverse
+    bench_stdout scan skipping value=null lines."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    lm = tmp_path / "last_measured.json"
+    monkeypatch.setattr(bench, "_LAST_MEASURED_PATH", str(lm))
+
+    tpu_rec = {"metric": "m", "value": 2561.0, "device_kind": "TPU v5 lite"}
+    bench._persist_measured(json.dumps(tpu_rec))
+    assert json.loads(lm.read_text())["value"] == 2561.0
+
+    # a CPU record must NOT displace it
+    bench._persist_measured(json.dumps(
+        {"metric": "m", "value": 102.0, "device_kind": "cpu", "tiny": True}))
+    assert json.loads(lm.read_text())["value"] == 2561.0
+
+    # failure record embeds the persisted evidence
+    rec = bench._failure_record("TimeoutExpired", "tail", 2)
+    assert rec["value"] is None
+    assert rec["last_measured"]["value"] == 2561.0
+
+    # fallback: no primary file -> reverse-scan bench_stdout.txt past a
+    # trailing failure line
+    lm.unlink()
+    stdout_file = tmp_path / "bench_stdout.txt"
+    stdout_file.write_text(
+        json.dumps({"metric": "m", "value": 2442.0,
+                    "device_kind": "TPU v5 lite"}) + "\n"
+        + json.dumps({"metric": "m", "value": 102.0,
+                      "device_kind": "cpu", "tiny": True}) + "\n"
+        + json.dumps({"metric": "m", "value": None, "error": "x"}) + "\n")
+    rec = bench._failure_record("TimeoutExpired", "tail", 2)
+    # the scan must skip BOTH the trailing failure line and the newer
+    # CPU record (same TPU-only invariant as the primary file)
+    assert rec["last_measured"]["value"] == 2442.0
