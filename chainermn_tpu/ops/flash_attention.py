@@ -63,19 +63,22 @@ def _smem_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _pick_block(t: int, preferred: int = 512) -> int:
+def _pick_block(t: int, preferred: int = 1024) -> int:
     """Largest hardware-legal divisor of ``t`` near ``preferred`` (kernel
     blocks must tile the sequence exactly; callers fall back to XLA
     otherwise). "Near": sub-8 requests on t > 8 round UP to the 8-row
     hardware minimum, so the result can exceed ``preferred``.
 
-    The 512 default follows production TPU flash kernels: per-cell fixed
-    work (mask iota, scratch flush, grid bookkeeping) amortizes over 4x
-    more MXU work than the original 128, and VMEM per cell stays O(block)
-    — ~1.5 MB at block 512, d=64, far under the ~128 MB budget (the
-    T = 131072 ceiling re-verified at this block size,
-    scripts/aot_flash_ceiling.jsonl). scripts/flash_tune.py measures
-    {128, 256, 512, 1024} on-chip to refine this from data.
+    The 1024 default is measured, not guessed: the round-5 on-chip sweep
+    (scripts/flash_tune.py -> scripts/flash_tune.jsonl, v5e, bf16 fwd+bwd,
+    causal) is monotonic in block size at both T=4096 and T=8192 —
+    28.3 TFLOP/s at block 1024 vs 18.0 (512) / 6.7 (128) at T=8192.
+    Per-cell fixed work (mask iota, scratch flush, grid bookkeeping)
+    amortizes over more MXU work, and VMEM per cell stays O(block) —
+    ~3 MB at block 1024, d=64, far under the ~128 MB budget. The
+    T = 131072 single-call ceiling is AOT-verified at blocks 128/256/512
+    (scripts/aot_flash_ceiling.jsonl); the block-1024 ceiling run is
+    queued (scripts/battery3.sh) — on-chip 1024 coverage is T <= 8192.
 
     Blocks respect the 8-row sublane granularity (Mosaic's (8, 128)
     tiling rule): candidates step down in multiples of 8, and a length
@@ -520,8 +523,8 @@ def flash_attention(
     scale: Optional[float] = None,
     q_offset=0,
     k_offset=0,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ):
     """Blockwise (flash) attention, layout ``[B, T, H, D]`` like
@@ -588,7 +591,7 @@ def _check_blocks(bq, bk, tq, tk):
 
 
 def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
-                       k_offset=0, block_q=512, block_k=512, interpret=None,
+                       k_offset=0, block_q=1024, block_k=1024, interpret=None,
                        out_dtype=None):
     """Primal-only flash forward returning ``(out, lse)``.
 
@@ -620,7 +623,7 @@ def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
 
 
 def flash_block_grads(q, k, v, do, lse, delta, *, causal=False, scale=None,
-                      q_offset=0, k_offset=0, block_q=512, block_k=512,
+                      q_offset=0, k_offset=0, block_q=1024, block_k=1024,
                       interpret=None, grad_dtype=jnp.float32):
     """One block's gradient contributions ``(dq, dk, dv)`` given the FINAL
     (globally merged) ``lse [B, H, Tq]`` and ``delta = rowsum(do * out)

@@ -57,7 +57,7 @@ def main():
 
     B, H, D = 1, 8, 64
     for t_len in (32768, 131072):
-        for blk in (128, 256, 512):
+        for blk in (128, 256, 512, 1024):
             aval = jax.ShapeDtypeStruct((B, t_len, H, D), jnp.bfloat16,
                                         sharding=repl)
 

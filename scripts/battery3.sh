@@ -1,0 +1,85 @@
+#!/bin/bash
+# Round-5 battery 3: runs AFTER battery2 completes (waits on its log
+# marker), in the next responsive chip window. Priority order:
+#   1. bench.py headline (conv7/256, sweep off) — restores
+#      scripts/last_measured.json to the flagship config after battery2's
+#      space_to_depth retry overwrote it (newest-success-wins semantics).
+#   2. flash_tune.py — block sweep now including 2048 cells.
+#   3. onchip_flash.py — flash timing at the new block-1024 default.
+#   4. aot_flash_ceiling.py — T=131072 compile check at block 1024
+#      (service-side only, no device lease; still probe-gated because the
+#      axon remote-compile helper wedges together with the lease).
+#   5. onchip_lm.py — LAST: the one stage that hung (and wedged the
+#      tunnel) in battery2; if it wedges again nothing else is lost.
+# Same wedge protocol as chip_watch.sh rev2: probe between stages,
+# whole-window stage gates, one attempt per stage, battery deadline.
+set -u
+cd /root/repo
+LOG=scripts/battery3.log
+START=$(date +%s)
+BATTERY_DEADLINE=${BATTERY3_DEADLINE:-21600}
+echo "$(date +%FT%T) battery3 start (deadline ${BATTERY_DEADLINE}s)" >> "$LOG"
+
+# Wait for battery2 to finish so two children never share the tunnel.
+while ! grep -q "battery2 done" scripts/battery2.log 2>/dev/null; do
+  if [ $(( $(date +%s) - START )) -gt "$BATTERY_DEADLINE" ]; then
+    echo "$(date +%FT%T) battery3 deadline passed waiting for battery2" >> "$LOG"
+    exit 0
+  fi
+  sleep 120
+done
+echo "$(date +%FT%T) battery2 done observed" >> "$LOG"
+
+probe() {
+  timeout -s TERM 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >/dev/null 2>&1
+}
+
+can_fit() {
+  [ $(( BATTERY_DEADLINE - ( $(date +%s) - START ) )) -ge "$1" ]
+}
+
+wait_alive() {
+  while true; do
+    if [ $(( $(date +%s) - START )) -gt "$BATTERY_DEADLINE" ]; then
+      echo "$(date +%FT%T) battery3 deadline passed" >> "$LOG"
+      return 1
+    fi
+    if probe; then return 0; fi
+    echo "$(date +%FT%T) probe wedged" >> "$LOG"
+    sleep 240
+  done
+}
+
+if wait_alive && can_fit 2700; then
+  echo "$(date +%FT%T) CHIP ALIVE — bench headline conv7/256" >> "$LOG"
+  ( CHAINERMN_TPU_BENCH_SWEEP=0 CHAINERMN_TPU_BENCH_STEPS=50 \
+    CHAINERMN_TPU_BENCH_ATTEMPTS=1 CHAINERMN_TPU_BENCH_TIMEOUT=2400 \
+    CHAINERMN_TPU_BENCH_TOTAL_BUDGET=2500 \
+    timeout -k 120 -s TERM 2700 python bench.py > scripts/bench3.json 2>> "$LOG"; \
+    echo "$(date +%FT%T) bench rc=$?" >> "$LOG" )
+fi
+
+if wait_alive && can_fit 1500; then
+  echo "$(date +%FT%T) CHIP ALIVE — flash_tune (incl. 2048)" >> "$LOG"
+  ( FLASH_TUNE_BUDGET=1300 timeout -k 120 -s TERM 1500 python scripts/flash_tune.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) flash_tune rc=$?" >> "$LOG" )
+fi
+
+if wait_alive && can_fit 1700; then
+  echo "$(date +%FT%T) CHIP ALIVE — onchip_flash (block-1024 default)" >> "$LOG"
+  ( ONCHIP_FLASH_BUDGET=1500 timeout -k 120 -s TERM 1700 python scripts/onchip_flash.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) onchip_flash rc=$?" >> "$LOG" )
+fi
+
+if wait_alive && can_fit 2000; then
+  echo "$(date +%FT%T) CHIP ALIVE — aot_flash_ceiling (block 1024)" >> "$LOG"
+  ( timeout -k 120 -s TERM 2000 python scripts/aot_flash_ceiling.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) aot_ceiling rc=$?" >> "$LOG" )
+fi
+
+if wait_alive && can_fit 1700; then
+  echo "$(date +%FT%T) CHIP ALIVE — onchip_lm (wedge suspect, last)" >> "$LOG"
+  ( ONCHIP_LM_BUDGET=1500 timeout -k 120 -s TERM 1700 python scripts/onchip_lm.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) onchip_lm rc=$?" >> "$LOG" )
+fi
+echo "$(date +%FT%T) battery3 done" >> "$LOG"

@@ -76,7 +76,7 @@ def main():
             except Exception as e:
                 emit({"test": "full_ref", "seq_len": t_len,
                       "error": f"{type(e).__name__}: {e}"[:200]})
-        for blk in (128, 256, 512, 1024):
+        for blk in (128, 256, 512, 1024, 2048):
             if time.time() > deadline:
                 emit({"test": "tune", "seq_len": t_len, "block": blk,
                       "skipped": "budget"})
