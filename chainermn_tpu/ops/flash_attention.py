@@ -103,6 +103,17 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _default_block(t: int) -> int:
+    """Default preferred block for sequence length ``t``: 1024 inside the
+    measured regime (on-chip sweep coverage is T <= 8192, where 1024 is
+    1.6x faster than 512 — see :func:`_pick_block`), 512 beyond it, where
+    the evidence stands at block <= 512 (on-chip long-context cells at
+    T = 16k/32k and the T = 131072 AOT ceiling,
+    scripts/aot_flash_ceiling.jsonl). Widen to 1024 everywhere once the
+    queued block-1024 ceiling + long-T runs land (scripts/battery3.sh)."""
+    return 1024 if t <= 8192 else 512
+
+
 def _out_vma(*xs) -> frozenset:
     """Varying-manner annotation for kernel outputs: the union of the
     inputs' vma sets. pallas_call does not infer vma, so under
@@ -523,8 +534,8 @@ def flash_attention(
     scale: Optional[float] = None,
     q_offset=0,
     k_offset=0,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Blockwise (flash) attention, layout ``[B, T, H, D]`` like
@@ -541,8 +552,8 @@ def flash_attention(
         scale = d ** -0.5
     if interpret is None:
         interpret = _interpret_default()
-    bq = _pick_block(tq, block_q)
-    bk = _pick_block(tk, block_k)
+    bq = _pick_block(tq, block_q or _default_block(tq))
+    bk = _pick_block(tk, block_k or _default_block(tk))
     if bq < min(8, tq) or bk < min(8, tk):
         # awkward lengths (no usable divisor): blockwise degenerates below
         # hardware tile minimums — use the XLA path, same semantics
@@ -591,7 +602,7 @@ def _check_blocks(bq, bk, tq, tk):
 
 
 def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
-                       k_offset=0, block_q=1024, block_k=1024, interpret=None,
+                       k_offset=0, block_q=None, block_k=None, interpret=None,
                        out_dtype=None):
     """Primal-only flash forward returning ``(out, lse)``.
 
@@ -609,7 +620,8 @@ def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
         scale = d ** -0.5
     if interpret is None:
         interpret = _interpret_default()
-    bq, bk = _pick_block(tq, block_q), _pick_block(tk, block_k)
+    bq = _pick_block(tq, block_q or _default_block(tq))
+    bk = _pick_block(tk, block_k or _default_block(tk))
     _check_blocks(bq, bk, tq, tk)
     qf, kf, vf = _fold_args(b, h, d, q, k, v)
     out, lse = _fwd(qf, kf, vf,
@@ -623,7 +635,7 @@ def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
 
 
 def flash_block_grads(q, k, v, do, lse, delta, *, causal=False, scale=None,
-                      q_offset=0, k_offset=0, block_q=1024, block_k=1024,
+                      q_offset=0, k_offset=0, block_q=None, block_k=None,
                       interpret=None, grad_dtype=jnp.float32):
     """One block's gradient contributions ``(dq, dk, dv)`` given the FINAL
     (globally merged) ``lse [B, H, Tq]`` and ``delta = rowsum(do * out)
@@ -638,7 +650,8 @@ def flash_block_grads(q, k, v, do, lse, delta, *, causal=False, scale=None,
         scale = d ** -0.5
     if interpret is None:
         interpret = _interpret_default()
-    bq, bk = _pick_block(tq, block_q), _pick_block(tk, block_k)
+    bq = _pick_block(tq, block_q or _default_block(tq))
+    bk = _pick_block(tk, block_k or _default_block(tk))
     _check_blocks(bq, bk, tq, tk)
     qf, kf, vf, dof = _fold_args(b, h, d, q, k, v, do)
     lsef = lse.reshape(b * h, tq)

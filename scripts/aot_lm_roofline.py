@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""AOT bytes/FLOPs roofline for the on-chip LM cells (round-5 diagnosis).
+
+The window-1 LM measurement (scripts/onchip_lm.jsonl) came in at 13.9%
+analytic MFU at T=2048 B=8 — and at that shape attention is ~1% of the
+step FLOPs, so the matmul tower itself was slow. First-principles HBM
+estimates (f32 logits ~2 GB, optimizer state ~5 GB, activations ~8 GB)
+do not add up to the 672 ms measured, so this script asks the compiler:
+AOT-compile the EXACT ``jit_lm_train_step`` program for the onchip_lm
+cell shapes against an abstract v5e and read its own cost accounting —
+FLOPs, HBM bytes, arithmetic intensity, roofline ms, MFU ceiling —
+the same method that resolved the ResNet MFU question in round 4
+(PERF.md "Where the time goes").
+
+Run chip-free (forces the CPU backend for eager ops; the TPU compiler
+is reached through the AOT lowering path only). NOTE the axon
+remote-compile helper serves AOT compiles too and wedges together with
+the device lease — run under a timeout and treat a hang as "service
+wedged", not as a bug here.
+
+Appends one record per cell to scripts/lm_roofline_aot.jsonl.
+"""
+
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(_HERE, "lm_roofline_aot.jsonl")
+
+PEAK_FLOPS = 197e12   # v5e bf16
+HBM_GBPS = 819e9
+
+# (seq_len, batch, attention) — the onchip_lm cells plus a B=32 T=2048
+# probe (token-batch lever: 4x the tokens amortize weight traffic 4x)
+CELLS = [
+    (2048, 8, "flash"),
+    (2048, 8, "full"),
+    (8192, 2, "flash"),
+    (2048, 32, "flash"),
+]
+
+
+def emit(rec):
+    rec["t"] = round(time.time(), 1)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import chainermn_tpu
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.training import jit_lm_train_step
+
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    mesh = Mesh(np.array(topo.devices[:1]), ("mn",))
+    repl = NamedSharding(mesh, P())
+    emit({"test": "target", "device_kind": topo.devices[0].device_kind})
+
+    vocab, d_model, n_layers = 32768, 1024, 12
+    n_heads = d_model // 64
+
+    comm = chainermn_tpu.create_communicator("tpu", mesh=mesh)
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.adamw(3e-4), comm)
+
+    for t_len, batch, attn in CELLS:
+        rec = {"cell": [t_len, batch, attn], "seq_len": t_len,
+               "batch": batch, "attention": attn}
+        t0 = time.time()
+        try:
+            model = TransformerLM(
+                vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+                n_layers=n_layers, max_len=max(t_len, 2048),
+                attention=attn, compute_dtype=jnp.bfloat16)
+            step = jit_lm_train_step(model, opt, comm, donate=False)
+
+            var_shapes = jax.eval_shape(
+                lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32)),
+                jax.ShapeDtypeStruct((2,), jnp.uint32))
+            to_aval = lambda t: jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                               sharding=repl), t)
+            variables = to_aval(var_shapes)
+            opt_state = to_aval(jax.eval_shape(opt.init, var_shapes))
+            tok = jax.ShapeDtypeStruct((batch, t_len), jnp.int32,
+                                       sharding=repl)
+
+            compiled = step.lower(variables, opt_state, tok, tok).compile()
+            rec["compile_s"] = round(time.time() - t0, 1)
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            flops = float(ca.get("flops", 0.0))
+            byts = float(ca.get("bytes accessed", 0.0))
+            rec["flops"] = flops
+            rec["hbm_bytes"] = byts
+            rec["arith_intensity"] = round(flops / byts, 1) if byts else None
+            t_comp = flops / PEAK_FLOPS
+            t_mem = byts / HBM_GBPS
+            rec["bound"] = "compute" if t_comp > t_mem else "memory"
+            roof_s = max(t_comp, t_mem)
+            rec["roofline_ms"] = round(roof_s * 1e3, 2)
+            rec["mfu_ceiling"] = round(flops / roof_s / PEAK_FLOPS, 4)
+            # token-normalized view for cross-cell comparison
+            rec["roofline_tokens_per_sec"] = round(batch * t_len / roof_s, 1)
+            try:
+                ma = compiled.memory_analysis()
+                rec["peak_hbm_gb"] = round(
+                    (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                     + ma.output_size_in_bytes) / 2**30, 2)
+            except Exception:
+                pass
+        except Exception as e:
+            rec["error"] = f"{type(e).__name__}: {e}"[:300]
+        rec["wall_s"] = round(time.time() - t0, 1)
+        emit(rec)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,10 @@ done
 echo "$(date +%FT%T) battery2 done observed" >> "$LOG"
 
 probe() {
-  timeout -s TERM 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >/dev/null 2>&1
+  # -k 30: a wedged probe can defer TERM inside the remote C call
+  # (PERF.md window 2) — without the KILL escalation the probe, and with
+  # it the whole battery, would hang past its deadline.
+  timeout -k 30 -s TERM 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >/dev/null 2>&1
 }
 
 can_fit() {
