@@ -119,6 +119,40 @@ def _chip_peak(device_kind: str):
     return None
 
 
+def _write_warm_marker(stem, rung, explicit_batch, n_chips, tiny, platform,
+                       compile_s, t0) -> None:
+    """Drop the headline_<stem>_<key>.ok marker _budget_plan keys warm
+    detection on — only for a REAL (TPU, non-tiny) run whose executable
+    demonstrably reached the persistent cache: a fresh ``-cache`` entry
+    appeared since ``t0`` (cold compile persisted) or the compile was a
+    warm hit (<10s: deserialization is local and fast; a cold compile
+    through the remote tunnel is minutes). A >=10s compile with no new
+    entry means serialization was skipped (enable_compilation_cache
+    tolerates that) and the next run is still cold — writing the marker
+    would recreate the round-4 double-TERM. The key matches what
+    _budget_plan computes on the parent side: the raw env value for an
+    explicitly-set batch, the per-chip rung otherwise (the parent cannot
+    know n_chips, so its default key is the per-chip 256)."""
+    if tiny or platform != "tpu":
+        return
+    try:
+        cache_dir = os.environ.get(
+            "CHAINERMN_TPU_BENCH_CACHE", "/tmp/chainermn_tpu_jax_cache")
+        if not cache_dir or not os.path.isdir(cache_dir):
+            return
+        persisted = any(
+            e.name.endswith("-cache") and e.stat().st_mtime >= t0 - 5
+            for e in os.scandir(cache_dir))
+        if not (persisted or compile_s < 10):
+            return
+        key = explicit_batch if explicit_batch else rung // max(n_chips, 1)
+        with open(os.path.join(
+                cache_dir, f"headline_{stem}_{key}.ok"), "w") as mf:
+            mf.write(f"{compile_s:.1f}\n")
+    except OSError:
+        pass
+
+
 def _measure(model, comm, batch, *, double_buffering, n_steps, warmup=3,
              commstats=True, image_size=224):
     """Compile + time one configuration; returns a result dict.
@@ -151,33 +185,10 @@ def _measure(model, comm, batch, *, double_buffering, n_steps, warmup=3,
     t0 = time.time()
     step = jitted.lower(variables, opt_state, images, labels).compile()
     compile_s = time.time() - t0
-    # Marker for the parent's cold/warm budget choice: this per-chip
-    # (stem, batch) executable now sits in the persistent cache, so future
-    # default runs can keep the short-attempt retry ladder. (The cache's
-    # own entries are opaque hashes — a same-dir marker is the only way to
-    # know WHICH program is warm.) Written only when the cache demonstrably
-    # engaged — a fresh entry appeared (cold compile persisted) or the
-    # compile was trivially fast (<10s: below the persistence threshold,
-    # where re-compiling is cheaper than the long-attempt fallback anyway).
-    # A >=10s compile with NO new entry means serialization was skipped
-    # (enable_compilation_cache tolerates that) and the next run is still
-    # cold — no marker, or the parent would recreate the double-TERM.
-    try:
-        cache_dir = os.environ.get(
-            "CHAINERMN_TPU_BENCH_CACHE", "/tmp/chainermn_tpu_jax_cache")
-        if cache_dir and os.path.isdir(cache_dir):
-            persisted = any(
-                e.name.endswith("-cache") and e.stat().st_mtime >= t0 - 5
-                for e in os.scandir(cache_dir))
-            warm_hit = compile_s < 10
-            if persisted or warm_hit:
-                with open(os.path.join(
-                        cache_dir,
-                        f"headline_{getattr(model, 'stem', 'model')}_"
-                        f"{batch // max(comm.size, 1)}.ok"), "w") as mf:
-                    mf.write(f"{compile_s:.1f}\n")
-    except OSError:
-        pass
+    # (The cold/warm cache marker for _budget_plan is written by
+    # child_main after a successful rung — it, not this shared helper,
+    # knows whether the run is tiny, on TPU, and env-keyed or
+    # ladder-keyed.)
     step_flops = None
     try:
         ca = step.cost_analysis()
@@ -403,6 +414,9 @@ def child_main() -> None:
                 f"step={h['step_time_ms']}ms "
                 f"{h['img_per_sec']:.0f} img/s "
                 f"(compile {h['compile_s']}s, total {prev_wall:.0f}s)")
+            _write_warm_marker(
+                stem, rung, explicit_batch, n_chips, tiny,
+                devs[0].platform, h["compile_s"], rung_start)
         except Exception as e:  # OOM / shape limits on this rung
             full_msg = f"{type(e).__name__}: {e}"
             if any(s in full_msg for s in _RETRYABLE):
@@ -561,8 +575,44 @@ def _persist_measured(json_line: str) -> None:
         pass
 
 
+def _budget_plan(env: dict) -> tuple:
+    """(attempts, attempt_timeout_s) for the parent's retry loop.
+
+    Pinned values win. Otherwise the shape depends on the persistent
+    cache: a cold conv7 ResNet-50 compile through the axon tunnel runs
+    ~11-12 min (measured, round-5 window 1) — LONGER than the default
+    720s attempt, so on a fresh /tmp the 5x720 ladder is a guaranteed
+    double-TERM (the round-4 record's exact failure). Cold -> spend the
+    same total budget as ONE long attempt: ~12 min compile + 50 measured
+    steps fits, and the cache makes every later run (retries, the
+    driver's next invocation) fast. Warm detection: the cache's entries
+    are opaque hashes, so the child drops a headline_<stem>_<per-chip-
+    batch>.ok marker beside them after each successful compile that
+    demonstrably engaged the cache; warm = the 256 headline rung (or the
+    explicitly requested batch) is known-cached (batch 128 compiles in
+    27s either way, so the cold single attempt still lands a record
+    fast when 256 turns out broken)."""
+    attempts = int(env.get("CHAINERMN_TPU_BENCH_ATTEMPTS", "5"))
+    attempt_timeout = float(env.get("CHAINERMN_TPU_BENCH_TIMEOUT", "720"))
+    if ("CHAINERMN_TPU_BENCH_TIMEOUT" in env
+            or "CHAINERMN_TPU_BENCH_ATTEMPTS" in env):
+        return attempts, attempt_timeout
+    cache_dir = env.get(
+        "CHAINERMN_TPU_BENCH_CACHE", "/tmp/chainermn_tpu_jax_cache")
+    stem = env.get("CHAINERMN_TPU_BENCH_STEM", "conv7")
+    key_batch = int(env.get("CHAINERMN_TPU_BENCH_BATCH", "0")) or 256
+    warm = bool(cache_dir) and os.path.exists(
+        os.path.join(cache_dir, f"headline_{stem}_{key_batch}.ok"))
+    if not warm:
+        attempts = 1
+        attempt_timeout = float(
+            env.get("CHAINERMN_TPU_BENCH_TOTAL_BUDGET", "1500")) - 120.0
+        log(f"cold compilation cache: single {attempt_timeout:.0f}s "
+            "attempt instead of the retry ladder")
+    return attempts, attempt_timeout
+
+
 def parent_main() -> None:
-    attempts = int(os.environ.get("CHAINERMN_TPU_BENCH_ATTEMPTS", "5"))
     delay = float(os.environ.get("CHAINERMN_TPU_BENCH_RETRY_DELAY", "10"))
     # Backend init can HANG (tunnel down) rather than fail fast; a hung child
     # would otherwise make the whole bench silently exceed the driver's
@@ -571,38 +621,9 @@ def parent_main() -> None:
     # Defaults deliberately fit well inside the driver's window: round 3's
     # 1800s/attempt + 3600s total outlived it (rc=124, no record). A hung
     # backend that doesn't come up within ~12min per attempt won't come up
-    # at 30min either.
-    attempt_timeout = float(os.environ.get("CHAINERMN_TPU_BENCH_TIMEOUT", "720"))
-    # Cold-cache shape: a cold conv7 ResNet-50 compile through the axon
-    # tunnel runs ~11-12 min (measured, round-5 window 1) — LONGER than the
-    # default 720s attempt, so on a fresh /tmp the 5x720 ladder is a
-    # guaranteed double-TERM (the round-4 record's exact failure). When the
-    # caller pinned nothing and the persistent cache has no compiled
-    # executable yet, spend the same 1500s total budget as ONE long attempt
-    # instead: ~12 min compile + 50 measured steps fits, and the cache
-    # makes every later run (retries, the driver's next invocation) fast.
-    if ("CHAINERMN_TPU_BENCH_TIMEOUT" not in os.environ
-            and "CHAINERMN_TPU_BENCH_ATTEMPTS" not in os.environ):
-        cache_dir = os.environ.get(
-            "CHAINERMN_TPU_BENCH_CACHE", "/tmp/chainermn_tpu_jax_cache")
-        # The cache entries are opaque hashes; the child drops a
-        # headline_<stem>_<batch>.ok marker beside them after each
-        # successful compile. Warm = the 256 headline rung (or the
-        # explicitly requested batch) is known-cached, so the short-attempt
-        # ladder can reach it. Cold = its ~11-min compile (measured,
-        # round-5 window 1; batch 128 compiled in 27s) needs one long
-        # attempt instead.
-        stem = os.environ.get("CHAINERMN_TPU_BENCH_STEM", "conv7")
-        key_batch = int(os.environ.get("CHAINERMN_TPU_BENCH_BATCH", "0")) or 256
-        warm = os.path.exists(
-            os.path.join(cache_dir, f"headline_{stem}_{key_batch}.ok"))
-        if not warm:
-            attempts = 1
-            attempt_timeout = float(
-                os.environ.get("CHAINERMN_TPU_BENCH_TOTAL_BUDGET", "1500")
-            ) - 120.0
-            log(f"cold compilation cache: single {attempt_timeout:.0f}s "
-                "attempt instead of the retry ladder")
+    # at 30min either. Cold-cache runs reshape the ladder — see
+    # _budget_plan.
+    attempts, attempt_timeout = _budget_plan(dict(os.environ))
     # The child's internal sweep deadline must fire BEFORE this parent's
     # attempt timeout, or a healthy child pacing its sweep against a larger
     # default budget gets SIGTERMed mid-sweep and logged as a (phantom)

@@ -55,22 +55,26 @@ def main():
     mesh = Mesh(np.array(topo.devices).reshape(4), ("replica",))
     repl = NamedSharding(mesh, P())
 
-    # Idempotent: skip (T, block) cells already recorded ok in the jsonl so
+    # Opt-in skip of already-recorded cells (AOT_CEILING_SKIP_RECORDED=1):
     # a battery stage with a tight window spends it on the NEW cells (the
     # block-1024 runs backing the new default) instead of re-proving
-    # 128/256/512.
+    # 128/256/512. OFF by default on purpose — this script's job is
+    # re-proving the ceiling after kernel changes, and a recorded-ok cell
+    # from an OLDER kernel must not masquerade as re-validation (records
+    # carry no kernel fingerprint).
     done = set()
-    try:
-        with open(OUT) as f:
-            for line in f:
-                try:
-                    r = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if r.get("ok"):
-                    done.add((r.get("seq_len"), r.get("block")))
-    except OSError:
-        pass
+    if os.environ.get("AOT_CEILING_SKIP_RECORDED"):
+        try:
+            with open(OUT) as f:
+                for line in f:
+                    try:
+                        r = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if r.get("ok"):
+                        done.add((r.get("seq_len"), r.get("block")))
+        except OSError:
+            pass
 
     B, H, D = 1, 8, 64
     for t_len in (32768, 131072):

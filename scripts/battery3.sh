@@ -76,7 +76,7 @@ fi
 
 if wait_alive && can_fit 2000; then
   echo "$(date +%FT%T) CHIP ALIVE — aot_flash_ceiling (block 1024)" >> "$LOG"
-  ( timeout -k 120 -s TERM 2000 python scripts/aot_flash_ceiling.py >> "$LOG" 2>&1; \
+  ( AOT_CEILING_SKIP_RECORDED=1 timeout -k 120 -s TERM 2000 python scripts/aot_flash_ceiling.py >> "$LOG" 2>&1; \
     echo "$(date +%FT%T) aot_ceiling rc=$?" >> "$LOG" )
 fi
 

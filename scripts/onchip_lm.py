@@ -78,7 +78,11 @@ def main():
     if tiny:
         vocab, d_model, n_layers, n_heads = 256, 64, 2, 2
     cells = [(2048, 8, "flash"), (2048, 8, "full"),
-             (8192, 2, "flash"), (8192, 2, "full")]
+             (8192, 2, "flash"), (8192, 2, "full"),
+             # token-batch lever: 4x the tokens amortize the weight/state
+             # HBM traffic 4x (the AOT LM roofline names bytes, not MXU
+             # occupancy, as the MFU limiter at B=8)
+             (2048, 32, "flash")]
     if tiny:
         cells = [(128, 2, "full")]
 

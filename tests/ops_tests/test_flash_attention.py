@@ -174,3 +174,17 @@ def test_pick_block_contract():
     assert _pick_block(8, 4) == 4              # t <= 8: plain divisor search
     assert _pick_block(6, 512) == 6
     assert _pick_block(4, 512) == 4
+
+
+def test_default_block_is_t_dependent():
+    """The data-driven default (round-5 on-chip sweep): block 1024 inside
+    the measured regime (T <= 8192), 512 beyond it where the evidence
+    (on-chip 16k/32k cells + the 131k AOT ceiling) stands at block <= 512.
+    Pins the verified-regime cap so a future 'widen to 1024 everywhere'
+    is a deliberate test change backed by the queued ceiling run."""
+    from chainermn_tpu.ops.flash_attention import _default_block
+
+    assert _default_block(2048) == 1024
+    assert _default_block(8192) == 1024
+    assert _default_block(16384) == 512
+    assert _default_block(131072) == 512

@@ -92,3 +92,46 @@ def test_persist_measured_is_tpu_only(tmp_path, monkeypatch):
     # the scan must skip BOTH the trailing failure line and the newer
     # CPU record (same TPU-only invariant as the primary file)
     assert rec["last_measured"]["value"] == 2442.0
+
+
+def test_budget_plan_cold_vs_warm(tmp_path):
+    """Parent budget shape (round-5): pinned envs win verbatim; a cold
+    persistent cache turns the 5x720 ladder into one long attempt inside
+    the same total budget (a cold conv7/256 compile is ~11-12 min — longer
+    than a 720s attempt, the round-4 double-TERM); the child's
+    headline_<stem>_<per-chip-batch>.ok marker flips it back to warm."""
+    sys.path.insert(0, REPO)
+    from bench import _budget_plan
+
+    cache = str(tmp_path / "cache")
+    os.makedirs(cache)
+    base = {"CHAINERMN_TPU_BENCH_CACHE": cache}
+
+    # pinned envs are respected exactly, warm or cold
+    a, t = _budget_plan({**base, "CHAINERMN_TPU_BENCH_ATTEMPTS": "3",
+                         "CHAINERMN_TPU_BENCH_TIMEOUT": "600"})
+    assert (a, t) == (3, 600.0)
+    a, t = _budget_plan({**base, "CHAINERMN_TPU_BENCH_TIMEOUT": "2400"})
+    assert (a, t) == (5, 2400.0)
+
+    # cold: one long attempt, total budget minus margin
+    a, t = _budget_plan(base)
+    assert (a, t) == (1, 1380.0)
+    a, t = _budget_plan({**base, "CHAINERMN_TPU_BENCH_TOTAL_BUDGET": "2500"})
+    assert (a, t) == (1, 2380.0)
+
+    # warm marker for the 256 headline rung restores the retry ladder
+    open(os.path.join(cache, "headline_conv7_256.ok"), "w").write("27\n")
+    a, t = _budget_plan(base)
+    assert (a, t) == (5, 720.0)
+
+    # an explicitly keyed batch checks ITS marker, not 256's
+    a, t = _budget_plan({**base, "CHAINERMN_TPU_BENCH_BATCH": "512"})
+    assert (a, t) == (1, 1380.0)
+    open(os.path.join(cache, "headline_conv7_512.ok"), "w").write("30\n")
+    a, t = _budget_plan({**base, "CHAINERMN_TPU_BENCH_BATCH": "512"})
+    assert (a, t) == (5, 720.0)
+
+    # a different stem is a different program: cold again
+    a, t = _budget_plan({**base, "CHAINERMN_TPU_BENCH_STEM": "space_to_depth"})
+    assert (a, t) == (1, 1380.0)
