@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 battery 4: service-side AOT analyses, after battery3 completes.
+#   1. aot_ring_overlap.py — re-verify the overlap schedule now that the
+#      default flash block at typical shard sizes moved 512 -> 1024.
+#   2. aot_lm_roofline.py — bytes/FLOPs breakdown of the onchip_lm cells
+#      (the 13.9%-LM-MFU diagnosis) incl. the B=32 token-batch probe.
+# These hold no device lease but ride the same axon remote-compile helper
+# that wedges with it — probe-gated and TERM/KILL-capped like the others.
+set -u
+cd /root/repo
+LOG=scripts/battery4.log
+START=$(date +%s)
+BATTERY_DEADLINE=${BATTERY4_DEADLINE:-21600}
+echo "$(date +%FT%T) battery4 start (deadline ${BATTERY_DEADLINE}s)" >> "$LOG"
+
+while ! grep -q "battery3 done" scripts/battery3.log 2>/dev/null; do
+  if [ $(( $(date +%s) - START )) -gt "$BATTERY_DEADLINE" ]; then
+    echo "$(date +%FT%T) battery4 deadline passed waiting for battery3" >> "$LOG"
+    exit 0
+  fi
+  sleep 120
+done
+echo "$(date +%FT%T) battery3 done observed" >> "$LOG"
+
+probe() {
+  timeout -k 30 -s TERM 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >/dev/null 2>&1
+}
+
+can_fit() {
+  [ $(( BATTERY_DEADLINE - ( $(date +%s) - START ) )) -ge "$1" ]
+}
+
+wait_alive() {
+  while true; do
+    if [ $(( $(date +%s) - START )) -gt "$BATTERY_DEADLINE" ]; then
+      echo "$(date +%FT%T) battery4 deadline passed" >> "$LOG"
+      return 1
+    fi
+    if probe; then return 0; fi
+    echo "$(date +%FT%T) probe wedged" >> "$LOG"
+    sleep 240
+  done
+}
+
+if wait_alive && can_fit 2400; then
+  echo "$(date +%FT%T) SERVICE ALIVE — aot_ring_overlap (block-1024 defaults)" >> "$LOG"
+  ( timeout -k 120 -s TERM 2400 python scripts/aot_ring_overlap.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) ring_overlap rc=$?" >> "$LOG" )
+fi
+
+if wait_alive && can_fit 2400; then
+  echo "$(date +%FT%T) SERVICE ALIVE — aot_lm_roofline" >> "$LOG"
+  ( timeout -k 120 -s TERM 2400 python scripts/aot_lm_roofline.py >> "$LOG" 2>&1; \
+    echo "$(date +%FT%T) lm_roofline rc=$?" >> "$LOG" )
+fi
+echo "$(date +%FT%T) battery4 done" >> "$LOG"
