@@ -13,14 +13,18 @@ START=$(date +%s)
 BATTERY_DEADLINE=${BATTERY4_DEADLINE:-21600}
 echo "$(date +%FT%T) battery4 start (deadline ${BATTERY_DEADLINE}s)" >> "$LOG"
 
-while ! grep -q "battery3 done" scripts/battery3.log 2>/dev/null; do
+# Wait on the battery3 PROCESS, not its log marker: the append-only log
+# keeps 'done' lines from earlier runs (stale-marker race), and battery3
+# has exit paths that never write one (deadline while waiting on
+# battery2, external kill). Process-gone covers every case.
+while pgrep -f "bash scripts/battery3.sh" >/dev/null 2>&1; do
   if [ $(( $(date +%s) - START )) -gt "$BATTERY_DEADLINE" ]; then
     echo "$(date +%FT%T) battery4 deadline passed waiting for battery3" >> "$LOG"
     exit 0
   fi
   sleep 120
 done
-echo "$(date +%FT%T) battery3 done observed" >> "$LOG"
+echo "$(date +%FT%T) battery3 gone; proceeding" >> "$LOG"
 
 probe() {
   timeout -k 30 -s TERM 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >/dev/null 2>&1

@@ -135,3 +135,43 @@ def test_budget_plan_cold_vs_warm(tmp_path):
     # a different stem is a different program: cold again
     a, t = _budget_plan({**base, "CHAINERMN_TPU_BENCH_STEM": "space_to_depth"})
     assert (a, t) == (1, 1380.0)
+
+
+def test_warm_marker_guards(tmp_path, monkeypatch):
+    """The warm marker must never be written by tiny or non-TPU runs (a
+    CPU smoke poisoning warm detection recreates the round-4 double-TERM)
+    and must key the way _budget_plan looks it up: raw env value for an
+    explicit batch, per-chip rung otherwise."""
+    sys.path.insert(0, REPO)
+    from bench import _write_warm_marker
+
+    cache = str(tmp_path / "cache")
+    os.makedirs(cache)
+    monkeypatch.setenv("CHAINERMN_TPU_BENCH_CACHE", cache)
+    stamp = str(tmp_path / "cache" / "x-cache")
+    open(stamp, "w").write("entry")  # fresh persisted entry
+
+    import time as _t
+    now = _t.time()
+    # tiny and cpu runs: no marker, even with a fresh cache entry
+    _write_warm_marker("conv7", 256, 0, 1, True, "tpu", 5.0, now - 60)
+    _write_warm_marker("conv7", 256, 0, 1, False, "cpu", 5.0, now - 60)
+    assert not [f for f in os.listdir(cache) if f.startswith("headline")]
+
+    # real run, default ladder rung on 4 chips: per-chip key
+    _write_warm_marker("conv7", 1024, 0, 4, False, "tpu", 700.0, now - 60)
+    assert os.path.exists(os.path.join(cache, "headline_conv7_256.ok"))
+
+    # explicit batch: env-value key, regardless of chip count
+    _write_warm_marker("conv7", 512, 512, 4, False, "tpu", 700.0, now - 60)
+    assert os.path.exists(os.path.join(cache, "headline_conv7_512.ok"))
+
+    # long compile with NO fresh cache entry: serialization was skipped,
+    # the next run is still cold -> no marker
+    os.unlink(stamp)
+    _write_warm_marker("s2d", 256, 0, 1, False, "tpu", 700.0, _t.time())
+    assert not os.path.exists(os.path.join(cache, "headline_s2d_256.ok"))
+
+    # ...but a warm hit (<10s) needs no new entry
+    _write_warm_marker("s2d", 256, 0, 1, False, "tpu", 3.0, _t.time())
+    assert os.path.exists(os.path.join(cache, "headline_s2d_256.ok"))
