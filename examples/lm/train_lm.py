@@ -120,6 +120,7 @@ def run_gspmd(args, comm) -> None:
         attention=args.attention,  # 'full' or 'flash' (guarded in main)
         moe_experts=args.moe_experts, moe_impl="gshard",
         moe_top_k=args.moe_top_k,
+        remat=args.remat,
         compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
         else jnp.float32,
     )
@@ -212,6 +213,11 @@ def main() -> None:
                              "automatically)")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="expert-parallel MoE FFN every 2nd block")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize block forwards in the backward "
+                             "(jax.checkpoint): ~1/3 more forward FLOPs for "
+                             "O(n_layers*B*T*d) less activation HBM — the "
+                             "lever for long context / large token batches")
     parser.add_argument("--moe-top-k", type=int, default=1, choices=[1, 2],
                         help="1 = Switch routing, 2 = GShard top-2")
     parser.add_argument("--tensor-parallel", action="store_true",
@@ -249,6 +255,12 @@ def main() -> None:
         raise SystemExit("--pipeline uses the whole mesh axis for stages; "
                          "it does not combine with the other parallel "
                          "flags in this example")
+    if args.pipeline and args.remat:
+        raise SystemExit("--pipeline builds its blocks via make_pipeline_lm, "
+                         "which does not thread --remat; the flag would be "
+                         "silently ignored (pipeline microbatching already "
+                         "bounds live activations to one microbatch per "
+                         "stage)")
     if args.gspmd and (args.seq_parallel or args.tensor_parallel
                        or args.pipeline):
         raise SystemExit("--gspmd is its own layout (plain jit, partitioner "
@@ -294,6 +306,7 @@ def main() -> None:
         moe_top_k=args.moe_top_k,
         tensor_axis=comm.axis_name if args.tensor_parallel else None,
         vocab_parallel_head=args.vocab_parallel_head,
+        remat=args.remat,
         compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
         else jnp.float32,
     )

@@ -32,13 +32,16 @@ OUT = os.path.join(_HERE, "lm_roofline_aot.jsonl")
 PEAK_FLOPS = 197e12   # v5e bf16
 HBM_GBPS = 819e9
 
-# (seq_len, batch, attention) — the onchip_lm cells plus a B=32 T=2048
-# probe (token-batch lever: 4x the tokens amortize weight traffic 4x)
+# (seq_len, batch, attention, remat) — the onchip_lm cells plus the B=32
+# T=2048 probe (token-batch lever: 4x the tokens amortize weight traffic
+# 4x). The B=32 twin carries remat=True to compile the SAME program
+# onchip_lm measures (stored activations without it are ~18 GB on a
+# 16 GB chip).
 CELLS = [
-    (2048, 8, "flash"),
-    (2048, 8, "full"),
-    (8192, 2, "flash"),
-    (2048, 32, "flash"),
+    (2048, 8, "flash", False),
+    (2048, 8, "full", False),
+    (8192, 2, "flash", False),
+    (2048, 32, "flash", True),
 ]
 
 
@@ -75,15 +78,16 @@ def main():
     comm = chainermn_tpu.create_communicator("tpu", mesh=mesh)
     opt = chainermn_tpu.create_multi_node_optimizer(optax.adamw(3e-4), comm)
 
-    for t_len, batch, attn in CELLS:
+    for t_len, batch, attn, use_remat in CELLS:
         rec = {"cell": [t_len, batch, attn], "seq_len": t_len,
-               "batch": batch, "attention": attn}
+               "batch": batch, "attention": attn, "remat": use_remat}
         t0 = time.time()
         try:
             model = TransformerLM(
                 vocab_size=vocab, d_model=d_model, n_heads=n_heads,
                 n_layers=n_layers, max_len=max(t_len, 2048),
-                attention=attn, compute_dtype=jnp.bfloat16)
+                attention=attn, compute_dtype=jnp.bfloat16,
+                remat=use_remat)
             step = jit_lm_train_step(model, opt, comm, donate=False)
 
             var_shapes = jax.eval_shape(

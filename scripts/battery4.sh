@@ -16,8 +16,11 @@ echo "$(date +%FT%T) battery4 start (deadline ${BATTERY_DEADLINE}s)" >> "$LOG"
 # Wait on the battery3 PROCESS, not its log marker: the append-only log
 # keeps 'done' lines from earlier runs (stale-marker race), and battery3
 # has exit paths that never write one (deadline while waiting on
-# battery2, external kill). Process-gone covers every case.
-while pgrep -f "bash scripts/battery3.sh" >/dev/null 2>&1; do
+# battery2, external kill). Process-gone covers every case. Launcher
+# contract: start battery4 only while battery3 is already running — the
+# first pgrep must see it or the gate opens immediately. The pattern
+# matches any invocation spelling of the script name.
+while pgrep -f "battery3.sh" >/dev/null 2>&1; do
   if [ $(( $(date +%s) - START )) -gt "$BATTERY_DEADLINE" ]; then
     echo "$(date +%FT%T) battery4 deadline passed waiting for battery3" >> "$LOG"
     exit 0

@@ -36,10 +36,17 @@ OUT = os.path.join(_HERE, "onchip_lm.jsonl")
 from bench import _chip_peak, enable_compilation_cache
 
 
+_PERSIST = [False]  # set true after the platform check confirms a real TPU
+
+
 def emit(rec):
+    """Real-chip records append to the evidence jsonl; CPU/tiny smoke runs
+    print only (the file is committed TPU evidence — same policy as
+    bench._persist_measured)."""
     rec["t"] = round(time.time(), 1)
-    with open(OUT, "a") as f:
-        f.write(json.dumps(rec) + "\n")
+    if _PERSIST[0]:
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
     print(json.dumps(rec), flush=True)
 
 
@@ -64,6 +71,7 @@ def main():
         return
     kind = devs[0].device_kind
     peak = _chip_peak(kind)
+    _PERSIST[0] = devs[0].platform == "tpu" and not tiny_env
     emit({"test": "platform", "device_kind": kind, "peak_flops": peak})
 
     import chainermn_tpu
@@ -81,8 +89,9 @@ def main():
              (8192, 2, "flash"), (8192, 2, "full"),
              # token-batch lever: 4x the tokens amortize the weight/state
              # HBM traffic 4x (the AOT LM roofline names bytes, not MXU
-             # occupancy, as the MFU limiter at B=8)
-             (2048, 32, "flash")]
+             # occupancy, as the MFU limiter at B=8). Needs remat: stored
+             # activations at B=32 are ~18 GB on a 16 GB chip without it.
+             (2048, 32, "flash+remat")]
     if tiny:
         cells = [(128, 2, "full")]
 
@@ -116,15 +125,18 @@ def main():
             emit({"cell": [t_len, batch, attn], "skipped": "budget",
                   "remaining_s": round(remaining, 1), "need_s": need})
             continue
+        use_remat = attn.endswith("+remat")
+        attn_kind = attn.removesuffix("+remat")
         rec = {"cell": [t_len, batch, attn], "seq_len": t_len,
-               "batch": batch, "attention": attn,
+               "batch": batch, "attention": attn_kind, "remat": use_remat,
                "d_model": d_model, "n_layers": n_layers, "vocab": vocab}
         t_start = time.time()
         try:
             model = TransformerLM(
                 vocab_size=vocab, d_model=d_model, n_heads=n_heads,
                 n_layers=n_layers, max_len=max(t_len, 2048),
-                attention=attn, compute_dtype=jnp.bfloat16)
+                attention=attn_kind, compute_dtype=jnp.bfloat16,
+                remat=use_remat)
             tokens = jax.random.randint(rng, (batch, t_len), 0, vocab)
             # real next-token objective (same key would make targets ==
             # tokens: a trivial copy task whose loss collapses)

@@ -226,3 +226,49 @@ def test_moe_lm_rejects_wrong_axis(comm):
     opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(1e-2), comm)
     with pytest.raises(ValueError, match="moe_axis"):
         jit_lm_train_step(model, opt, comm)
+
+
+def test_remat_matches_nonremat():
+    """remat=True is a memory/FLOPs trade, not a numerics change: values
+    AND gradients must match the plain model exactly (same params — remat
+    only re-runs the identical forward inside the backward)."""
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 64), 0, 64)
+    plain = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                          max_len=256, compute_dtype=jnp.float32)
+    rem = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                        max_len=256, compute_dtype=jnp.float32, remat=True)
+    params = plain.init(jax.random.PRNGKey(1), tokens)
+
+    np.testing.assert_array_equal(
+        np.asarray(plain.apply(params, tokens)),
+        np.asarray(rem.apply(params, tokens)))
+
+    def loss(model, p):
+        lg = model.apply(p, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg, tokens).mean()
+
+    g_plain = jax.grad(lambda p: loss(plain, p))(params)
+    g_rem = jax.grad(lambda p: loss(rem, p))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_plain),
+                    jax.tree_util.tree_leaves(g_rem)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_remat_train_step(comm):
+    """remat threads through the canonical jitted DP train step."""
+    from chainermn_tpu.training import jit_lm_train_step
+
+    lm = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                       max_len=256, compute_dtype=jnp.float32, remat=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 64)
+    params = comm.bcast_data(lm.init(jax.random.PRNGKey(3), tokens[:1]))
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(3e-3), comm)
+    opt_state = jax.device_put(opt.init(params), comm.named_sharding())
+    step = jit_lm_train_step(lm, opt, comm)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss, _ = step(params, opt_state, tokens, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
