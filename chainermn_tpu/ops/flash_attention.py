@@ -14,9 +14,12 @@ them); this kernel is the TPU-native hot-op for the long-context extension
   ``k_offset`` arrive as SMEM scalars so sequence-sharded callers (ring
   attention shards, ``pos_offset`` in the LM) can pass traced offsets;
 - fully-masked (future) chunks skip their COMPUTE via ``pl.when`` — the
-  standard ~2x causal FLOP saving — but their K/V block DMAs still
-  stream; skipping the traffic too is the ring layer's job (its
-  block-level masking decides which whole blocks to visit);
+  standard ~2x causal FLOP saving — and, when the offsets are static
+  (the plain ``flash_attention`` LM path), their DMAs too: the
+  streaming-side index maps clamp masked chunks to the previous chunk's
+  block index, which Mosaic's pipeline elides (see ``_static_delta``).
+  Ring shards pass traced offsets, where the ring layer's block-level
+  masking decides which whole blocks to visit instead;
 - backward is the standard two-kernel flash backward: ``dq`` gridded over
   q-blocks and ``(dk, dv)`` gridded over k-blocks, both recomputing scores
   from the saved row logsumexp (``lse``) instead of storing P;
@@ -145,6 +148,24 @@ def _fold_args(b, h, d, *xs):
                  for x in xs)
 
 
+def _static_delta(causal, q_offset, k_offset):
+    """``q_offset - k_offset`` when both offsets are static Python ints and
+    the call is causal, else None. A static delta lets the kernels CLAMP
+    their streaming-side index maps so fully-masked chunks alias the
+    previous chunk's block index — Mosaic's pipeline emitter skips the
+    copy when consecutive grid steps map to the same block, so the ~2x
+    causal FLOP saving (pl.when compute skip) gains the matching ~2x DMA
+    saving. This matters more than it sounds: the reduction-chunk grids
+    re-stream K/V once per q-block (and q/do once per k-block in the dkv
+    kernel), so attention bytes, not attention FLOPs, are the LM step's
+    roofline term (scripts/lm_roofline_aot.jsonl: ~1% of FLOPs, over half
+    the bytes). Traced offsets (ring shards) return None — the ring layer
+    already skips wholly-invisible blocks at the block level."""
+    if causal and isinstance(q_offset, int) and isinstance(k_offset, int):
+        return q_offset - k_offset
+    return None
+
+
 # --------------------------------------------------------------------------- #
 # Forward                                                                     #
 # --------------------------------------------------------------------------- #
@@ -229,8 +250,36 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
+def _kv_clamped_map(delta, block_q, block_k, n_k):
+    """Streaming-side index map for grids ``(bh, q-block i, k-chunk j)``:
+    chunks past q-block i's last visible chunk alias that chunk (same
+    block index -> the pipeline skips the copy). The kernel's pl.when
+    skips their compute by the true j, so values are unchanged.
+    ``delta=None`` (traced offsets / non-causal) -> plain streaming map."""
+    if delta is None:
+        return lambda b, i, j: (b, j, 0)
+
+    def kv_map(b, i, j):
+        vis = (delta + (i + 1) * block_q - 1) // block_k
+        return (b, jnp.clip(jnp.minimum(j, vis), 0, n_k - 1), 0)
+    return kv_map
+
+
+def _q_clamped_map(delta, block_q, block_k, n_q):
+    """Streaming-side index map for grids ``(bh, k-block j, q-chunk i)``:
+    q-chunks wholly before k-block j's first visible chunk alias it.
+    ``delta=None`` -> plain streaming map."""
+    if delta is None:
+        return lambda b, j, i: (b, i, 0)
+
+    def q_map(b, j, i):
+        first = (j * block_k - delta) // block_q
+        return (b, jnp.clip(jnp.maximum(i, first), 0, n_q - 1), 0)
+    return q_map
+
+
 def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
-         interpret, out_dtype=None):
+         interpret, out_dtype=None, static_delta=None):
     bh, tq, d = q.shape
     tk = k.shape[1]
     n_k = tk // block_k
@@ -240,6 +289,7 @@ def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
     smem = _smem_spec()
+    kv_map = _kv_clamped_map(static_delta, block_q, block_k, n_k)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           n_k=n_k),
@@ -248,8 +298,8 @@ def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
             smem,
             smem,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -404,7 +454,7 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
-             block_k, interpret, grad_dtype=None):
+             block_k, interpret, grad_dtype=None, static_delta=None):
     """dq for one (q-range x k-range) pair, folded ``[B*H, T, D]`` layout —
     shared by the full backward and the ring backward's per-block calls
     (which pass ``grad_dtype=f32`` to accumulate across blocks losslessly)."""
@@ -415,6 +465,7 @@ def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANE))
     smem = _smem_spec()
     n_k = tk // block_k
+    kv_map = _kv_clamped_map(static_delta, block_q, block_k, n_k)
     return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           n_k=n_k),
@@ -422,8 +473,8 @@ def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
         in_specs=[
             smem, smem,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
@@ -439,7 +490,7 @@ def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
 
 
 def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
-              block_k, interpret, grad_dtype=None):
+              block_k, interpret, grad_dtype=None, static_delta=None):
     """(dk, dv) for one (q-range x k-range) pair, folded layout — see
     :func:`_dq_call`."""
     bh, tq, d = q.shape
@@ -448,6 +499,7 @@ def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANE))
     smem = _smem_spec()
     n_q = tq // block_q
+    q_map = _q_clamped_map(static_delta, block_q, block_k, n_q)
     return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           n_q=n_q),
@@ -456,12 +508,12 @@ def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
         grid=(bh, tk // block_k, n_q),
         in_specs=[
             smem, smem,
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q, _LANE), q_map),
+            pl.BlockSpec((1, block_q, _LANE), q_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -484,14 +536,14 @@ def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
     )(qo2, ko2, q, k, v, do, lse, delta)
 
 
-def _bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _bwd(scale, causal, block_q, block_k, interpret, static_delta, res, g):
     q, k, v, out, lse, qo, ko = res
     do, _ = g  # cotangent of (out, lse); lse cotangent unused
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     qo2 = jnp.asarray(qo, jnp.int32).reshape(1, 1)
     ko2 = jnp.asarray(ko, jnp.int32).reshape(1, 1)
     kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-              interpret=interpret)
+              interpret=interpret, static_delta=static_delta)
     dq = _dq_call(q, k, v, do, lse, delta, qo2, ko2, **kw)
     dk, dv = _dkv_call(q, k, v, do, lse, delta, qo2, ko2, **kw)
     return dq, dk, dv, None, None
@@ -501,24 +553,27 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
 # Public entry                                                                #
 # --------------------------------------------------------------------------- #
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, q_offset, k_offset, scale, causal, block_q, block_k,
-           interpret):
+           interpret, static_delta):
     out, _ = _fwd(q, k, v, q_offset, k_offset, scale=scale, causal=causal,
-                  block_q=block_q, block_k=block_k, interpret=interpret)
+                  block_q=block_q, block_k=block_k, interpret=interpret,
+                  static_delta=static_delta)
     return out
 
 
 def _flash_fwd(q, k, v, q_offset, k_offset, scale, causal, block_q, block_k,
-               interpret):
+               interpret, static_delta):
     out, lse = _fwd(q, k, v, q_offset, k_offset, scale=scale, causal=causal,
-                    block_q=block_q, block_k=block_k, interpret=interpret)
+                    block_q=block_q, block_k=block_k, interpret=interpret,
+                    static_delta=static_delta)
     return out, (q, k, v, out, lse, q_offset, k_offset)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, static_delta,
+               res, g):
     dq, dk, dv, _, _ = _bwd(scale, causal, block_q, block_k, interpret,
-                            res, (g, None))
+                            static_delta, res, (g, None))
     return dq, dk, dv, None, None
 
 
@@ -575,7 +630,8 @@ def flash_attention(
     out = _flash(qf, kf, vf,
                  jnp.asarray(q_offset, jnp.int32),
                  jnp.asarray(k_offset, jnp.int32),
-                 float(scale), bool(causal), bq, bk, bool(interpret))
+                 float(scale), bool(causal), bq, bk, bool(interpret),
+                 _static_delta(causal, q_offset, k_offset))
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
 
 
@@ -611,9 +667,10 @@ def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
     (f32; fully-masked rows hold the -1e30 sentinel, which the lse-weighted
     merge turns into a zero contribution). Causal masking uses global
     positions via the (possibly traced) offsets; fully-masked chunks skip
-    their compute (pl.when) but still pay their K/V DMA — ring callers
-    that KNOW a whole block is invisible should skip the call, not lean
-    on the kernel."""
+    their compute (pl.when), and with STATIC int offsets their DMA too
+    (clamped index maps, see _static_delta). Traced-offset callers still
+    pay the masked chunks' DMA — ring callers that KNOW a whole block is
+    invisible should skip the call, not lean on the kernel."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if scale is None:
@@ -629,7 +686,8 @@ def flash_fwd_with_lse(q, k, v, *, causal=False, scale=None, q_offset=0,
                     jnp.asarray(k_offset, jnp.int32),
                     scale=float(scale), causal=bool(causal), block_q=bq,
                     block_k=bk, interpret=bool(interpret),
-                    out_dtype=out_dtype)
+                    out_dtype=out_dtype,
+                    static_delta=_static_delta(causal, q_offset, k_offset))
     return (out.reshape(b, h, tq, d).transpose(0, 2, 1, 3),
             lse.reshape(b, h, tq))
 
@@ -659,7 +717,8 @@ def flash_block_grads(q, k, v, do, lse, delta, *, causal=False, scale=None,
     qo2 = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko2 = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
     kw = dict(scale=float(scale), causal=bool(causal), block_q=bq,
-              block_k=bk, interpret=bool(interpret), grad_dtype=grad_dtype)
+              block_k=bk, interpret=bool(interpret), grad_dtype=grad_dtype,
+              static_delta=_static_delta(causal, q_offset, k_offset))
     dq = _dq_call(qf, kf, vf, dof, lsef, deltaf, qo2, ko2, **kw)
     dk, dv = _dkv_call(qf, kf, vf, dof, lsef, deltaf, qo2, ko2, **kw)
     unfold = lambda x: x.reshape(b, h, x.shape[1], d).transpose(0, 2, 1, 3)

@@ -32,17 +32,20 @@ OUT = os.path.join(_HERE, "lm_roofline_aot.jsonl")
 PEAK_FLOPS = 197e12   # v5e bf16
 HBM_GBPS = 819e9
 
-# (seq_len, batch, attention, remat) — the onchip_lm cells plus the B=32
-# T=2048 probe (token-batch lever: 4x the tokens amortize weight traffic
-# 4x). The B=32 twin carries remat=True to compile the SAME program
-# onchip_lm measures (stored activations without it are ~18 GB on a
-# 16 GB chip).
+# (seq_len, batch, attention, remat) — the onchip_lm cells plus the B=16
+# T=2048 remat probe (token-batch lever; matches onchip_lm's cell: the
+# measured answers were ceiling 52% at B=8, 79% at B=16+remat/12.7 GB,
+# 98.6% at B=32+remat but 18.8 GB peak = OOM, full attention at B=8
+# 27.3 GB = cannot compile at all).
 CELLS = [
     (2048, 8, "flash", False),
     (2048, 8, "full", False),
     (8192, 2, "flash", False),
-    (2048, 32, "flash", True),
+    (2048, 16, "flash", True),
 ]
+# Override, e.g. LM_ROOFLINE_CELLS='[[2048,16,"flash",true]]'
+if os.environ.get("LM_ROOFLINE_CELLS"):
+    CELLS = [tuple(c) for c in json.loads(os.environ["LM_ROOFLINE_CELLS"])]
 
 
 def emit(rec):

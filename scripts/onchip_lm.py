@@ -88,10 +88,12 @@ def main():
     cells = [(2048, 8, "flash"), (2048, 8, "full"),
              (8192, 2, "flash"), (8192, 2, "full"),
              # token-batch lever: 4x the tokens amortize the weight/state
-             # HBM traffic 4x (the AOT LM roofline names bytes, not MXU
-             # occupancy, as the MFU limiter at B=8). Needs remat: stored
-             # activations at B=32 are ~18 GB on a 16 GB chip without it.
-             (2048, 32, "flash+remat")]
+             # HBM traffic (the AOT LM roofline names bytes, not MXU
+             # occupancy, as the MFU limiter at B=8; ceiling 52% -> 79%
+             # at B=16+remat, lm_roofline_aot.jsonl). B=16 is the biggest
+             # feasible cell: B=32 peaks at 18.8 GB even WITH remat (the
+             # f32 logits pair alone is ~17 GB); B=16+remat fits at 12.7.
+             (2048, 16, "flash+remat")]
     if tiny:
         cells = [(128, 2, "full")]
 
