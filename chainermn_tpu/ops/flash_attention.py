@@ -50,6 +50,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -79,9 +80,10 @@ def _pick_block(t: int, preferred: int = 1024) -> int:
     Per-cell fixed work (mask iota, scratch flush, grid bookkeeping)
     amortizes over more MXU work, and VMEM per cell stays O(block) —
     ~3 MB at block 1024, d=64, far under the ~128 MB budget. The
-    T = 131072 single-call ceiling is AOT-verified at blocks 128/256/512
-    (scripts/aot_flash_ceiling.jsonl); the block-1024 ceiling run is
-    queued (scripts/battery3.sh) — on-chip 1024 coverage is T <= 8192.
+    T = 131072 single-call ceiling is AOT-verified at every block in
+    {128, 256, 512, 1024} with the post-round-5 kernels (clamped causal
+    maps, storage-dtype MXU inputs): 3.25 GB peak at each
+    (scripts/aot_flash_ceiling.jsonl).
 
     Blocks respect the 8-row sublane granularity (Mosaic's (8, 128)
     tiling rule): candidates step down in multiples of 8, and a length
@@ -107,14 +109,15 @@ def _interpret_default() -> bool:
 
 
 def _default_block(t: int) -> int:
-    """Default preferred block for sequence length ``t``: 1024 inside the
-    measured regime (on-chip sweep coverage is T <= 8192, where 1024 is
-    1.6x faster than 512 — see :func:`_pick_block`), 512 beyond it, where
-    the evidence stands at block <= 512 (on-chip long-context cells at
-    T = 16k/32k and the T = 131072 AOT ceiling,
-    scripts/aot_flash_ceiling.jsonl). Widen to 1024 everywhere once the
-    queued block-1024 ceiling + long-T runs land (scripts/battery3.sh)."""
-    return 1024 if t <= 8192 else 512
+    """Default preferred block: 1024 at every length. On-chip sweep
+    coverage (T <= 8192) shows 1024 is 1.6x faster than 512 and the gain
+    GROWS with T (the mechanism — fewer K/V re-streams per q-block —
+    scales with n_blocks); the T = 131072 fwd+bwd ceiling is AOT-verified
+    at block 1024 with the clamped causal maps active (3.25 GB peak,
+    scripts/aot_flash_ceiling.jsonl), so long-T compilability is proven,
+    not assumed. Kept as a function: the tuning boundary lives in one
+    place if on-chip long-T data ever disagrees."""
+    return 1024
 
 
 def _out_vma(*xs) -> frozenset:
@@ -161,8 +164,9 @@ def _static_delta(causal, q_offset, k_offset):
     roofline term (scripts/lm_roofline_aot.jsonl: ~1% of FLOPs, over half
     the bytes). Traced offsets (ring shards) return None — the ring layer
     already skips wholly-invisible blocks at the block level."""
-    if causal and isinstance(q_offset, int) and isinstance(k_offset, int):
-        return q_offset - k_offset
+    if (causal and isinstance(q_offset, (int, np.integer))
+            and isinstance(k_offset, (int, np.integer))):
+        return int(q_offset) - int(k_offset)
     return None
 
 
@@ -615,8 +619,8 @@ def flash_attention(
         from chainermn_tpu.parallel.sequence import full_attention
 
         static_zero_offsets = (
-            isinstance(q_offset, int) and q_offset == 0
-            and isinstance(k_offset, int) and k_offset == 0
+            isinstance(q_offset, (int, np.integer)) and q_offset == 0
+            and isinstance(k_offset, (int, np.integer)) and k_offset == 0
         )
         if not causal or (static_zero_offsets and tq == tk):
             return full_attention(q, k, v, causal=causal, scale=scale)

@@ -176,15 +176,14 @@ def test_pick_block_contract():
     assert _pick_block(4, 512) == 4
 
 
-def test_default_block_is_t_dependent():
-    """The data-driven default (round-5 on-chip sweep): block 1024 inside
-    the measured regime (T <= 8192), 512 beyond it where the evidence
-    (on-chip 16k/32k cells + the 131k AOT ceiling) stands at block <= 512.
-    Pins the verified-regime cap so a future 'widen to 1024 everywhere'
-    is a deliberate test change backed by the queued ceiling run."""
+def test_default_block():
+    """The data-driven default (round-5 on-chip sweep + the block-1024
+    T=131072 AOT ceiling proof): 1024 at every length. This widening was
+    the deliberate test change the previous revision's comment promised,
+    backed by the landed ceiling run (aot_flash_ceiling.jsonl)."""
     from chainermn_tpu.ops.flash_attention import _default_block
 
     assert _default_block(2048) == 1024
     assert _default_block(8192) == 1024
-    assert _default_block(16384) == 512
-    assert _default_block(131072) == 512
+    assert _default_block(16384) == 1024
+    assert _default_block(131072) == 1024
