@@ -206,7 +206,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, return_aux: bool = False,
-                 kv_caches=None):
+                 kv_caches=None, return_hidden: bool = False):
         if self.tensor_axis is not None and self.moe_experts:
             raise ValueError(
                 "tensor_axis and moe_experts are mutually exclusive: the MoE "
@@ -269,6 +269,22 @@ class TransformerLM(nn.Module):
             x, aux = out if is_moe else (out, 0.0)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        if return_hidden:
+            # pre-head hidden states for a fused/chunked head+loss (see
+            # ops.losses.chunked_softmax_cross_entropy): the [B, T, vocab]
+            # f32 logits are the train step's largest tensor pair and this
+            # path never builds them
+            if self.vocab_parallel_head:
+                raise ValueError(
+                    "return_hidden composes with the replicated lm_head "
+                    "(the fused CE applies it itself); the vocab-parallel "
+                    "head already avoids full logits — use "
+                    "vocab_parallel_cross_entropy instead"
+                )
+            if kv_caches is not None:
+                raise ValueError("return_hidden is a training-loss path; "
+                                 "decode wants logits")
+            return (x, aux_total) if return_aux else x
         if self.vocab_parallel_head:
             from chainermn_tpu.parallel.tensor import ColumnParallelDense
 

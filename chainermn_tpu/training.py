@@ -288,6 +288,7 @@ def jit_lm_train_step(
     shard_sequence: bool = False,
     donate: bool = True,
     moe_aux_weight: float = 0.01,
+    fused_ce: bool = False,
 ) -> Callable:
     """Jitted next-token-prediction step for :class:`TransformerLM`-shaped
     models. Call as ``step(params, opt_state, tokens, targets)`` ->
@@ -315,6 +316,13 @@ def jit_lm_train_step(
     seq_axis = getattr(model, "sequence_axis", None)
     moe_experts = getattr(model, "moe_experts", 0)
     tensor_axis = getattr(model, "tensor_axis", None)
+    if fused_ce and (tensor_axis is not None
+                     or getattr(model, "vocab_parallel_head", False)):
+        raise ValueError(
+            "fused_ce applies the replicated lm_head itself; the TP/"
+            "vocab-parallel paths shard the head and already avoid full "
+            "logits (vocab_parallel_cross_entropy)"
+        )
     if tensor_axis is not None:
         return _jit_tp_lm_train_step(
             model, optimizer, comm, tensor_axis,
@@ -354,16 +362,34 @@ def jit_lm_train_step(
         )
 
         def loss_fn(p):
+            # return_hidden is passed ONLY when fused_ce asks for it: the
+            # step's contract covers any TransformerLM-SHAPED model, and a
+            # user model without the kwarg must keep working un-fused
+            extra = {"return_hidden": True} if fused_ce else {}
             if moe_experts:
-                (logits, aux), sown = model.apply(
+                (out, aux), sown = model.apply(
                     p, tokens, pos_offset, return_aux=True,
-                    mutable=["moe_stats"],
+                    mutable=["moe_stats"], **extra,
                 )
             else:
-                logits, aux, sown = model.apply(p, tokens, pos_offset), 0.0, {}
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets
-            ).mean()
+                out, aux, sown = model.apply(
+                    p, tokens, pos_offset, **extra), 0.0, {}
+            if fused_ce:
+                # fused head+loss: the [B, T, vocab] f32 logits pair is the
+                # step's largest tensor (scripts/lm_roofline_aot.jsonl) —
+                # the chunked CE never builds it (ops/losses.py)
+                from chainermn_tpu.ops.losses import (
+                    chunked_softmax_cross_entropy,
+                )
+
+                head = p["params"]["lm_head"]
+                ce = chunked_softmax_cross_entropy(
+                    out, head["kernel"], head.get("bias"), targets
+                ).mean()
+            else:
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    out, targets
+                ).mean()
             return ce + moe_aux_weight * aux, sown
 
         (loss, sown), grads = jax.value_and_grad(

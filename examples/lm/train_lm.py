@@ -213,6 +213,10 @@ def main() -> None:
                              "automatically)")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="expert-parallel MoE FFN every 2nd block")
+    parser.add_argument("--fused-ce", action="store_true",
+                        help="fused chunked head+loss: never materializes "
+                             "the [B,T,vocab] f32 logits (the step's "
+                             "largest tensor pair; ops/losses.py)")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize block forwards in the backward "
                              "(jax.checkpoint): ~1/3 more forward FLOPs for "
@@ -261,6 +265,13 @@ def main() -> None:
                          "silently ignored (pipeline microbatching already "
                          "bounds live activations to one microbatch per "
                          "stage)")
+    if args.fused_ce and (args.pipeline or args.gspmd
+                          or args.tensor_parallel):
+        raise SystemExit("--fused-ce is the plain/sequence-parallel step's "
+                         "fused head+loss; the pipeline/gspmd/TP paths "
+                         "build their own steps and would silently ignore "
+                         "it (TP's vocab-parallel head already avoids full "
+                         "logits)")
     if args.gspmd and (args.seq_parallel or args.tensor_parallel
                        or args.pipeline):
         raise SystemExit("--gspmd is its own layout (plain jit, partitioner "
@@ -376,7 +387,8 @@ def main() -> None:
         )
     opt_state = jax.device_put(optimizer.init(params), comm.named_sharding())
     step = jit_lm_train_step(model, optimizer, comm,
-                             shard_sequence=args.seq_parallel)
+                             shard_sequence=args.seq_parallel,
+                             fused_ce=args.fused_ce)
 
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     if comm.rank == 0:

@@ -42,6 +42,7 @@ CELLS = [
     (2048, 8, "full", False),
     (8192, 2, "flash", False),
     (2048, 16, "flash", True),
+    (2048, 32, "flash", True, True),   # fused chunked CE: the champion
 ]
 # Override, e.g. LM_ROOFLINE_CELLS='[[2048,16,"flash",true]]'
 if os.environ.get("LM_ROOFLINE_CELLS"):
@@ -81,9 +82,14 @@ def main():
     comm = chainermn_tpu.create_communicator("tpu", mesh=mesh)
     opt = chainermn_tpu.create_multi_node_optimizer(optax.adamw(3e-4), comm)
 
-    for t_len, batch, attn, use_remat in CELLS:
-        rec = {"cell": [t_len, batch, attn], "seq_len": t_len,
-               "batch": batch, "attention": attn, "remat": use_remat}
+    for cell in CELLS:
+        t_len, batch, attn, use_remat = cell[:4]
+        fused = bool(cell[4]) if len(cell) > 4 else False
+        label = attn + ("+remat" if use_remat else "") + (
+            "+fused" if fused else "")
+        rec = {"cell": [t_len, batch, label], "seq_len": t_len,
+               "batch": batch, "attention": attn, "remat": use_remat,
+               "fused_ce": fused}
         t0 = time.time()
         try:
             model = TransformerLM(
@@ -91,7 +97,8 @@ def main():
                 n_layers=n_layers, max_len=max(t_len, 2048),
                 attention=attn, compute_dtype=jnp.bfloat16,
                 remat=use_remat)
-            step = jit_lm_train_step(model, opt, comm, donate=False)
+            step = jit_lm_train_step(model, opt, comm, donate=False,
+                                     fused_ce=fused)
 
             var_shapes = jax.eval_shape(
                 lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32)),

@@ -93,7 +93,12 @@ def main():
              # at B=16+remat, lm_roofline_aot.jsonl). B=16 is the biggest
              # feasible cell: B=32 peaks at 18.8 GB even WITH remat (the
              # f32 logits pair alone is ~17 GB); B=16+remat fits at 12.7.
-             (2048, 16, "flash+remat")]
+             (2048, 16, "flash+remat"),
+             # chunked fused head+loss (ops/losses.py) removes the f32
+             # logits pair entirely: B=32 drops 18.8 -> 10.65 GB and the
+             # ceiling rises to 87.9% (the best feasible single-chip cell;
+             # B=64 is 17.9 GB = OOM)
+             (2048, 32, "flash+remat+fused")]
     if tiny:
         cells = [(128, 2, "full")]
 
@@ -127,10 +132,12 @@ def main():
             emit({"cell": [t_len, batch, attn], "skipped": "budget",
                   "remaining_s": round(remaining, 1), "need_s": need})
             continue
-        use_remat = attn.endswith("+remat")
-        attn_kind = attn.removesuffix("+remat")
+        flags = attn.split("+")
+        attn_kind, use_remat, use_fused = (
+            flags[0], "remat" in flags[1:], "fused" in flags[1:])
         rec = {"cell": [t_len, batch, attn], "seq_len": t_len,
                "batch": batch, "attention": attn_kind, "remat": use_remat,
+               "fused_ce": use_fused,
                "d_model": d_model, "n_layers": n_layers, "vocab": vocab}
         t_start = time.time()
         try:
@@ -148,7 +155,8 @@ def main():
             n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
             rec["n_params"] = n_params
 
-            step_fn = jit_lm_train_step(model, opt, comm)
+            step_fn = jit_lm_train_step(model, opt, comm,
+                                        fused_ce=use_fused)
             t0 = time.time()
             # first call compiles (jit_lm_train_step caches per-shape)
             params, opt_state, loss, _ = step_fn(

@@ -272,3 +272,40 @@ def test_remat_train_step(comm):
         params, opt_state, loss, _ = step(params, opt_state, tokens, tokens)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_fused_ce_matches_materialized(comm):
+    """fused_ce=True (chunked head+loss, no [B,T,V] logits) must produce
+    the same loss trajectory as the materialized-logits step on identical
+    params/batch (f32 compute for exact comparison)."""
+    from chainermn_tpu.training import jit_lm_train_step
+
+    lm = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                       max_len=256, compute_dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (8, 16), 0, 64)
+    params0 = comm.bcast_data(lm.init(jax.random.PRNGKey(5), tokens[:1]))
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(3e-3), comm)
+
+    traj = {}
+    for fused in (False, True):
+        params = jax.tree_util.tree_map(jnp.copy, params0)
+        opt_state = jax.device_put(opt.init(params), comm.named_sharding())
+        step = jit_lm_train_step(lm, opt, comm, fused_ce=fused)
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss, _ = step(params, opt_state, tokens,
+                                              tokens)
+            losses.append(float(loss))
+        traj[fused] = losses
+        assert losses[-1] < losses[0], losses
+    np.testing.assert_allclose(traj[True], traj[False], rtol=1e-5)
+
+
+def test_fused_ce_rejects_sharded_heads():
+    from chainermn_tpu.training import jit_lm_train_step
+
+    lm = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=1,
+                       max_len=64, tensor_axis="ranks",
+                       vocab_parallel_head=True)
+    with pytest.raises(ValueError, match="fused_ce"):
+        jit_lm_train_step(lm, None, None, fused_ce=True)
