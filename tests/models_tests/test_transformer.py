@@ -309,3 +309,35 @@ def test_fused_ce_rejects_sharded_heads():
                        vocab_parallel_head=True)
     with pytest.raises(ValueError, match="fused_ce"):
         jit_lm_train_step(lm, None, None, fused_ce=True)
+
+
+def test_fused_ce_sequence_parallel(comm):
+    """fused_ce composes with the sequence-sharded step (zigzag): each
+    shard's chunked CE over local tokens, global mean via the loss
+    allreduce — trajectory must match the materialized-logits SP step."""
+    from chainermn_tpu.parallel.sequence import zigzag_permutation
+
+    model = _tiny("zigzag", comm.axis_name)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, (4, 64)), jnp.int32)
+    targets = jnp.asarray(np.roll(np.asarray(tokens), -1, axis=1), jnp.int32)
+    perm = zigzag_permutation(tokens.shape[1], comm.size)
+    tokens, targets = tokens[:, perm], targets[:, perm]
+    params0 = comm.bcast_data(model.init(jax.random.PRNGKey(0),
+                                         tokens[:, :8]))
+    traj = {}
+    for fused in (False, True):
+        params = jax.tree_util.tree_map(jnp.copy, params0)
+        opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(1e-2),
+                                                        comm)
+        opt_state = jax.device_put(opt.init(params), comm.named_sharding())
+        step = jit_lm_train_step(model, opt, comm, shard_sequence=True,
+                                 fused_ce=fused)
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss, _ = step(params, opt_state, tokens,
+                                              targets)
+            losses.append(float(loss))
+        traj[fused] = losses
+    np.testing.assert_allclose(traj[True], traj[False], rtol=1e-5)
+    assert traj[True][-1] < traj[True][0]
