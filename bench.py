@@ -508,6 +508,119 @@ def child_main() -> None:
     _scratch_write(record)
 
 
+def serving_main() -> None:
+    """``bench.py --mode serving``: continuous-batching decode benchmark
+    over :mod:`chainermn_tpu.serving` — the serving-side counterpart of the
+    ResNet training headline. Prints ONE JSON line:
+    ``{"metric": "serving_decode_throughput", "value": tokens/sec, ...,
+    "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "slot_occupancy", ...}``.
+
+    Workload: a burst of ragged random prompts (the arrival pattern that
+    exercises admission + slot reuse) through a fixed slot pool; one
+    warmup request compiles the two engine programs, then the measured
+    run counts only steady-state work. The zero-recompile invariant is
+    carried in the record (``"recompiles"``) so a regression shows up in
+    the perf artifact, not just in tests. Runs on whatever accelerator
+    jax sees — on the CPU mesh it establishes the harness baseline
+    (records say so via ``device_kind``), on a real chip the serving perf
+    number. No retry parent: decode workloads don't hit the multi-minute
+    remote-compile hazard the training bench's ladder machinery exists
+    for; a failure prints a parseable error record instead.
+    """
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    import numpy as np
+
+    import jax
+
+    plat = os.environ.get("CHAINERMN_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    enable_compilation_cache(jax)
+
+    import jax.numpy as jnp
+
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.serving import FCFSScheduler, ServingEngine
+
+    e = os.environ.get
+    n_slots = int(e("CHAINERMN_TPU_SERVE_SLOTS", "8"))
+    n_requests = int(e("CHAINERMN_TPU_SERVE_REQUESTS", "32"))
+    prefill_len = int(e("CHAINERMN_TPU_SERVE_PREFILL_LEN", "32"))
+    max_new = int(e("CHAINERMN_TPU_SERVE_MAX_NEW", "32"))
+    vocab = int(e("CHAINERMN_TPU_SERVE_VOCAB", "256"))
+    d_model = int(e("CHAINERMN_TPU_SERVE_DMODEL", "128"))
+    n_layers = int(e("CHAINERMN_TPU_SERVE_LAYERS", "4"))
+    n_heads = int(e("CHAINERMN_TPU_SERVE_HEADS", "8"))
+
+    devs = jax.devices()
+    log(f"serving bench: devices={len(devs)} kind={devs[0].device_kind!r} "
+        f"slots={n_slots} requests={n_requests}")
+    try:
+        model = TransformerLM(
+            vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+            n_layers=n_layers, max_len=prefill_len + max_new,
+        )
+        rng = np.random.RandomState(0)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, prefill_len), jnp.int32))
+        engine = ServingEngine(model, params, n_slots=n_slots,
+                               prefill_len=prefill_len)
+
+        # warmup: compile prefill + decode once, off the measured clock
+        warm = FCFSScheduler(engine)
+        warm.submit(rng.randint(1, vocab, 4).astype(np.int32), 2)
+        warm.run_until_idle()
+
+        sched = FCFSScheduler(engine)  # fresh metrics for the measured run
+        t0 = time.time()
+        for _ in range(n_requests):
+            prompt = rng.randint(1, vocab,
+                                 rng.randint(1, prefill_len + 1))
+            sched.submit(prompt.astype(np.int32),
+                         int(rng.randint(1, max_new + 1)))
+        sched.run_until_idle()
+        wall = time.time() - t0
+        m = sched.metrics.report()
+        record = {
+            "metric": "serving_decode_throughput",
+            "value": m["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "mode": "serving",
+            "n_chips": len(devs),
+            "device_kind": devs[0].device_kind,
+            "n_slots": n_slots,
+            "n_requests": n_requests,
+            "prefill_len": prefill_len,
+            "max_new": max_new,
+            "model": {"vocab": vocab, "d_model": d_model,
+                      "n_layers": n_layers, "n_heads": n_heads},
+            "tokens_generated": m["tokens_generated"],
+            "wall_s": round(wall, 3),
+            "ttft_p50_ms": round(m["ttft_p50_s"] * 1e3, 3),
+            "ttft_p99_ms": round(m["ttft_p99_s"] * 1e3, 3),
+            "ttft_mean_ms": round(m["ttft_mean_s"] * 1e3, 3),
+            "tpot_p50_ms": round(m["tpot_p50_s"] * 1e3, 3),
+            "tpot_p99_ms": round(m["tpot_p99_s"] * 1e3, 3),
+            "slot_occupancy": m["slot_occupancy_mean"],
+            "queue_depth_mean": m["queue_depth_mean"],
+            "recompiles": engine.compile_counts(),
+        }
+    except Exception as exc:  # one parseable line, never a bare traceback
+        log(f"serving bench failed: {type(exc).__name__}: {exc}")
+        record = {
+            "metric": "serving_decode_throughput",
+            "value": None,
+            "unit": "tokens/sec",
+            "mode": "serving",
+            "error": type(exc).__name__,
+            "detail": str(exc)[-500:],
+        }
+        print(json.dumps(record))
+        raise SystemExit(1)
+    print(json.dumps(record))
+    _scratch_write(record)
+
+
 def _failure_record(err_class: str, detail: str, attempts_run: int) -> dict:
     rec = {
         "metric": "resnet50_imagenet_train_throughput",
@@ -796,8 +909,24 @@ def parent_main() -> None:
     raise SystemExit(1)
 
 
+def _cli_mode(argv) -> str:
+    """``--mode serving`` / ``--mode=serving`` (default: the ResNet
+    training benchmark with its retry-parent machinery)."""
+    for i, a in enumerate(argv):
+        if a == "--mode" and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith("--mode="):
+            return a.split("=", 1)[1]
+    return "train"
+
+
 def main() -> None:
-    if "--child" in sys.argv:
+    mode = _cli_mode(sys.argv[1:])
+    if mode == "serving":
+        serving_main()
+    elif mode != "train":
+        raise SystemExit(f"unknown --mode {mode!r} (train|serving)")
+    elif "--child" in sys.argv:
         # child stdout carries ONLY the JSON record; everything else is stderr
         child_main()
     else:

@@ -17,6 +17,7 @@ from chainermn_tpu.extensions.profiling import (
     StepTimer,
     Watchdog,
     collective_stats,
+    latency_report,
     parse_hlo_collectives,
     trace,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "StepTimer",
     "Watchdog",
     "collective_stats",
+    "latency_report",
     "parse_hlo_collectives",
     "trace",
 ]

@@ -136,6 +136,22 @@ def collective_stats(fn: Callable, *args, **kwargs) -> dict[str, Any]:
     return parse_hlo_collectives(hlo)
 
 
+def latency_report(samples, prefix: str) -> dict[str, float]:
+    """``{prefix}_mean_s`` / ``{prefix}_p50_s`` / ``{prefix}_p99_s`` from a
+    list of second-valued samples — the one percentile convention every
+    latency surface (``StepTimer`` steps, serving TTFT/TPOT) reports in, so
+    records from training and serving benchmarks stay field-compatible.
+    Empty input returns ``{}`` (no samples is not 0 latency)."""
+    if not len(samples):
+        return {}
+    t = np.asarray(samples, dtype=np.float64)
+    return {
+        f"{prefix}_mean_s": float(t.mean()),
+        f"{prefix}_p50_s": float(np.percentile(t, 50)),
+        f"{prefix}_p99_s": float(np.percentile(t, 99)),
+    }
+
+
 class StepTimer:
     """Wall-clock step statistics with warmup exclusion.
 
@@ -180,15 +196,10 @@ class StepTimer:
     def report(self) -> dict[str, float]:
         if not self._times:
             return {"steps": 0}
-        t = np.asarray(self._times)
-        out = {
-            "steps": len(t),
-            "step_time_mean_s": float(t.mean()),
-            "step_time_p50_s": float(np.percentile(t, 50)),
-            "step_time_p99_s": float(np.percentile(t, 99)),
-        }
+        out = {"steps": len(self._times)}
+        out.update(latency_report(self._times, "step_time"))
         if self._items:
-            out["items_per_sec"] = self._items / float(t.mean())
+            out["items_per_sec"] = self._items / out["step_time_mean_s"]
         return out
 
 

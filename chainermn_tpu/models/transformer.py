@@ -231,15 +231,23 @@ class TransformerLM(nn.Module):
         d_ff = self.d_ff or 4 * self.d_model
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
-        # pos_offset: scalar base (contiguous shard) OR a [T_local] vector of
+        # pos_offset: scalar base (contiguous shard), a [T_local] vector of
         # explicit global positions (zigzag layout — each shard holds one
-        # early and one late chunk, so its positions are not contiguous)
+        # early and one late chunk, so its positions are not contiguous),
+        # OR a [B, T] matrix of per-SEQUENCE positions (continuous-batching
+        # decode: every cache slot sits at its own depth, so one call
+        # advances all slots with per-row position bases).
         if jnp.ndim(pos_offset) == 0:
             pos = pos_offset + jnp.arange(tokens.shape[1])
         else:
             pos = pos_offset
-        x = x + nn.Embed(self.max_len, self.d_model,
-                         dtype=self.compute_dtype, name="pos_embed")(pos)[None]
+        pe = nn.Embed(self.max_len, self.d_model,
+                      dtype=self.compute_dtype, name="pos_embed")(pos)
+        x = x + (pe if jnp.ndim(pos_offset) == 2 else pe[None])
+        # blocks only consume positions on the cache path, where each batch
+        # row needs its scalar base: column 0 of the per-sequence matrix
+        # (decode steps are contiguous within one call)
+        block_pos = pos_offset[:, 0] if jnp.ndim(pos_offset) == 2 else pos_offset
         aux_total = jnp.float32(0.0)
         new_caches = []
         # nn.remat wraps the block's apply in jax.checkpoint; decode
@@ -262,10 +270,10 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )
             if kv_caches is not None:
-                x, c = block(x, pos_offset, kv_cache=kv_caches[i])
+                x, c = block(x, block_pos, kv_cache=kv_caches[i])
                 new_caches.append(c)
                 continue
-            out = block(x, pos_offset)
+            out = block(x, block_pos)
             x, aux = out if is_moe else (out, 0.0)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
@@ -328,6 +336,7 @@ def generate(
     rng=None,
     use_cache: bool = True,
     comm=None,
+    eos_id: Optional[int] = None,
 ):
     """Autoregressive decoding for :class:`TransformerLM` (inference utility
     beyond the reference, which has no generation loop; completes the LM
@@ -366,6 +375,16 @@ def generate(
     params placed by :func:`~chainermn_tpu.parallel.gspmd.megatron_shard`
     run under the partitioner, which inserts the gathers the Megatron
     layout needs (pinned by ``test_generate_with_megatron_layout``).
+
+    ``eos_id``: early-stop token. Once a sequence samples it, every later
+    position in that row is written as pad (0) instead of the sampled
+    token — the row stops contributing changed tokens while the batch
+    keeps its static shape (pure ``jnp.where`` masking, no recompile, no
+    shape change). The decode loop still runs ``n_tokens`` steps (finished
+    rows feed pad through the model), so cached/cacheless/TP parity is
+    preserved; per-request wall-clock retirement on EOS is the serving
+    engine's job (:mod:`chainermn_tpu.serving`), whose slot-retirement
+    contract depends on exactly this masking.
     """
     if model.sequence_axis is not None:
         raise ValueError(
@@ -392,6 +411,13 @@ def generate(
             f"top_k must be in [0, vocab_size={model.vocab_size}], got "
             f"{top_k} (0 disables the filter)"
         )
+    if eos_id is not None:
+        eos_id = int(eos_id)  # normalize for the compiled-fn cache key
+        if not 0 <= eos_id < model.vocab_size:
+            raise ValueError(
+                f"eos_id must be in [0, vocab_size={model.vocab_size}), "
+                f"got {eos_id}"
+            )
     if model.moe_experts and not use_cache:
         import warnings
 
@@ -417,11 +443,11 @@ def generate(
             )
         run = _generate_tp_fn(model, int(n_tokens), float(temperature),
                               int(top_k), float(top_p), b, int(t0),
-                              jnp.dtype(prompt.dtype).name, comm)
+                              jnp.dtype(prompt.dtype).name, comm, eos_id)
         return run(params, prompt, rng)
     fn = _generate_cached_fn if use_cache else _generate_fn
     run = fn(model, int(n_tokens), float(temperature), int(top_k),
-             float(top_p), b, int(t0), jnp.dtype(prompt.dtype).name)
+             float(top_p), b, int(t0), jnp.dtype(prompt.dtype).name, eos_id)
     return run(params, prompt, rng)
 
 
@@ -455,9 +481,25 @@ def _sampler(temperature, top_k=0, top_p=1.0):
     return sample
 
 
+def _eos_tracker(eos_id, b):
+    """(init_done, mask_fn) for EOS early-stop: ``init_done(first)`` flags
+    rows whose FIRST generated token is EOS; ``mask_fn(done, nxt)`` returns
+    ``(write, new_done)`` — pad (0) for already-done rows, and the done set
+    grown by rows that just sampled EOS. With ``eos_id=None`` both are
+    identity/always-false, compiling to nothing."""
+    if eos_id is None:
+        return (lambda first: jnp.zeros((b,), bool),
+                lambda done, nxt: (nxt, done))
+
+    def mask(done, nxt):
+        return jnp.where(done, jnp.zeros_like(nxt), nxt), done | (nxt == eos_id)
+
+    return (lambda first: first == eos_id), mask
+
+
 @functools.lru_cache(maxsize=32)
 def _generate_cached_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
-                        dtype_name):
+                        dtype_name, eos_id=None):
     """KV-cached decode: one prefill over the prompt, then one token per
     step against the static cache. Compiled per (model, shape, sampler)
     key. NOTE the lru_cache retains compiled programs closed over param
@@ -466,6 +508,7 @@ def _generate_cached_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
     total = t0 + n_tokens
     dtype = jnp.dtype(dtype_name)
     sample = _sampler(temperature, top_k, top_p)
+    init_done, eos_mask = _eos_tracker(eos_id, b)
 
     @jax.jit
     def run(params, prompt, rng):
@@ -474,18 +517,20 @@ def _generate_cached_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
         logits, caches = model.apply(params, prompt, 0, kv_caches=caches)
         nxt, key = sample(logits[:, -1], rng)
         buf = buf.at[:, t0].set(nxt.astype(dtype))
+        done = init_done(nxt)
 
         def step(carry, i):
-            buf, caches, key = carry
+            buf, caches, key, done = carry
             tok = lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
             lg, caches = model.apply(params, tok, i, kv_caches=caches)
             nxt, key = sample(lg[:, 0], key)
+            write, done = eos_mask(done, nxt)
             buf = lax.dynamic_update_slice(
-                buf, nxt[:, None].astype(dtype), (0, i + 1))
-            return (buf, caches, key), None
+                buf, write[:, None].astype(dtype), (0, i + 1))
+            return (buf, caches, key, done), None
 
-        (buf, _, _), _ = lax.scan(
-            step, (buf, caches, key), jnp.arange(t0, total - 1))
+        (buf, _, _, _), _ = lax.scan(
+            step, (buf, caches, key, done), jnp.arange(t0, total - 1))
         return buf
 
     return run
@@ -493,7 +538,7 @@ def _generate_cached_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
 
 @functools.lru_cache(maxsize=8)
 def _generate_tp_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
-                    dtype_name, comm):
+                    dtype_name, comm, eos_id=None):
     """Tensor-parallel cached decode: the same loop as
     :func:`_generate_cached_fn` traced INSIDE ``comm.shard_map`` — per-rank
     caches hold the rank's local heads, and a vocab-parallel head's local
@@ -512,6 +557,7 @@ def _generate_tp_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
             f"n_heads {model.n_heads} not divisible by tensor-axis size {n_tp}"
         )
     local_h = model.n_heads // n_tp
+    init_done, eos_mask = _eos_tracker(eos_id, b)
 
     def body(params, prompt, rng):
         def last_logits(tokens, offset, caches):
@@ -530,18 +576,20 @@ def _generate_tp_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
         logits, caches = last_logits(prompt, 0, caches)
         nxt, key = sample(logits, rng)
         buf = buf.at[:, t0].set(nxt.astype(dtype))
+        done = init_done(nxt)
 
         def step(carry, i):
-            buf, caches, key = carry
+            buf, caches, key, done = carry
             tok = lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
             lg, caches = last_logits(tok, i, caches)
             nxt, key = sample(lg, key)
+            write, done = eos_mask(done, nxt)
             buf = lax.dynamic_update_slice(
-                buf, nxt[:, None].astype(dtype), (0, i + 1))
-            return (buf, caches, key), None
+                buf, write[:, None].astype(dtype), (0, i + 1))
+            return (buf, caches, key, done), None
 
-        (buf, _, _), _ = lax.scan(
-            step, (buf, caches, key), jnp.arange(t0, total - 1))
+        (buf, _, _, _), _ = lax.scan(
+            step, (buf, caches, key, done), jnp.arange(t0, total - 1))
         return buf
 
     return jax.jit(comm.shard_map(
@@ -551,7 +599,7 @@ def _generate_tp_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
 
 @functools.lru_cache(maxsize=32)
 def _generate_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
-                 dtype_name):
+                 dtype_name, eos_id=None):
     """The cacheless reference decode (round-3 behavior): re-runs the full
     forward over the whole buffer per token — O(T^2) attention x T tokens.
     Kept as the independent correctness reference for the cached path.
@@ -560,21 +608,24 @@ def _generate_fn(model, n_tokens, temperature, top_k, top_p, b, t0,
     total = t0 + n_tokens
     dtype = jnp.dtype(dtype_name)
     sample = _sampler(temperature, top_k, top_p)
+    _, eos_mask = _eos_tracker(eos_id, b)
 
     @jax.jit
     def run(params, prompt, rng):
         buf = jnp.zeros((b, total), dtype).at[:, :t0].set(prompt)
+        done = jnp.zeros((b,), bool)  # every token is sampled inside the scan
 
         def step(carry, i):
-            buf, key = carry
+            buf, key, done = carry
             logits = model.apply(params, buf)      # [B, total, V]
             # the token at position i is predicted from the logits at i-1
             nxt_logits = lax.dynamic_slice_in_dim(logits, i - 1, 1, axis=1)[:, 0]
             nxt, key = sample(nxt_logits, key)
-            buf = buf.at[:, i].set(nxt.astype(buf.dtype))
-            return (buf, key), None
+            write, done = eos_mask(done, nxt)
+            buf = buf.at[:, i].set(write.astype(buf.dtype))
+            return (buf, key, done), None
 
-        (out, _), _ = lax.scan(step, (buf, rng), jnp.arange(t0, total))
+        (out, _, _), _ = lax.scan(step, (buf, rng, done), jnp.arange(t0, total))
         return out
 
     return run

@@ -120,6 +120,22 @@ def _default_block(t: int) -> int:
     return 1024
 
 
+def _sds(shape, dtype, vma):
+    """``jax.ShapeDtypeStruct`` with a vma annotation where supported;
+    legacy JAX has no vma field (and no tracking to need it)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _compiler_params(**kw):
+    """``pltpu.CompilerParams`` (new) / ``pltpu.TPUCompilerParams``
+    (legacy 0.4.x) — same fields, pre-rename."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
 def _out_vma(*xs) -> frozenset:
     """Varying-manner annotation for kernel outputs: the union of the
     inputs' vma sets. pallas_call does not infer vma, so under
@@ -128,6 +144,8 @@ def _out_vma(*xs) -> frozenset:
     schedule analysis (scripts/aot_ring_overlap.py); the CPU suite never
     sees it because interpret-mode tests run with check_vma=False."""
     vma = frozenset()
+    if not hasattr(jax, "typeof"):  # legacy JAX: no vma tracking at all
+        return vma
     for x in xs:
         v = getattr(jax.typeof(x), "vma", None)
         if v:
@@ -313,17 +331,17 @@ def _fwd(q, k, v, q_offset, k_offset, *, scale, causal, block_q, block_k,
             # out_dtype=f32 lets ring callers merge partial block outputs
             # without a bf16 round-trip (q/k/v still feed the MXU in their
             # input dtype; the kernel accumulates f32 regardless)
-            jax.ShapeDtypeStruct((bh, tq, d), out_dtype or q.dtype,
-                                 vma=_out_vma(qo, ko, q, k, v)),
-            jax.ShapeDtypeStruct((bh, tq, _LANE), jnp.float32,
-                                 vma=_out_vma(qo, ko, q, k, v)),
+            _sds((bh, tq, d), out_dtype or q.dtype,
+                                 _out_vma(qo, ko, q, k, v)),
+            _sds((bh, tq, _LANE), jnp.float32,
+                                 _out_vma(qo, ko, q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max m
             pltpu.VMEM((block_q, _LANE), jnp.float32),   # running denom l
             pltpu.VMEM((block_q, d), jnp.float32),       # unnormalized acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qo, ko, q, k, v)
@@ -484,10 +502,10 @@ def _dq_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
             pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), grad_dtype or q.dtype,
-                                       vma=_out_vma(qo2, ko2, q, k, v, do)),
+        out_shape=_sds((bh, tq, d), grad_dtype or q.dtype,
+                                       _out_vma(qo2, ko2, q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)
@@ -524,17 +542,17 @@ def _dkv_call(q, k, v, do, lse, delta, qo2, ko2, *, scale, causal, block_q,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or k.dtype,
-                                 vma=_out_vma(qo2, ko2, q, k, v, do)),
-            jax.ShapeDtypeStruct((bh, tk, d), grad_dtype or v.dtype,
-                                 vma=_out_vma(qo2, ko2, q, k, v, do)),
+            _sds((bh, tk, d), grad_dtype or k.dtype,
+                                 _out_vma(qo2, ko2, q, k, v, do)),
+            _sds((bh, tk, d), grad_dtype or v.dtype,
+                                 _out_vma(qo2, ko2, q, k, v, do)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         # the q-chunk dim accumulates into the scratch -> sequential
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qo2, ko2, q, k, v, do, lse, delta)

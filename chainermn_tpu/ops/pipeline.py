@@ -33,6 +33,9 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
+
+from chainermn_tpu.utils import axis_size as _axis_size
+from chainermn_tpu.utils import pcast_varying
 from jax.sharding import PartitionSpec as P
 
 
@@ -67,7 +70,7 @@ def pipeline_apply(
     """
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b = x.shape[0]
     if b % n_microbatches:
@@ -88,7 +91,7 @@ def pipeline_apply(
 
     # the carry is per-device state (varying over the pipeline axis); without
     # the cast the scan carry's replicated-ness differs between input/output
-    state0 = lax.pcast(jnp.zeros_like(micro[0]), (axis_name,), to="varying")
+    state0 = pcast_varying(jnp.zeros_like(micro[0]), (axis_name,))
     _, outs = lax.scan(tick, state0, jnp.arange(ticks))
     # the last stage emits valid microbatch m at tick m + n - 1; everything
     # it produced earlier is fill garbage. Select the valid window and

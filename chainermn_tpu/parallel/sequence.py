@@ -37,7 +37,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from chainermn_tpu.utils import axis_size as _axis_size
+
 _NEG_BIG = -1e30  # finite "minus infinity": avoids inf-inf NaNs in masked rows
+
+
+def _typeof_vma(x):
+    """Varying-manner set of a traced value; empty on legacy JAX (no
+    ``jax.typeof``/vma — replication tracking is off there, see
+    ``_vary_to``)."""
+    return jax.typeof(x).vma if hasattr(jax, "typeof") else frozenset()
 
 
 def _vary_to(x, vma):
@@ -45,8 +54,13 @@ def _vary_to(x, vma):
     already vary on. A plain ``pcast(..., to='varying')`` on a value that
     already carries some of the axes raises ("Unsupported pcast
     from=varying, to='varying'") — hit once the flash kernels started
-    propagating input vma to their outputs (round 5)."""
-    need = tuple(a for a in vma if a not in jax.typeof(x).vma)
+    propagating input vma to their outputs (round 5). Legacy JAX (no
+    ``jax.typeof``/vma) runs shard_map with replication tracking off
+    (``mesh_communicator._shard_map``), where everything is already
+    varying — identity."""
+    if not hasattr(jax, "typeof"):
+        return x
+    need = tuple(a for a in vma if a not in _typeof_vma(x))
     return lax.pcast(x, need, to="varying") if need else x
 
 
@@ -97,7 +111,7 @@ def ring_attention(
             f"ring_attention needs a single named mesh axis, got {axis_name!r} "
             "— use a flat communicator (e.g. 'tpu') for sequence parallelism"
         )
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     if scale is None:
@@ -110,8 +124,8 @@ def ring_attention(
     # q/k/v carry the tensor axis's vma too; a ring-axis-only pcast would
     # make the carry types diverge after one iteration). With check_vma off
     # the vma sets are empty and this degenerates to the ring axis alone.
-    vma = (frozenset({axis_name}) | jax.typeof(q).vma
-           | jax.typeof(k).vma | jax.typeof(v).vma)
+    vma = (frozenset({axis_name}) | _typeof_vma(q)
+           | _typeof_vma(k) | _typeof_vma(v))
     _vary = lambda x: _vary_to(x, vma)
     m0 = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
     l0 = _vary(jnp.zeros((b, h, t), jnp.float32))
@@ -204,12 +218,12 @@ def _ring_flash(q, k, v, axis_name, causal, scale):
 def _ring_flash_fwd_pass(q, k, v, axis_name, causal, scale):
     from chainermn_tpu.ops.flash_attention import flash_fwd_with_lse
 
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
-    vma = (frozenset({axis_name}) | jax.typeof(q).vma
-           | jax.typeof(k).vma | jax.typeof(v).vma)
+    vma = (frozenset({axis_name}) | _typeof_vma(q)
+           | _typeof_vma(k) | _typeof_vma(v))
     _vary = lambda x: _vary_to(x, vma)
     o0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
     lse0 = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
@@ -239,7 +253,7 @@ def _ring_flash_bwd_rule(axis_name, causal, scale, res, do):
     from chainermn_tpu.ops.flash_attention import flash_block_grads
 
     q, k, v, out, lse = res
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -247,7 +261,7 @@ def _ring_flash_bwd_rule(axis_name, causal, scale, res, do):
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     ).transpose(0, 2, 1)
-    vma = (jax.typeof(q).vma | jax.typeof(do).vma
+    vma = (_typeof_vma(q) | _typeof_vma(do)
            | frozenset({axis_name}))
     _vary = lambda x: _vary_to(x, vma)
     dq0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
@@ -324,7 +338,7 @@ def _zigzag_flash(q, k, v, axis_name, scale):
 def _zigzag_flash_fwd_pass(q, k, v, axis_name, scale):
     from chainermn_tpu.ops.flash_attention import flash_fwd_with_lse
 
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     if t % 2:
@@ -404,7 +418,7 @@ def _zigzag_flash_bwd_rule(axis_name, scale, res, do):
     from chainermn_tpu.ops.flash_attention import flash_block_grads
 
     q, k, v, out, lse = res
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     c = t // 2
@@ -412,7 +426,7 @@ def _zigzag_flash_bwd_rule(axis_name, scale, res, do):
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     ).transpose(0, 2, 1)
-    vma = jax.typeof(q).vma | jax.typeof(do).vma | frozenset({axis_name})
+    vma = _typeof_vma(q) | _typeof_vma(do) | frozenset({axis_name})
     _vary = lambda x: _vary_to(x, vma)
     off_e, off_l = my * c, (2 * n - 1 - my) * c
 
@@ -591,7 +605,7 @@ def zigzag_ring_attention(
             f"zigzag_ring_attention needs a single named mesh axis, got "
             f"{axis_name!r}"
         )
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     if t % 2:
@@ -601,8 +615,8 @@ def zigzag_ring_attention(
         scale = d ** -0.5
 
     q32 = q.astype(jnp.float32)
-    vma = (frozenset({axis_name}) | jax.typeof(q).vma
-           | jax.typeof(k).vma | jax.typeof(v).vma)
+    vma = (frozenset({axis_name}) | _typeof_vma(q)
+           | _typeof_vma(k) | _typeof_vma(v))
     _vary = lambda x: _vary_to(x, vma)
     m = _vary(jnp.full((b, h, t), _NEG_BIG, jnp.float32))
     l = _vary(jnp.zeros((b, h, t), jnp.float32))
@@ -714,7 +728,7 @@ def ulysses_attention(
         # O(T^2) score tile the flag exists to avoid
         raise ValueError(
             f"block_impl must be 'xla' or 'flash', got {block_impl!r}")
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     h = q.shape[2]
     if h % n != 0:
         raise ValueError(f"heads ({h}) must be divisible by axis size ({n})")
@@ -765,16 +779,25 @@ def cached_attention(q, kbuf, vbuf, pos_offset, *, scale: Optional[float] = None
     Static shapes throughout: the compiled program is one [S, Tc] score
     tile per head, O(Tc*D) per decoded token instead of the O(Tc^2)
     re-forward of cacheless decoding. Shared by the dense and
-    tensor-parallel decode paths (``pos_offset`` may be traced)."""
+    tensor-parallel decode paths (``pos_offset`` may be traced).
+
+    ``pos_offset`` may also be a ``[B]`` vector of per-sequence bases: each
+    batch row then decodes at its OWN position — the continuous-batching
+    shape, where one call advances every cache slot one token regardless of
+    how far along each slot's sequence is."""
     d = q.shape[-1]
     if scale is None:
         scale = d ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, kbuf,
                    preferred_element_type=jnp.float32) * scale
-    q_pos = pos_offset + jnp.arange(q.shape[1])
     k_pos = jnp.arange(kbuf.shape[1])
-    mask = k_pos[None, :] <= q_pos[:, None]
-    s = jnp.where(mask[None, None], s, _NEG_BIG)
+    if jnp.ndim(pos_offset) == 0:
+        q_pos = pos_offset + jnp.arange(q.shape[1])          # [S]
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,S,Tc]
+    else:
+        q_pos = pos_offset[:, None] + jnp.arange(q.shape[1])[None]  # [B, S]
+        mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]  # [B,1,S,Tc]
+    s = jnp.where(mask, s, _NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, vbuf.astype(jnp.float32),
                      preferred_element_type=jnp.float32)
@@ -787,11 +810,25 @@ def update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
     the matching queries against the updated buffers — the one shared
     decode-step body for the dense and tensor-parallel cached paths.
     Returns ``(out, new_cache)`` with ``new_cache`` the same ``{'k','v'}``
-    dict shape. Causal by construction (the position mask)."""
-    kbuf = lax.dynamic_update_slice(
-        kv_cache["k"], k.astype(kv_cache["k"].dtype), (0, pos_offset, 0, 0))
-    vbuf = lax.dynamic_update_slice(
-        kv_cache["v"], v.astype(kv_cache["v"].dtype), (0, pos_offset, 0, 0))
+    dict shape. Causal by construction (the position mask).
+
+    A ``[B]`` ``pos_offset`` writes each batch row's K/V at that row's own
+    position (vmapped per-row update) — the slot-pool decode step, where
+    every slot sits at a different depth in its sequence."""
+    if jnp.ndim(pos_offset) == 0:
+        kbuf = lax.dynamic_update_slice(
+            kv_cache["k"], k.astype(kv_cache["k"].dtype),
+            (0, pos_offset, 0, 0))
+        vbuf = lax.dynamic_update_slice(
+            kv_cache["v"], v.astype(kv_cache["v"].dtype),
+            (0, pos_offset, 0, 0))
+    else:
+        row_update = jax.vmap(
+            lambda buf, new, p: lax.dynamic_update_slice(buf, new, (p, 0, 0)))
+        kbuf = row_update(kv_cache["k"], k.astype(kv_cache["k"].dtype),
+                          pos_offset)
+        vbuf = row_update(kv_cache["v"], v.astype(kv_cache["v"].dtype),
+                          pos_offset)
     out = cached_attention(q, kbuf, vbuf, pos_offset, scale=scale)
     return out, {"k": kbuf, "v": vbuf}
 
@@ -863,7 +900,7 @@ def sequence_parallel_attention(
 
     def f(q, k, v):
         try:
-            lax.axis_size(axis_name)
+            _axis_size(axis_name)
         except NameError:
             # axis not bound: we're outside shard_map (flax init, eval on a
             # gathered sequence) — the whole sequence is local, so exact

@@ -339,6 +339,12 @@ def global_objective(local_loss, axes):
 
     if isinstance(axes, str):
         axes = (axes,)
+    if not hasattr(jax, "typeof"):
+        # Legacy JAX has no vma tracking at all: pmean over EVERY requested
+        # axis. Math is unchanged — pmean of a value that happens to be
+        # replicated over an axis returns the same value — and the backward
+        # psums the pattern needs come from pmean's own transpose.
+        return lax.pmean(local_loss, axes)
     # The pattern is built ON vma tracking: with check_vma=False every value
     # reads as vma-empty, no pmean would ever fire, and the "grads" would be
     # per-rank garbage — fail loudly instead (axis_index is varying by

@@ -1,0 +1,40 @@
+"""``chainermn_tpu.serving`` — continuous-batching inference over the
+static KV-cache decode path.
+
+The training side of the framework ends at offline decoding
+(:func:`chainermn_tpu.models.generate`: one fixed batch, start to finish).
+This package is the traffic-facing counterpart — the ROADMAP's
+"serving heavy traffic" axis — built from four layers:
+
+- :class:`~chainermn_tpu.serving.engine.ServingEngine` — mechanism: a
+  fixed pool of cache slots in one persistent static-shape KV cache, two
+  compiled programs (per-slot ``prefill``, all-slots ``decode_step``),
+  zero recompiles after warmup, tensor-parallel via ``comm.shard_map``;
+- :class:`~chainermn_tpu.serving.scheduler.FCFSScheduler` — policy: FCFS
+  admission into freed slots between decode steps, request state machine,
+  EOS/length retirement, cancellation;
+- :class:`~chainermn_tpu.serving.metrics.ServingMetrics` — observability:
+  TTFT/TPOT percentiles, tokens/s, queue depth, slot occupancy (the same
+  reporting convention as ``extensions.StepTimer``);
+- :class:`~chainermn_tpu.serving.client.ServingClient` — the in-process
+  front: background engine thread, blocking and per-token streaming APIs.
+
+Correctness invariant (pinned in ``tests/serving_tests``): requests
+admitted at staggered times into the shared slot pool produce
+token-for-token the same outputs as isolated ``generate()`` calls with
+the same params and rng.
+"""
+
+from chainermn_tpu.serving.client import ServingClient
+from chainermn_tpu.serving.engine import ServingEngine
+from chainermn_tpu.serving.metrics import ServingMetrics
+from chainermn_tpu.serving.scheduler import FCFSScheduler, Request, RequestState
+
+__all__ = [
+    "FCFSScheduler",
+    "Request",
+    "RequestState",
+    "ServingClient",
+    "ServingEngine",
+    "ServingMetrics",
+]

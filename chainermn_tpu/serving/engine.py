@@ -1,0 +1,335 @@
+"""Continuous-batching decode engine over the static KV-cache path.
+
+The offline :func:`chainermn_tpu.models.generate` decodes ONE fixed batch
+start-to-finish; a traffic-facing server cannot wait for the slowest
+request before admitting the next. This engine owns a fixed pool of
+``n_slots`` cache slots inside one persistent static-shape KV cache
+(:func:`~chainermn_tpu.models.transformer.init_kv_caches`-backed) and
+exposes exactly two compiled device programs:
+
+- ``prefill``: run one request's (padded) prompt through the model,
+  writing its K/V into ONE slot of the shared cache and sampling the first
+  generated token — admission cost is one prefill, independent of every
+  other slot's progress;
+- ``decode_step``: advance ALL slots one token per call, each at its OWN
+  sequence position (the per-slot ``[B]`` position form of
+  ``update_cache_and_attend``); retired/free slots ride along masked by
+  ``jnp.where`` so shapes never change and nothing recompiles.
+
+Why this is correct without ever zeroing a slot between requests: the
+causal position mask only admits cache rows at positions ``<= q_pos``, and
+every such row was either written by THIS request's prefill (rows
+``< prompt_len``) or overwritten by one of its decode steps (each step
+writes its query row before attending). Stale K/V from a previous tenant
+of the slot — and the padding rows a short prompt leaves behind — sit at
+positions the mask excludes until the exact step that overwrites them.
+The engine-level parity test (staggered admissions vs solo ``generate()``,
+token-for-token) pins this.
+
+Per-request sampling parity: each slot carries its own PRNG key and draws
+through the SAME ``_sampler`` split sequence as a solo ``generate()`` call
+(one split at prefill, one per decode step), via a per-slot vmap — so a
+request's tokens are independent of which other requests share the batch.
+
+Tensor-parallel decode reuses the ``_generate_tp_fn`` pattern: both
+programs are traced inside ``comm.shard_map`` with the cache's head axis
+sharded over the mesh (``P(None, None, axis)`` at rest), and a
+vocab-parallel head's local logits are ``all_gather``-ed before sampling —
+the scheduler drives TP decode through the identical slot API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from chainermn_tpu.models.transformer import (
+    _sampler,
+    init_kv_caches,
+)
+
+
+class ServingEngine:
+    """Slot-pool KV-cache decode engine (mechanism only — admission policy,
+    EOS retirement, and per-request bookkeeping live in
+    :class:`~chainermn_tpu.serving.scheduler.FCFSScheduler`).
+
+    Parameters
+    ----------
+    model : TransformerLM
+        Built for inference: ``sequence_axis=None``; MoE via
+        ``moe_impl='gshard'``; ``tensor_axis`` set requires ``comm``.
+    params : pytree
+        Model parameters (the engine never mutates them).
+    n_slots : int
+        Cache slots == max concurrently-decoding requests. The decode
+        program's batch dimension; fixed at construction.
+    prefill_len : int
+        Every prompt is right-padded to this length so prefill compiles
+        ONCE. Padding rows write K/V the causal mask hides until decode
+        overwrites them (module docstring); longer prompts are rejected.
+    cache_len : int, optional
+        Per-slot KV capacity (prompt + generated); defaults to
+        ``model.max_len``. A request needs ``len(prompt) + max_new <=
+        cache_len``.
+    temperature / top_k / top_p : sampler configuration shared by every
+        request (the compiled programs bake it in, exactly like
+        ``generate()``'s lru-cache key).
+    comm : communicator, optional
+        Required iff ``model.tensor_axis`` is set: both programs then run
+        inside its ``shard_map`` with head-sharded caches.
+    """
+
+    def __init__(self, model, params, *, n_slots: int, prefill_len: int,
+                 cache_len: Optional[int] = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0, comm=None):
+        if model.sequence_axis is not None:
+            raise ValueError(
+                "serving decode does not support sequence-sharded models: "
+                "rebuild with sequence_axis=None for inference"
+            )
+        if model.moe_experts and model.moe_impl != "gshard":
+            raise ValueError(
+                "serving decode supports MoE only via moe_impl='gshard' — "
+                "rebuild the model with moe_impl='gshard' (same params)"
+            )
+        if model.tensor_axis is not None and comm is None:
+            raise ValueError(
+                "tensor-parallel serving needs comm= (the decode programs "
+                "run inside the communicator's shard_map)"
+            )
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        cache_len = cache_len or model.max_len
+        if not 0 < prefill_len <= cache_len:
+            raise ValueError(
+                f"prefill_len must be in (0, cache_len={cache_len}], got "
+                f"{prefill_len}"
+            )
+        if cache_len > model.max_len:
+            raise ValueError(
+                f"cache_len {cache_len} exceeds model.max_len "
+                f"{model.max_len}"
+            )
+        self.model = model
+        self.params = params
+        self.n_slots = int(n_slots)
+        self.prefill_len = int(prefill_len)
+        self.cache_len = int(cache_len)
+        self._comm = comm
+        self._sample = _sampler(float(temperature), int(top_k), float(top_p))
+
+        if model.tensor_axis is not None:
+            self._init_tp_caches(comm)
+            self._prefill_fn, self._decode_fn = self._build_tp_fns(comm)
+        else:
+            self.caches = init_kv_caches(model, self.n_slots, self.cache_len)
+            self._prefill_fn, self._decode_fn = self._build_fns()
+
+        # host-side slot mirror: the scheduler reads/writes through the
+        # occupy/release API; the decode program consumes these as [B]
+        # device operands each step (tiny transfers, static shapes)
+        self._token = np.zeros((self.n_slots,), np.int32)
+        self._pos = np.zeros((self.n_slots,), np.int32)
+        self._active = np.zeros((self.n_slots,), bool)
+        self._keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
+        self.free_slots = set(range(self.n_slots))
+
+    # ------------------------------------------------------------------ #
+    # program construction                                                #
+    # ------------------------------------------------------------------ #
+
+    def _prefill_body(self, vocab_gather=None):
+        """Shared prefill trace: slice the slot out of the pooled cache,
+        run the prompt through the model against it, splice the updated
+        slot back, sample the first token from the last REAL position."""
+        model, sample = self.model, self._sample
+
+        def body(params, caches, tokens, slot, length, key):
+            slot_c = [
+                {k: lax.dynamic_slice_in_dim(c[k], slot, 1, axis=0)
+                 for k in ("k", "v")}
+                for c in caches
+            ]
+            logits, slot_c = model.apply(params, tokens, 0,
+                                         kv_caches=slot_c)
+            caches = [
+                {k: lax.dynamic_update_slice_in_dim(c[k], s[k], slot, axis=0)
+                 for k in ("k", "v")}
+                for c, s in zip(caches, slot_c)
+            ]
+            # logits of the last PROMPT token, not the last padded row
+            lg = lax.dynamic_slice_in_dim(logits, length - 1, 1, axis=1)[:, 0]
+            if vocab_gather is not None:
+                lg = vocab_gather(lg)
+            nxt, key = sample(lg, key)
+            return caches, nxt[0], key
+
+        return body
+
+    def _decode_body(self, vocab_gather=None):
+        """Shared decode trace: one token for EVERY slot, per-slot
+        positions, per-slot sampler keys (each slot draws exactly like a
+        B=1 ``generate()`` so batching never perturbs a request)."""
+        model, sample = self.model, self._sample
+
+        def slot_sample(lg, key):
+            nxt, key = sample(lg[None], key)
+            return nxt[0], key
+
+        def body(params, caches, tokens, pos, active, keys):
+            lg, caches = model.apply(params, tokens[:, None], pos[:, None],
+                                     kv_caches=caches)
+            lg = lg[:, 0]
+            if vocab_gather is not None:
+                lg = vocab_gather(lg)
+            nxt, keys = jax.vmap(slot_sample)(lg, keys)
+            # free/retired slots ride along masked — shapes never change
+            nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+            return caches, nxt, keys
+
+        return body
+
+    def _build_fns(self):
+        prefill = jax.jit(self._prefill_body(), donate_argnums=(1,))
+        decode = jax.jit(self._decode_body(), donate_argnums=(1,))
+        return prefill, decode
+
+    def _init_tp_caches(self, comm):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = self.model.tensor_axis
+        n_tp = comm.mesh.shape[axis]
+        if self.model.n_heads % n_tp:
+            raise ValueError(
+                f"n_heads {self.model.n_heads} not divisible by "
+                f"tensor-axis size {n_tp}"
+            )
+        shard = NamedSharding(comm.mesh, P(None, None, axis))
+        self.caches = jax.device_put(
+            init_kv_caches(self.model, self.n_slots, self.cache_len), shard)
+
+    def _build_tp_fns(self, comm):
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.model.tensor_axis
+        gather = None
+        if self.model.vocab_parallel_head:
+            def gather(lg):
+                return lax.all_gather(lg, axis, axis=-1, tiled=True)
+
+        cache_spec = [{"k": P(None, None, axis), "v": P(None, None, axis)}
+                      for _ in range(self.model.n_layers)]
+        prefill = jax.jit(comm.shard_map(
+            self._prefill_body(gather),
+            in_specs=(P(), cache_spec, P(), P(), P(), P()),
+            out_specs=(cache_spec, P(), P()),
+            check_vma=False,
+        ), donate_argnums=(1,))
+        decode = jax.jit(comm.shard_map(
+            self._decode_body(gather),
+            in_specs=(P(), cache_spec, P(), P(), P(), P()),
+            out_specs=(cache_spec, P(), P()),
+            check_vma=False,
+        ), donate_argnums=(1,))
+        return prefill, decode
+
+    # ------------------------------------------------------------------ #
+    # slot API (host side)                                                #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def active_slots(self) -> int:
+        return int(self._active.sum())
+
+    def validate_request(self, prompt_len: int, max_new_tokens: int) -> None:
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if prompt_len > self.prefill_len:
+            raise ValueError(
+                f"prompt of {prompt_len} tokens exceeds prefill_len="
+                f"{self.prefill_len}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt_len + max_new_tokens > self.cache_len:
+            raise ValueError(
+                f"{prompt_len} prompt + {max_new_tokens} new tokens exceed "
+                f"cache_len={self.cache_len}"
+            )
+
+    def prefill(self, prompt: np.ndarray, rng) -> tuple[int, int]:
+        """Admit one prompt into a free slot: runs the compiled prefill,
+        returns ``(slot, first_token)``. ``rng`` is the request's own PRNG
+        key (its sampler split sequence matches a solo ``generate()``).
+        Raises ``RuntimeError`` when no slot is free — admission control
+        is the scheduler's job, not a silent queue here."""
+        if not self.free_slots:
+            raise RuntimeError("no free slot (scheduler admitted too many)")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.validate_request(len(prompt), 1)
+        slot = min(self.free_slots)  # deterministic pick: stable tests/replay
+        padded = np.zeros((1, self.prefill_len), np.int32)
+        padded[0, : len(prompt)] = prompt
+        self.caches, first, key = self._prefill_fn(
+            self.params, self.caches, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(len(prompt)), rng)
+        self.free_slots.discard(slot)
+        self._token[slot] = int(first)
+        self._pos[slot] = len(prompt)
+        self._active[slot] = True
+        self._keys = self._keys.at[slot].set(key)
+        return slot, int(first)
+
+    def decode_step(self) -> dict[int, int]:
+        """Advance every active slot one token (ONE compiled call for the
+        whole pool); returns ``{slot: token}`` for the active slots. No-op
+        ({}) when nothing is active."""
+        if not self._active.any():
+            return {}
+        self.caches, nxt, self._keys = self._decode_fn(
+            self.params, self.caches, jnp.asarray(self._token),
+            jnp.asarray(self._pos), jnp.asarray(self._active), self._keys)
+        nxt = np.asarray(nxt)
+        out = {}
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            tok = int(nxt[slot])
+            self._token[slot] = tok
+            self._pos[slot] += 1
+            out[slot] = tok
+        return out
+
+    def slot_tokens_used(self, slot: int) -> int:
+        """Current sequence depth of a slot (prompt + generated so far)."""
+        return int(self._pos[slot]) + 1 if self._active[slot] else 0
+
+    def release(self, slot: int) -> None:
+        """Retire a slot (EOS / length / cancellation). The cache is NOT
+        zeroed: the causal position mask makes stale rows unreachable to
+        the next tenant (module docstring — pinned by the slot-reuse
+        parity test)."""
+        if slot in self.free_slots:
+            return
+        self._active[slot] = False
+        self.free_slots.add(slot)
+
+    # ------------------------------------------------------------------ #
+    # observability                                                       #
+    # ------------------------------------------------------------------ #
+
+    def compile_counts(self) -> dict[str, int]:
+        """Executable counts of the two device programs — the
+        zero-recompile invariant is ``{'prefill': 1, 'decode': 1}`` after
+        warmup, asserted by tests and reported by the serving benchmark."""
+        return {
+            "prefill": int(self._prefill_fn._cache_size()),
+            "decode": int(self._decode_fn._cache_size()),
+        }
+
+
+__all__ = ["ServingEngine"]

@@ -1,0 +1,117 @@
+"""Serving observability: TTFT, per-token latency, throughput, queue depth
+and slot occupancy — the serving counterpart of the training side's
+``extensions.StepTimer``/``collective_stats`` layer, reporting through the
+same :func:`chainermn_tpu.extensions.latency_report` percentile convention
+so training and serving benchmark records stay field-compatible.
+
+All timestamps are caller-supplied ``time.perf_counter()`` values (the
+scheduler owns the clock); this module only aggregates, so it is trivially
+testable and thread-agnostic (the scheduler serializes all calls).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from chainermn_tpu.extensions import latency_report
+
+
+class ServingMetrics:
+    """Aggregate serving statistics.
+
+    Latency definitions (the standard inference-serving ones):
+
+    - **TTFT** (time to first token): request submission -> its first
+      generated token (queue wait + prefill; the admission-policy number).
+    - **TPOT** (time per output token): gap between consecutive tokens of
+      the SAME request (decode-step cadence; the streaming-smoothness
+      number). First tokens don't contribute (they're TTFT).
+    - **tokens/s**: generated tokens over the span between the first and
+      last recorded token across all requests (engine-level throughput;
+      0.0 until two tokens exist).
+
+    Gauges (queue depth, slot occupancy) are sampled once per scheduler
+    step and reported as means — occupancy is the fraction of the slot
+    pool decoding, the continuous-batching utilization number.
+    """
+
+    def __init__(self, n_slots: int) -> None:
+        self.n_slots = n_slots
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_cancelled = 0
+        self.tokens_generated = 0
+        self._ttft: list[float] = []
+        self._tpot: list[float] = []
+        self._queue_depth: list[int] = []
+        self._occupancy: list[float] = []
+        self._t_first_token: Optional[float] = None
+        self._t_last_token: Optional[float] = None
+
+    # ------------------------------------------------------------------ #
+    # recording (scheduler-driven)                                        #
+    # ------------------------------------------------------------------ #
+
+    def record_submit(self) -> None:
+        self.requests_submitted += 1
+
+    def record_first_token(self, t_submit: float, t_token: float) -> None:
+        self._ttft.append(t_token - t_submit)
+        self._record_token_time(t_token)
+        self.tokens_generated += 1
+
+    def record_token(self, t_prev_token: float, t_token: float) -> None:
+        self._tpot.append(t_token - t_prev_token)
+        self._record_token_time(t_token)
+        self.tokens_generated += 1
+
+    def record_done(self, cancelled: bool = False) -> None:
+        if cancelled:
+            self.requests_cancelled += 1
+        else:
+            self.requests_completed += 1
+
+    def record_step(self, queue_depth: int, active_slots: int) -> None:
+        self._queue_depth.append(queue_depth)
+        self._occupancy.append(active_slots / self.n_slots)
+
+    def _record_token_time(self, t: float) -> None:
+        if self._t_first_token is None:
+            self._t_first_token = t
+        self._t_last_token = t
+
+    # ------------------------------------------------------------------ #
+    # reporting                                                           #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def tokens_per_sec(self) -> float:
+        if self._t_first_token is None or self._t_last_token is None:
+            return 0.0
+        span = self._t_last_token - self._t_first_token
+        if span <= 0.0:
+            return 0.0
+        # the first token opens the span, the rest fill it
+        return (self.tokens_generated - 1) / span
+
+    def report(self) -> dict:
+        out = {
+            "requests_submitted": self.requests_submitted,
+            "requests_completed": self.requests_completed,
+            "requests_cancelled": self.requests_cancelled,
+            "tokens_generated": self.tokens_generated,
+            "tokens_per_sec": round(self.tokens_per_sec, 2),
+            "n_slots": self.n_slots,
+        }
+        out.update(latency_report(self._ttft, "ttft"))
+        out.update(latency_report(self._tpot, "tpot"))
+        if self._queue_depth:
+            out["queue_depth_mean"] = round(
+                sum(self._queue_depth) / len(self._queue_depth), 3)
+        if self._occupancy:
+            out["slot_occupancy_mean"] = round(
+                sum(self._occupancy) / len(self._occupancy), 3)
+        return out
+
+
+__all__ = ["ServingMetrics"]

@@ -1,0 +1,240 @@
+"""FCFS admission + request lifecycle over the slot-pool engine.
+
+The engine (:mod:`chainermn_tpu.serving.engine`) is pure mechanism: it
+advances whatever occupies its slots. This module is the policy layer — a
+first-come-first-served queue whose requests move through
+
+    QUEUED -> PREFILL -> DECODE -> DONE            (or CANCELLED)
+
+One :meth:`FCFSScheduler.step` is one engine round: fill every freed slot
+from the queue (one prefill each — prefill interleaves with decode at step
+granularity, the classic continuous-batching schedule), advance all active
+slots one token, deliver tokens to per-request streams, and retire slots
+whose request hit EOS or its token budget. Retirement frees the slot for
+the NEXT step's admissions, so the pool refills without ever waiting for
+the whole batch to finish — the property that separates this from the
+offline ``generate()`` path.
+
+Thread model: ``submit``/``cancel`` are safe from any thread (they only
+touch the locked queue and request state); ``step`` must be driven from
+ONE thread — the engine's device state is not concurrent. The in-process
+:class:`~chainermn_tpu.serving.client.ServingClient` owns that thread.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from chainermn_tpu.serving.metrics import ServingMetrics
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    DONE = "done"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class Request:
+    """One inference request and its full lifecycle state. Created by
+    :meth:`FCFSScheduler.submit`; treat as read-only outside the scheduler
+    (``wait()``/``output`` are the consumer surface)."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    rng: object = None                 # per-request PRNG key (solo-parity)
+    stream_cb: Optional[Callable[[int], None]] = None
+    id: int = -1
+    state: RequestState = RequestState.QUEUED
+    slot: int = -1
+    tokens: list = field(default_factory=list)
+    error: Optional[BaseException] = None
+    t_submit: float = 0.0
+    t_last_token: float = 0.0
+    _done: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def finished(self) -> bool:
+        return self.state in (RequestState.DONE, RequestState.CANCELLED)
+
+    @property
+    def output(self) -> np.ndarray:
+        """``prompt + generated`` tokens (the ``generate()``-shaped
+        result, without its trailing pad)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until DONE/CANCELLED (or error); True if finished."""
+        ok = self._done.wait(timeout)
+        if self.error is not None:
+            raise self.error
+        return ok
+
+
+class FCFSScheduler:
+    """First-come-first-served continuous-batching scheduler.
+
+    ``eos_id``: a request retires as soon as it samples this token (the
+    EOS is kept as its last token — matching ``generate(eos_id=...)``,
+    whose masked buffer holds the EOS then pads). Length retirement
+    (``max_new_tokens``) applies either way. Both are host-side policy
+    BETWEEN engine steps; inside the compiled programs shapes never
+    change (see the engine's ``jnp.where`` masking).
+    """
+
+    def __init__(self, engine, *, eos_id: Optional[int] = None,
+                 metrics: Optional[ServingMetrics] = None) -> None:
+        self.engine = engine
+        self.eos_id = eos_id
+        self.metrics = metrics or ServingMetrics(engine.n_slots)
+        self._queue: deque[Request] = deque()
+        self._by_slot: dict[int, Request] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+
+    # ------------------------------------------------------------------ #
+    # submission surface (any thread)                                     #
+    # ------------------------------------------------------------------ #
+
+    def submit(self, prompt, max_new_tokens: int, *, rng=None,
+               stream_cb: Optional[Callable[[int], None]] = None) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.engine.validate_request(len(prompt), max_new_tokens)
+        req = Request(
+            prompt=prompt, max_new_tokens=int(max_new_tokens),
+            rng=rng if rng is not None else jax.random.PRNGKey(0),
+            stream_cb=stream_cb,
+        )
+        req.t_submit = time.perf_counter()
+        with self._lock:
+            req.id = next(self._ids)
+            self._queue.append(req)
+            self.metrics.record_submit()
+        return req
+
+    def cancel(self, req: Request) -> bool:
+        """Cancel a request: dequeued if still QUEUED, slot freed if
+        decoding. False if it already finished."""
+        with self._lock:
+            if req.finished:
+                return False
+            if req.state is RequestState.QUEUED:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    return False
+            elif req.slot >= 0:
+                self.engine.release(req.slot)
+                self._by_slot.pop(req.slot, None)
+            # else: prefill in flight (no slot yet) — the step() admission
+            # path sees the CANCELLED state and releases the slot itself
+            req.state = RequestState.CANCELLED
+            self.metrics.record_done(cancelled=True)
+        req._done.set()
+        return True
+
+    @property
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._queue) or bool(self._by_slot)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ------------------------------------------------------------------ #
+    # the scheduling loop (one driving thread)                            #
+    # ------------------------------------------------------------------ #
+
+    def step(self) -> int:
+        """One continuous-batching round; returns tokens emitted (0 when
+        idle). Admissions first — freed slots refill BEFORE the decode
+        step, so a retirement's slot never sits idle for a step."""
+        emitted = 0
+        # 1. admission: one prefill per free slot, FCFS
+        while self.engine.free_slots:
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                req.state = RequestState.PREFILL
+            slot, first = self.engine.prefill(req.prompt, req.rng)
+            now = time.perf_counter()
+            with self._lock:
+                if req.state is RequestState.CANCELLED:
+                    # cancelled while its prefill was in flight (it had no
+                    # slot yet, so cancel() left the release to us)
+                    self.engine.release(slot)
+                    continue
+                req.slot = slot
+                self._by_slot[slot] = req
+                req.state = RequestState.DECODE
+            self.metrics.record_first_token(req.t_submit, now)
+            self._deliver(req, first, now)
+            emitted += 1
+        # 2. decode: every active slot, one token, one compiled call
+        for slot, tok in self.engine.decode_step().items():
+            req = self._by_slot.get(slot)
+            if req is None:            # released mid-flight (cancelled)
+                continue
+            now = time.perf_counter()
+            self.metrics.record_token(req.t_last_token, now)
+            self._deliver(req, tok, now)
+            emitted += 1
+        self.metrics.record_step(self.queue_depth, self.engine.active_slots)
+        return emitted
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> int:
+        """Drive ``step()`` until queue and slots drain; returns total
+        tokens emitted. The offline convenience loop (tests, benchmarks);
+        online serving drives ``step()`` from the client thread instead."""
+        total = 0
+        steps = 0
+        while self.has_work:
+            total += self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return total
+
+    # ------------------------------------------------------------------ #
+    # internals                                                           #
+    # ------------------------------------------------------------------ #
+
+    def _deliver(self, req: Request, tok: int, now: float) -> None:
+        req.tokens.append(int(tok))
+        req.t_last_token = now
+        if req.stream_cb is not None:
+            try:
+                req.stream_cb(int(tok))
+            except Exception:
+                pass  # a consumer's callback must not kill the engine loop
+        hit_eos = self.eos_id is not None and int(tok) == self.eos_id
+        if hit_eos or len(req.tokens) >= req.max_new_tokens:
+            self._retire(req)
+
+    def _retire(self, req: Request) -> None:
+        with self._lock:
+            if req.finished:   # a concurrent cancel() won the race
+                return
+            self.engine.release(req.slot)
+            self._by_slot.pop(req.slot, None)
+            req.state = RequestState.DONE
+            self.metrics.record_done()
+        req._done.set()
+
+
+__all__ = ["FCFSScheduler", "Request", "RequestState"]

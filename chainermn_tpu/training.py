@@ -18,6 +18,8 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+from chainermn_tpu.utils import axis_size as _axis_size
+from chainermn_tpu.utils import pcast_varying
 
 
 def classification_loss_fn(
@@ -87,7 +89,7 @@ def make_classification_train_step(
         # meshes) would be bypassed. pcast keeps the grads per-rank local so
         # the multi-node optimizer owns the one true reduction.
         params_v = jax.tree_util.tree_map(
-            lambda a: jax.lax.pcast(a, comm.axis_name, to="varying"), params
+            lambda a: pcast_varying(a, comm.axis_name), params
         )
         loss_fn = classification_loss_fn(
             model, rest, mutable, images, labels, train_kwargs, label_smoothing
@@ -153,7 +155,7 @@ def _shard_positions(model, seq_axis, t_local):
     if getattr(model, "attention", None) in ("zigzag", "zigzag_flash"):
         from chainermn_tpu.parallel.sequence import zigzag_positions
 
-        return zigzag_positions(idx, jax.lax.axis_size(seq_axis), t_local)
+        return zigzag_positions(idx, _axis_size(seq_axis), t_local)
     return idx * t_local
 
 
@@ -358,7 +360,7 @@ def jit_lm_train_step(
         )
         # varying view for local grads — see make_classification_train_step
         params_v = jax.tree_util.tree_map(
-            lambda a: jax.lax.pcast(a, comm.axis_name, to="varying"), params
+            lambda a: pcast_varying(a, comm.axis_name), params
         )
 
         def loss_fn(p):

@@ -26,6 +26,37 @@ def apply_env_platform() -> None:
         pass  # backend already up; the env var did its job or it's too late
 
 
+def axis_size(axis_name):
+    """``lax.axis_size`` across JAX generations: legacy 0.4.x lacks it —
+    ``psum(1, axis)`` is the classic equivalent (and raises the same
+    ``NameError`` outside a bound axis context, which callers rely on to
+    detect "not inside shard_map")."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pcast_varying(x, axes):
+    """``lax.pcast(x, axes, to='varying')`` across JAX generations.
+
+    New JAX tracks per-value varying manner (vma) inside ``shard_map`` and
+    needs the explicit cast wherever a replicated value enters a per-rank
+    computation whose gradients must STAY per-rank (training.py's grad
+    pattern, the pipeline scan carry). Legacy 0.4.x has no vma — and the
+    framework runs its legacy shard_maps with ``check_rep=False`` (see
+    ``mesh_communicator._shard_map``), where every value is per-rank by
+    default — so the cast is the identity there.
+    """
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        axes = (axes,) if isinstance(axes, str) else tuple(axes)
+        return jax.lax.pcast(x, axes, to="varying")
+    return x
+
+
 def ensure_batch_fits(dataset, global_batch: int, size: int = 1) -> None:
     """Fail fast when the global batch exceeds the dataset: every batch would
     be a ragged tail (which training loops skip, matching the reference's
@@ -43,4 +74,5 @@ def ensure_batch_fits(dataset, global_batch: int, size: int = 1) -> None:
         )
 
 
-__all__ = ["apply_env_platform", "ensure_batch_fits"]
+__all__ = ["apply_env_platform", "axis_size", "ensure_batch_fits",
+           "pcast_varying"]

@@ -134,6 +134,32 @@ def test_moe_gshard_generate(lm_and_params):
         generate(ep, params, prompt, 2)
 
 
+def test_eos_early_stop(lm_and_params):
+    """EOS masking (the serving engine's retirement contract): once a row
+    samples eos_id, every later position stays pad (0) — the row stops
+    contributing changed tokens — while other rows keep decoding
+    unperturbed; cached and cacheless paths agree under the masking."""
+    lm, params, prompt = lm_and_params
+    base = generate(lm, params, prompt, 8)
+    # pick row 0's second generated token as EOS: stops row 0 mid-stream
+    eos = int(base[0, prompt.shape[1] + 1])
+    out = generate(lm, params, prompt, 8, eos_id=eos)
+    out_nc = generate(lm, params, prompt, 8, eos_id=eos, use_cache=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out_nc))
+    for row_base, row in zip(np.asarray(base), np.asarray(out)):
+        gen_b, gen = list(row_base[3:]), list(row[3:])
+        if eos in gen_b:
+            cut = gen_b.index(eos)
+            # identical up to and including EOS, pad-frozen after
+            assert gen[: cut + 1] == gen_b[: cut + 1]
+            assert gen[cut + 1:] == [0] * (len(gen) - cut - 1)
+        else:
+            assert gen == gen_b  # untouched rows decode identically
+    assert eos in list(np.asarray(out)[0, 3:])  # the stop actually fired
+    with pytest.raises(ValueError, match="eos_id"):
+        generate(lm, params, prompt, 2, eos_id=99)
+
+
 def test_top_k_top_p_sampling(lm_and_params):
     """Sampler truncation semantics end-to-end: top_k=1 and a tiny top_p
     both reduce to greedy for ANY rng; cached == cacheless under combined

@@ -11,12 +11,23 @@ import socket
 import subprocess
 import sys
 
+import jax
+import pytest
+
 import chainermn_tpu
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "worker_traced.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+_requires_cpu_multiprocess = pytest.mark.skipif(
+    not hasattr(jax, "typeof"),
+    reason="legacy jaxlib: 'Multiprocess computations aren't implemented "
+    "on the CPU backend' — the emulated multi-controller harness needs a "
+    "newer runtime",
+)
 
 
 def _free_port() -> int:
@@ -28,6 +39,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@_requires_cpu_multiprocess
 def test_multicontroller_traced_training(tmp_path):
     from tests.multiprocess_tests import worker_traced
 

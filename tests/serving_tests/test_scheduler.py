@@ -1,0 +1,113 @@
+"""Scheduler policy: FCFS order, state machine, cancellation, metrics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.models import TransformerLM
+from chainermn_tpu.serving import FCFSScheduler, RequestState, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=1,
+                       max_len=32, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make(lm, params, n_slots=2, **kw):
+    engine = ServingEngine(lm, params, n_slots=n_slots, prefill_len=6,
+                           cache_len=24)
+    return engine, FCFSScheduler(engine, **kw)
+
+
+def test_fcfs_admission_order(lm_and_params):
+    """With one slot, requests are admitted strictly in submission order
+    (each must fully finish before the next starts)."""
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1)
+    order = []
+    reqs = [sched.submit(np.array([1 + i]), 2,
+                         stream_cb=lambda tok, i=i: order.append(i))
+            for i in range(4)]
+    sched.run_until_idle()
+    assert order == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert [r.state for r in reqs] == [RequestState.DONE] * 4
+
+
+def test_state_machine_transitions(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1)
+    r1 = sched.submit(np.array([1, 2]), 3)
+    r2 = sched.submit(np.array([3, 4]), 3)
+    assert r1.state is RequestState.QUEUED
+    sched.step()   # admits r1 (prefill -> decode), r2 still queued
+    assert r1.state is RequestState.DECODE and r1.slot == 0
+    assert r2.state is RequestState.QUEUED
+    assert sched.queue_depth == 1
+    sched.run_until_idle()
+    assert r1.state is RequestState.DONE and r2.state is RequestState.DONE
+    assert not sched.has_work
+    assert len(r1.tokens) == 3 and len(r2.tokens) == 3
+
+
+def test_cancel_queued_and_active(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1)
+    r1 = sched.submit(np.array([1, 2]), 10)
+    r2 = sched.submit(np.array([3, 4]), 10)
+    sched.step()
+    assert sched.cancel(r2)            # still queued: dequeued
+    assert r2.state is RequestState.CANCELLED
+    assert sched.cancel(r1)            # decoding: slot freed immediately
+    assert r1.state is RequestState.CANCELLED
+    assert engine.free_slots == {0}
+    assert not sched.has_work
+    assert not sched.cancel(r1)        # idempotent: already finished
+    m = sched.metrics.report()
+    assert m["requests_cancelled"] == 2 and m["requests_completed"] == 0
+
+
+def test_retirement_frees_slot_for_next_admission(lm_and_params):
+    """A retirement and the next admission happen in the SAME step window:
+    the pool never idles a freed slot for a full step."""
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1)
+    r1 = sched.submit(np.array([1, 2]), 1)    # retires at its prefill
+    r2 = sched.submit(np.array([3, 4]), 1)
+    n = sched.step()
+    # one step admitted AND retired both: each produced its single token
+    assert n == 2 and r1.finished and r2.finished
+
+
+def test_metrics_report_shape(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=2)
+    for i in range(3):
+        sched.submit(np.array([1 + i, 2 + i]), 4)
+    sched.run_until_idle()
+    m = sched.metrics.report()
+    assert m["requests_submitted"] == 3
+    assert m["requests_completed"] == 3
+    assert m["tokens_generated"] == 12
+    assert m["tokens_per_sec"] > 0
+    for k in ("ttft_mean_s", "ttft_p50_s", "ttft_p99_s",
+              "tpot_p50_s", "tpot_p99_s"):
+        assert m[k] >= 0.0, k
+    assert 0.0 < m["slot_occupancy_mean"] <= 1.0
+    assert m["n_slots"] == 2
+
+
+def test_submit_validates_against_engine(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params)
+    with pytest.raises(ValueError, match="prefill_len"):
+        sched.submit(np.arange(1, 9), 2)     # 8 > prefill_len=6
+    with pytest.raises(ValueError, match="cache_len"):
+        sched.submit(np.array([1, 2]), 100)  # budget over slot capacity
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        sched.submit(np.array([1, 2]), 0)
+    assert not sched.has_work  # nothing leaked into the queue

@@ -53,6 +53,43 @@ def test_bench_smoke_tiny_cpu():
     assert "double_buffering_speedup" in rec
 
 
+def test_bench_serving_mode_smoke():
+    """``bench.py --mode serving`` (acceptance criterion): one parseable
+    JSON record with tokens/s, TTFT p50/p99, and slot occupancy on the
+    emulated CPU mesh — the serving perf baseline's harness, pinned so a
+    bench-side regression is caught in CI, not on a chip window."""
+    env = dict(
+        os.environ,
+        CHAINERMN_TPU_BENCH_PLATFORM="cpu",
+        CHAINERMN_TPU_SERVE_SLOTS="4",
+        CHAINERMN_TPU_SERVE_REQUESTS="10",
+        CHAINERMN_TPU_SERVE_PREFILL_LEN="8",
+        CHAINERMN_TPU_SERVE_MAX_NEW="8",
+        CHAINERMN_TPU_SERVE_VOCAB="64",
+        CHAINERMN_TPU_SERVE_DMODEL="32",
+        CHAINERMN_TPU_SERVE_LAYERS="2",
+        CHAINERMN_TPU_SERVE_HEADS="4",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--mode", "serving"],
+        env=env, capture_output=True, text=True, timeout=540, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "serving_decode_throughput"
+    assert rec["unit"] == "tokens/sec"
+    assert rec["value"] and rec["value"] > 0
+    assert rec["n_chips"] == 8
+    assert rec["n_slots"] == 4 and rec["n_requests"] == 10
+    assert rec["ttft_p50_ms"] > 0 and rec["ttft_p99_ms"] >= rec["ttft_p50_ms"]
+    assert rec["tpot_p50_ms"] > 0
+    assert 0 < rec["slot_occupancy"] <= 1
+    assert rec["tokens_generated"] > 0
+    # the zero-recompile invariant travels with the perf record
+    assert rec["recompiles"] == {"prefill": 1, "decode": 1}
+
+
 def test_persist_measured_is_tpu_only(tmp_path, monkeypatch):
     """The evidence file must only ever hold real-chip records: a tiny-CPU
     smoke run (this very suite) once displaced the round's TPU measurement.
