@@ -166,6 +166,8 @@ def _measure(model, comm, batch, *, double_buffering, n_steps, warmup=3,
     import chainermn_tpu
     from chainermn_tpu.training import jit_train_step
 
+    from chainermn_tpu.monitor import instrument
+
     rng = jax.random.PRNGKey(0)
     images = jax.random.normal(
         rng, (batch, image_size, image_size, 3), jnp.bfloat16
@@ -207,6 +209,11 @@ def _measure(model, comm, batch, *, double_buffering, n_steps, warmup=3,
             cs = parse_hlo_collectives(step.as_text())
         except Exception as e:
             log(f"collective_stats unavailable: {e}")
+    # The AOT-compiled executable bypasses jit_train_step's own monitored
+    # wrapper, so instrument it here: the measured loop feeds the step
+    # counter/histogram every record embeds as its "monitor" block. (Wrapper
+    # cost is host-side dict/deque ops — noise against a real step.)
+    step = instrument(step, "bench_train_step")
     # Timing closes with a device->host FETCH of the loss, not
     # block_until_ready: through the axon tunnel block_until_ready can
     # return on the relay's ack before the device finishes (observed: 50
@@ -353,6 +360,14 @@ def child_main() -> None:
         }
         if tiny:
             rec["tiny"] = True  # CI smoke run, not a real measurement
+        # acceptance: every mode's record carries the registry snapshot
+        # (step counters, step-time percentiles, device-memory gauges)
+        try:
+            from chainermn_tpu.monitor import snapshot as monitor_snapshot
+
+            rec["monitor"] = monitor_snapshot()
+        except Exception as e:
+            log(f"monitor snapshot unavailable: {e}")
         if h["step_flops_per_device"]:
             achieved = h["step_flops_per_device"] / (h["step_time_ms"] / 1e3)
             rec["achieved_tflops_per_chip"] = round(achieved / 1e12, 2)
@@ -602,9 +617,14 @@ def serving_main() -> None:
             "tpot_p50_ms": round(m["tpot_p50_s"] * 1e3, 3),
             "tpot_p99_ms": round(m["tpot_p99_s"] * 1e3, 3),
             "slot_occupancy": m["slot_occupancy_mean"],
+            "slot_occupancy_p99": m["slot_occupancy_p99"],
             "queue_depth_mean": m["queue_depth_mean"],
+            "queue_depth_p99": m["queue_depth_p99"],
             "recompiles": engine.compile_counts(),
         }
+        from chainermn_tpu.monitor import snapshot as monitor_snapshot
+
+        record["monitor"] = monitor_snapshot()
     except Exception as exc:  # one parseable line, never a bare traceback
         log(f"serving bench failed: {type(exc).__name__}: {exc}")
         record = {
@@ -612,6 +632,158 @@ def serving_main() -> None:
             "value": None,
             "unit": "tokens/sec",
             "mode": "serving",
+            "error": type(exc).__name__,
+            "detail": str(exc)[-500:],
+        }
+        print(json.dumps(record))
+        raise SystemExit(1)
+    print(json.dumps(record))
+    _scratch_write(record)
+
+
+def monitor_main() -> None:
+    """``bench.py --mode monitor``: telemetry-subsystem smoke cell.
+
+    Proves, in one JSON record, the two monitor acceptance criteria that
+    need a live workload: (1) **overhead** — the same compiled LM train
+    step timed bare vs through ``monitor.instrument`` (events + metrics +
+    recompile tracking), reported as ``overhead_frac`` (<2% is the
+    production target; the CI assertion uses a generous bound because
+    millisecond CPU steps are noisy); (2) **flight recorder** — a serving
+    burst runs with monitoring on, then a simulated hang inside a
+    watchdog-armed window must dump the last events (slot admits/retires
+    included) + per-device memory stats. The record also embeds the full
+    registry ``snapshot`` like every other mode.
+
+    Knobs: ``CHAINERMN_TPU_MONITOR_STEPS`` (timed steps per side, default
+    30) and the ``CHAINERMN_TPU_SERVE_*`` sizes shared with serving mode.
+    The ``slow``-marked soak variant in tests/test_bench_smoke.py raises
+    the step/request counts through these.
+    """
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    import io
+
+    import numpy as np
+
+    import jax
+
+    plat = os.environ.get("CHAINERMN_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    enable_compilation_cache(jax)
+
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu import monitor
+    from chainermn_tpu.extensions import Watchdog
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.serving import FCFSScheduler, ServingEngine
+    from chainermn_tpu.training import jit_lm_train_step
+
+    e = os.environ.get
+    n_steps = int(e("CHAINERMN_TPU_MONITOR_STEPS", "30"))
+    n_slots = int(e("CHAINERMN_TPU_SERVE_SLOTS", "4"))
+    n_requests = int(e("CHAINERMN_TPU_SERVE_REQUESTS", "12"))
+    prefill_len = int(e("CHAINERMN_TPU_SERVE_PREFILL_LEN", "8"))
+    max_new = int(e("CHAINERMN_TPU_SERVE_MAX_NEW", "8"))
+    vocab = int(e("CHAINERMN_TPU_SERVE_VOCAB", "64"))
+    d_model = int(e("CHAINERMN_TPU_SERVE_DMODEL", "64"))
+    n_layers = int(e("CHAINERMN_TPU_SERVE_LAYERS", "2"))
+    n_heads = int(e("CHAINERMN_TPU_SERVE_HEADS", "4"))
+
+    devs = jax.devices()
+    log(f"monitor smoke: devices={len(devs)} kind={devs[0].device_kind!r} "
+        f"steps={n_steps} requests={n_requests}")
+    try:
+        # ---- overhead: bare jitted step vs instrumented wrapper -------- #
+        lm = TransformerLM(vocab_size=vocab, d_model=d_model,
+                           n_heads=n_heads, n_layers=n_layers,
+                           max_len=prefill_len + max_new)
+        comm = chainermn_tpu.create_communicator("tpu")
+        tokens = jnp.zeros((8 * max(len(devs), 1), 16), jnp.int32)
+        targets = jnp.zeros_like(tokens)
+        params = comm.bcast_data(
+            lm.init(jax.random.PRNGKey(0), tokens[:1]))
+        opt = optax.sgd(0.1)
+        opt_state = jax.device_put(opt.init(params), comm.named_sharding())
+        bare = jit_lm_train_step(lm, opt, comm, donate=False,
+                                 monitored=False)
+        mon = monitor.instrument(bare, "lm_train_step")  # same jit cache
+
+        def timed(step, k):
+            best = None
+            for _ in range(2):  # best-of-2 damps scheduler noise
+                t0 = time.perf_counter()
+                for _ in range(k):
+                    p, s, loss, _ = step(params, opt_state, tokens, targets)
+                float(loss)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            return best
+
+        timed(bare, 3)  # compile + warm both paths (same executable)
+        timed(mon, 3)
+        t_bare = timed(bare, n_steps)
+        t_mon = timed(mon, n_steps)
+        overhead = (t_mon - t_bare) / t_bare
+        log(f"monitored step overhead: {overhead:+.2%} "
+            f"({t_mon / n_steps * 1e3:.3f} vs {t_bare / n_steps * 1e3:.3f} "
+            "ms/step)")
+
+        # ---- serving burst + simulated hang -> flight recorder --------- #
+        sink = io.StringIO()
+        # engine watchdog: genuinely armed around every device call, but
+        # sized not to fire on warmup compiles (this cell proves wiring,
+        # not hangs); the short-fuse dog below simulates the actual hang
+        engine_dog = Watchdog(timeout=120.0, on_timeout="warn", _sink=sink)
+        dog = Watchdog(timeout=0.25, on_timeout="warn", _sink=sink)
+        eng_params = lm.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, prefill_len), jnp.int32))
+        engine = ServingEngine(lm, eng_params, n_slots=n_slots,
+                               prefill_len=prefill_len, watchdog=engine_dog)
+        sched = FCFSScheduler(engine)
+        rng = np.random.RandomState(0)
+        for _ in range(n_requests):
+            prompt = rng.randint(1, vocab, rng.randint(1, prefill_len + 1))
+            sched.submit(prompt.astype(np.int32),
+                         int(rng.randint(1, max_new + 1)))
+        sched.run_until_idle()
+        with dog.step("simulated hang (monitor smoke)"):
+            time.sleep(0.6)   # > timeout: watchdog fires and dumps
+        flight = sink.getvalue()
+        flight_events = sum(
+            1 for line in flight.splitlines() if line.startswith("{"))
+        snap = monitor.snapshot()
+        steps_counted = sum(
+            v for k, v in snap["counters"].items()
+            if k.startswith("steps_total"))
+        record = {
+            "metric": "monitor_smoke",
+            "value": steps_counted,
+            "unit": "monitored_steps",
+            "mode": "monitor",
+            "n_chips": len(devs),
+            "device_kind": devs[0].device_kind,
+            "overhead_frac": round(overhead, 4),
+            "step_time_ms": round(t_bare / n_steps * 1e3, 3),
+            "watchdog_fired": dog.fired,
+            "flight_events_in_dump": flight_events,
+            "flight_has_slot_admit": '"kind": "slot_admit"' in flight,
+            "flight_has_slot_retire": '"kind": "slot_retire"' in flight,
+            "flight_has_memory": "device memory" in flight,
+            "serving": sched.metrics.report(),
+            "recompiles": engine.compile_counts(),
+            "monitor": snap,
+        }
+    except Exception as exc:  # one parseable line, never a bare traceback
+        log(f"monitor smoke failed: {type(exc).__name__}: {exc}")
+        record = {
+            "metric": "monitor_smoke",
+            "value": None,
+            "unit": "monitored_steps",
+            "mode": "monitor",
             "error": type(exc).__name__,
             "detail": str(exc)[-500:],
         }
@@ -910,8 +1082,8 @@ def parent_main() -> None:
 
 
 def _cli_mode(argv) -> str:
-    """``--mode serving`` / ``--mode=serving`` (default: the ResNet
-    training benchmark with its retry-parent machinery)."""
+    """``--mode serving`` / ``--mode monitor`` / ``--mode=...`` (default:
+    the ResNet training benchmark with its retry-parent machinery)."""
     for i, a in enumerate(argv):
         if a == "--mode" and i + 1 < len(argv):
             return argv[i + 1]
@@ -924,8 +1096,10 @@ def main() -> None:
     mode = _cli_mode(sys.argv[1:])
     if mode == "serving":
         serving_main()
+    elif mode == "monitor":
+        monitor_main()
     elif mode != "train":
-        raise SystemExit(f"unknown --mode {mode!r} (train|serving)")
+        raise SystemExit(f"unknown --mode {mode!r} (train|serving|monitor)")
     elif "--child" in sys.argv:
         # child stdout carries ONLY the JSON record; everything else is stderr
         child_main()

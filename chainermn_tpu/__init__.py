@@ -20,6 +20,7 @@ from chainermn_tpu.extensions import (
     create_multi_node_checkpointer,
 )
 from chainermn_tpu.global_except_hook import add_hook as add_global_except_hook
+from chainermn_tpu import monitor
 from chainermn_tpu.iterators import (
     SerialIterator,
     create_multi_node_iterator,
@@ -77,5 +78,6 @@ __all__ = [
     "create_multi_node_checkpointer",
     "add_global_except_hook",
     "functions",
+    "monitor",
     "__version__",
 ]

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.communicators import _object_comm
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase, ReduceOp
+from chainermn_tpu.monitor import annotate
 from chainermn_tpu.parallel import mesh as mesh_lib
 
 
@@ -217,12 +218,19 @@ class MeshCommunicator(CommunicatorBase):
     # Traced collective bodies (group-aware)                              #
     # ------------------------------------------------------------------ #
 
+    # Every traced collective body is wrapped in monitor.annotate: the XLA
+    # ops carry a ``chainermn.<op>`` scope in their HLO metadata, so an
+    # XProf/Perfetto capture shows WHICH framework collective a device-time
+    # span belongs to. (Scope names avoid hyphenated opcode spellings so
+    # parse_hlo_collectives' text scan can never match them.)
+
     def _gathered(self, x):
         """all_gather giving every rank the full [size, ...] stack; the
         building block for ops XLA lacks a grouped/native primitive for."""
-        return lax.all_gather(
-            x, self._axes, axis_index_groups=self._groups, tiled=False
-        )
+        with annotate("chainermn.allgather"):
+            return lax.all_gather(
+                x, self._axes, axis_index_groups=self._groups, tiled=False
+            )
 
     def _grouped_sum(self, x):
         """Group-scoped sum with ring-allreduce wire cost (~2x payload).
@@ -310,6 +318,10 @@ class MeshCommunicator(CommunicatorBase):
         return full.reshape(a.shape)
 
     def _t_allreduce(self, x, op: ReduceOp):
+        with annotate(f"chainermn.allreduce_{op}"):
+            return self._t_allreduce_body(x, op)
+
+    def _t_allreduce_body(self, x, op: ReduceOp):
         if op == "prod":
             return self._prod(x)
         if self._groups is None:
@@ -341,13 +353,14 @@ class MeshCommunicator(CommunicatorBase):
         # reduce-scatter/all-gather decomposition. (A true collective-
         # broadcast would halve wire bytes, but JAX exposes neither
         # collective-broadcast nor multi-destination ppermute.)
-        mask = self.axis_index() == root
-        masked = jax.tree_util.tree_map(
-            lambda a: jnp.where(mask, a, jnp.zeros_like(a)), x
-        )
-        if self._groups is None:
-            return lax.psum(masked, self._axes)
-        return self._grouped_sum(masked)
+        with annotate("chainermn.bcast"):
+            mask = self.axis_index() == root
+            masked = jax.tree_util.tree_map(
+                lambda a: jnp.where(mask, a, jnp.zeros_like(a)), x
+            )
+            if self._groups is None:
+                return lax.psum(masked, self._axes)
+            return self._grouped_sum(masked)
 
     def _t_gather(self, x, root: int):
         del root  # SPMD: the stack is global; "root-ness" is a sharding choice
@@ -365,28 +378,31 @@ class MeshCommunicator(CommunicatorBase):
             raise ValueError(
                 f"scatter input leading axis {x.shape[0]} != comm size {self.size}"
             )
-        mask = self.axis_index() == root
-        masked = jnp.where(mask, x, jnp.zeros_like(x))
-        return lax.psum_scatter(
-            masked, self._axes, scatter_dimension=0, tiled=False,
-            axis_index_groups=self._groups,
-        )
+        with annotate("chainermn.scatter"):
+            mask = self.axis_index() == root
+            masked = jnp.where(mask, x, jnp.zeros_like(x))
+            return lax.psum_scatter(
+                masked, self._axes, scatter_dimension=0, tiled=False,
+                axis_index_groups=self._groups,
+            )
 
     def _t_alltoall(self, x):
         if x.shape[0] != self.size:
             raise ValueError(
                 f"alltoall input leading axis {x.shape[0]} != comm size {self.size}"
             )
-        return lax.all_to_all(
-            x, self._axes, split_axis=0, concat_axis=0, tiled=True,
-            axis_index_groups=self._groups,
-        )
+        with annotate("chainermn.alltoall"):
+            return lax.all_to_all(
+                x, self._axes, split_axis=0, concat_axis=0, tiled=True,
+                axis_index_groups=self._groups,
+            )
 
     def _t_ppermute(self, x, perm: Sequence[tuple[int, int]]):
         """Group-local perm pairs -> global pairs when split."""
         if self._groups is not None:
             perm = [(g[s], g[d]) for g in self._groups for (s, d) in perm]
-        return lax.ppermute(x, self._axes, perm=list(perm))
+        with annotate("chainermn.ppermute"):
+            return lax.ppermute(x, self._axes, perm=list(perm))
 
     # ------------------------------------------------------------------ #
     # Eager harness: rank-major arrays through cached jit(shard_map)      #

@@ -36,6 +36,18 @@ def _make_hook(prev_hook, exit_code: int):
                 prev_hook(exctype, value, tb)  # prior hook owns the printing
             else:
                 traceback.print_exception(exctype, value, tb)
+            # Flight recorder: if the monitor subsystem was in use, append
+            # the last events + device memory so the crash record says what
+            # the process was doing, not just where it raised. Only when
+            # already imported — a bare crash must not drag telemetry in.
+            mon = sys.modules.get("chainermn_tpu.monitor")
+            if mon is not None:
+                try:
+                    log = mon.get_event_log()
+                    if len(log):
+                        log.dump(file=sys.stderr)
+                except Exception:
+                    pass
             sys.stderr.flush()
             sys.stdout.flush()
         finally:

@@ -1,0 +1,110 @@
+"""``chainermn_tpu.monitor`` — the unified telemetry subsystem.
+
+The reference ChainerMN ships no observability of its own (SURVEY.md S5:
+users bolt on Chainer hooks + nvprof; a lost collective hangs silently).
+PR 1 left good but disconnected primitives (``extensions.profiling``,
+``serving.metrics``); this package is the spine that connects them, in
+four pillars:
+
+- **Metrics** (:class:`MetricsRegistry`): process-wide counters / gauges /
+  histograms with labels, JSON :func:`snapshot`, Prometheus-style
+  :func:`exposition`, and cross-rank :func:`aggregate` (fleet-wide p50/p99
+  on rank 0 over the communicator's object transport, merged with the
+  ``latency_report`` field convention so records stay ``BENCH_*.json``-
+  compatible).
+- **Events** (:class:`EventLog`): a bounded ring of structured events
+  (step start/end, prefill/decode, slot admit/retire, compile, watchdog
+  arm/fire) dumped automatically — last N events + per-device
+  ``memory_stats()`` — when ``Watchdog`` fires or ``global_except_hook``
+  trips.
+- **Profiler annotations** (:func:`annotate`): ``TraceAnnotation`` +
+  ``named_scope`` in one context manager (no-op fallback on legacy JAX),
+  permanently on inside train steps, serving prefill/decode, the
+  scheduler's admit loop, and every ``MeshCommunicator`` collective.
+- **Recompile + memory tracking** (:class:`RecompileGuard`,
+  :func:`record_memory_gauges`): executable-cache growth as a counted,
+  event-logged signal (the serving zero-recompile assertion, generalized),
+  plus periodic device-memory gauges.
+
+The per-step hot-path cost is a few dict/deque operations (<2% step time
+even on millisecond CPU steps — asserted by ``bench.py --mode monitor``);
+everything heavier happens at reporting or failure time.
+
+Usage::
+
+    from chainermn_tpu import monitor
+
+    step = monitor.instrument(step, "train")      # events+metrics+recompiles
+    with monitor.annotate("chainermn.eval"):      # profiler region
+        ...
+    monitor.emit("checkpoint", path=p)            # structured event
+    print(monitor.exposition())                   # Prometheus text
+    record["monitor"] = monitor.snapshot()        # JSON block
+    fleet = monitor.aggregate(comm)               # rank-0 fleet percentiles
+"""
+
+from __future__ import annotations
+
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.monitor.annotations import annotate
+from chainermn_tpu.monitor.events import EventLog, device_memory_lines
+from chainermn_tpu.monitor.instrument import (
+    MonitoredFunction,
+    RecompileGuard,
+    instrument,
+    record_memory_gauges,
+)
+from chainermn_tpu.monitor.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    merge_rank_payloads,
+)
+
+
+def emit(kind: str, **fields) -> None:
+    """Emit a structured event into the default flight recorder."""
+    get_event_log().emit(kind, **fields)
+
+
+def snapshot(memory: bool = True) -> dict:
+    """JSON-able snapshot of the default registry (refreshing the
+    device-memory gauges first unless ``memory=False``) — the block every
+    ``bench.py`` mode embeds in its record."""
+    if memory:
+        record_memory_gauges(get_registry())
+    return get_registry().snapshot()
+
+
+def exposition() -> str:
+    """Prometheus text exposition of the default registry."""
+    return get_registry().exposition()
+
+
+def aggregate(comm) -> dict:
+    """Fleet-wide merge of the default registry across ranks (counters
+    summed, gauges averaged, histogram percentiles over pooled samples)."""
+    return get_registry().aggregate(comm)
+
+
+__all__ = [
+    "Counter",
+    "EventLog",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MonitoredFunction",
+    "RecompileGuard",
+    "aggregate",
+    "annotate",
+    "device_memory_lines",
+    "emit",
+    "exposition",
+    "get_event_log",
+    "get_registry",
+    "instrument",
+    "merge_rank_payloads",
+    "record_memory_gauges",
+    "snapshot",
+]

@@ -1,0 +1,29 @@
+"""Process-wide default telemetry sinks.
+
+Separate from the package facade so submodules (and the subsystems they
+instrument: serving, training, communicators) can reach the singletons
+without importing ``chainermn_tpu.monitor``'s ``__init__`` — which may be
+mid-initialization when the communicator layer first pulls monitor in.
+"""
+
+from __future__ import annotations
+
+from chainermn_tpu.monitor.events import EventLog
+from chainermn_tpu.monitor.registry import MetricsRegistry
+
+_REGISTRY = MetricsRegistry()
+_EVENTS = EventLog()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default :class:`MetricsRegistry`."""
+    return _REGISTRY
+
+
+def get_event_log() -> EventLog:
+    """The process-wide default :class:`EventLog` (the flight recorder
+    Watchdog/global_except_hook dump)."""
+    return _EVENTS
+
+
+__all__ = ["get_registry", "get_event_log"]

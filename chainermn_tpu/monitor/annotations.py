@@ -1,0 +1,75 @@
+"""Profiler annotations: one context manager for host AND traced code.
+
+``annotate(name)`` enters both ``jax.profiler.TraceAnnotation`` (host-side
+TraceMe — the region shows up on the Python/host rows of an XProf/Perfetto
+capture) and ``jax.named_scope`` (trace-time name stack — the region's XLA
+ops carry the name in their metadata, so device rows are legible too).
+Either half degrades to a no-op when the running JAX lacks it (legacy
+releases), and entering them is cheap when no profiler is attached, so the
+annotations stay on permanently in the hot paths (train step bodies,
+serving prefill/decode, communicator collectives).
+
+Scope names deliberately avoid XLA collective opcode spellings
+(``all-reduce`` etc.): names land in HLO ``op_name`` metadata, and
+:func:`~chainermn_tpu.extensions.profiling.parse_hlo_collectives` scans raw
+HLO text — ``chainermn.allreduce`` can never collide with ``all-reduce(``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class _Annotation:
+    """Re-entrant-constructible, single-use context manager pair."""
+
+    __slots__ = ("_name", "_tm", "_ns")
+
+    def __init__(self, name: str) -> None:
+        self._name = name
+        self._tm = None
+        self._ns = None
+
+    def __enter__(self) -> "_Annotation":
+        try:
+            tm = jax.profiler.TraceAnnotation(self._name)
+            tm.__enter__()
+            self._tm = tm
+        except Exception:
+            self._tm = None
+        try:
+            ns = jax.named_scope(self._name)
+            ns.__enter__()
+            self._ns = ns
+        except Exception:
+            self._ns = None
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._ns is not None:
+            try:
+                self._ns.__exit__(*exc)
+            finally:
+                self._ns = None
+        if self._tm is not None:
+            try:
+                self._tm.__exit__(*exc)
+            finally:
+                self._tm = None
+
+
+def annotate(name: str) -> _Annotation:
+    """Name a region for profiling::
+
+        with monitor.annotate("chainermn.decode"):
+            ...   # host call OR traced computation
+
+    Inside a trace the enclosed ops get ``name`` in their HLO metadata
+    (named_scope); around a host call the region appears on the host
+    timeline (TraceAnnotation). No-op fallback on JAX builds lacking
+    either API.
+    """
+    return _Annotation(str(name))
+
+
+__all__ = ["annotate"]

@@ -1,0 +1,119 @@
+"""Structured event log + flight recorder.
+
+A bounded in-memory ring of structured events (step start/end,
+prefill/decode, slot admit/retire, compile, watchdog arm/fire) that costs
+one deque append per event while healthy, and is dumped — last N events as
+JSONL plus per-device ``memory_stats()`` — the moment something goes wrong:
+:class:`~chainermn_tpu.extensions.profiling.Watchdog` firing, or
+``global_except_hook`` tripping. A hang or crash then prints *what the
+system was doing*, not just thread stacks (SURVEY.md S5: lost collectives
+in the reference are silent).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+def device_memory_lines() -> list[str]:
+    """One human line per jax device: the ``memory_stats()`` essentials
+    (bytes in use / peak / limit), or a note when the backend exposes none
+    (CPU returns ``None``). Never raises — this runs inside crash paths."""
+    lines: list[str] = []
+    try:
+        import jax
+
+        for i, d in enumerate(jax.devices()):
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                lines.append(
+                    f"device {i} ({d.device_kind}): memory_stats unavailable")
+                continue
+            used = stats.get("bytes_in_use")
+            peak = stats.get("peak_bytes_in_use")
+            limit = stats.get("bytes_limit")
+            parts = [f"device {i} ({d.device_kind}):"]
+            if used is not None:
+                parts.append(f"in_use={used / 1e6:.1f}MB")
+            if peak is not None:
+                parts.append(f"peak={peak / 1e6:.1f}MB")
+            if limit is not None:
+                parts.append(f"limit={limit / 1e6:.1f}MB")
+            lines.append(" ".join(parts))
+    except Exception as e:  # jax missing/broken mid-crash: still dump events
+        lines.append(f"device memory unavailable: {type(e).__name__}: {e}")
+    return lines
+
+
+class EventLog:
+    """Bounded structured event ring.
+
+    :meth:`emit` is the hot-path call: one timestamped dict appended to a
+    ``deque(maxlen=capacity)`` under a lock — no I/O, no serialization, so
+    it can sit inside serving decode loops and per-step training wrappers.
+    :meth:`dump` is the failure-path call: write the tail as JSONL plus
+    device memory stats to a sink (stderr by default).
+    """
+
+    def __init__(self, capacity: int = 1024) -> None:
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+
+    def emit(self, kind: str, **fields) -> None:
+        ev = {"i": next(self._seq), "t": round(time.time(), 6),
+              "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            self._ring.append(ev)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def tail(self, n: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            evs = list(self._ring)
+        return evs if n is None else evs[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def dump(self, file=None, last: int = 64, memory: bool = True) -> int:
+        """Write the flight-recorder tail; returns the number of events
+        dumped. Format: a banner, one JSON object per line (oldest first),
+        then per-device memory stats — grep-able and machine-parseable."""
+        sink = file or sys.stderr
+        evs = self.tail(last)
+        print(
+            f"chainermn_tpu.monitor flight recorder: last {len(evs)} "
+            f"event(s) of {len(self._ring)} retained",
+            file=sink,
+        )
+        for ev in evs:
+            try:
+                print(json.dumps(ev, default=str), file=sink)
+            except Exception:
+                print(str(ev), file=sink)
+        if memory:
+            print("device memory:", file=sink)
+            for line in device_memory_lines():
+                print(f"  {line}", file=sink)
+        print("end flight recorder", file=sink)
+        try:
+            sink.flush()
+        except Exception:
+            pass
+        return len(evs)
+
+
+__all__ = ["EventLog", "device_memory_lines"]

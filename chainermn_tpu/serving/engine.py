@@ -40,17 +40,21 @@ the scheduler drives TP decode through the identical slot API.
 
 from __future__ import annotations
 
-from typing import Optional
+import contextlib
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from chainermn_tpu.extensions.profiling import Watchdog
 from chainermn_tpu.models.transformer import (
     _sampler,
     init_kv_caches,
 )
+from chainermn_tpu.monitor import RecompileGuard, annotate
+from chainermn_tpu.monitor._state import get_event_log, get_registry
 
 
 class ServingEngine:
@@ -82,11 +86,22 @@ class ServingEngine:
     comm : communicator, optional
         Required iff ``model.tensor_axis`` is set: both programs then run
         inside its ``shard_map`` with head-sharded caches.
+    watchdog : Watchdog or float, optional
+        Hang detection around every device program call (prefill AND the
+        all-slots decode step). Default **off**. A float builds a
+        ``Watchdog(timeout=...)`` (abort mode — die loudly, the
+        ``global_except_hook`` stance); pass a configured ``Watchdog``
+        (e.g. ``on_timeout='warn'``) for report-only. On fire it dumps
+        thread stacks + the monitor flight recorder (last events incl.
+        slot admits/retires, per-device memory), so a wedged collective
+        in serving aborts with evidence instead of hanging the client
+        thread forever.
     """
 
     def __init__(self, model, params, *, n_slots: int, prefill_len: int,
                  cache_len: Optional[int] = None, temperature: float = 0.0,
-                 top_k: int = 0, top_p: float = 1.0, comm=None):
+                 top_k: int = 0, top_p: float = 1.0, comm=None,
+                 watchdog: Optional[Union[Watchdog, float]] = None):
         if model.sequence_axis is not None:
             raise ValueError(
                 "serving decode does not support sequence-sharded models: "
@@ -122,6 +137,15 @@ class ServingEngine:
         self.cache_len = int(cache_len)
         self._comm = comm
         self._sample = _sampler(float(temperature), int(top_k), float(top_p))
+        if watchdog is not None and not isinstance(watchdog, Watchdog):
+            watchdog = Watchdog(timeout=float(watchdog))
+        self.watchdog = watchdog
+        self._events = get_event_log()
+        labels = {"engine": "serving"}
+        reg = get_registry()
+        self._c_prefills = reg.counter("serving_prefills_total", labels)
+        self._c_decode_steps = reg.counter("serving_decode_steps_total",
+                                           labels)
 
         if model.tensor_axis is not None:
             self._init_tp_caches(comm)
@@ -139,6 +163,20 @@ class ServingEngine:
         self._keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
         self.free_slots = set(range(self.n_slots))
 
+        # recompile tracking: the zero-recompile invariant as live
+        # telemetry (compile/recompile events + recompiles_total counter),
+        # checked after every device call — not only in tests
+        self._guard = RecompileGuard()
+        self._guard.watch("serving_prefill", self._prefill_fn)
+        self._guard.watch("serving_decode", self._decode_fn)
+
+    def _watched(self, label: str):
+        """Watchdog context for one device-program call (no-op when hang
+        detection is off)."""
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.step(label)
+
     # ------------------------------------------------------------------ #
     # program construction                                                #
     # ------------------------------------------------------------------ #
@@ -150,6 +188,10 @@ class ServingEngine:
         model, sample = self.model, self._sample
 
         def body(params, caches, tokens, slot, length, key):
+            with annotate("chainermn.prefill"):
+                return body_inner(params, caches, tokens, slot, length, key)
+
+        def body_inner(params, caches, tokens, slot, length, key):
             slot_c = [
                 {k: lax.dynamic_slice_in_dim(c[k], slot, 1, axis=0)
                  for k in ("k", "v")}
@@ -182,6 +224,10 @@ class ServingEngine:
             return nxt[0], key
 
         def body(params, caches, tokens, pos, active, keys):
+            with annotate("chainermn.decode"):
+                return body_inner(params, caches, tokens, pos, active, keys)
+
+        def body_inner(params, caches, tokens, pos, active, keys):
             lg, caches = model.apply(params, tokens[:, None], pos[:, None],
                                      kv_caches=caches)
             lg = lg[:, 0]
@@ -275,15 +321,21 @@ class ServingEngine:
         slot = min(self.free_slots)  # deterministic pick: stable tests/replay
         padded = np.zeros((1, self.prefill_len), np.int32)
         padded[0, : len(prompt)] = prompt
-        self.caches, first, key = self._prefill_fn(
-            self.params, self.caches, jnp.asarray(padded),
-            jnp.int32(slot), jnp.int32(len(prompt)), rng)
+        with self._watched("serving prefill"), \
+                annotate("chainermn.serving_prefill"):
+            self.caches, first, key = self._prefill_fn(
+                self.params, self.caches, jnp.asarray(padded),
+                jnp.int32(slot), jnp.int32(len(prompt)), rng)
+            first = int(first)
         self.free_slots.discard(slot)
-        self._token[slot] = int(first)
+        self._token[slot] = first
         self._pos[slot] = len(prompt)
         self._active[slot] = True
         self._keys = self._keys.at[slot].set(key)
-        return slot, int(first)
+        self._c_prefills.inc()
+        self._events.emit("prefill", slot=slot, prompt_len=len(prompt))
+        self._guard.check()
+        return slot, first
 
     def decode_step(self) -> dict[int, int]:
         """Advance every active slot one token (ONE compiled call for the
@@ -291,10 +343,19 @@ class ServingEngine:
         ({}) when nothing is active."""
         if not self._active.any():
             return {}
-        self.caches, nxt, self._keys = self._decode_fn(
-            self.params, self.caches, jnp.asarray(self._token),
-            jnp.asarray(self._pos), jnp.asarray(self._active), self._keys)
-        nxt = np.asarray(nxt)
+        # the fetch (np.asarray) is inside the watchdog window on purpose:
+        # a wedged collective hangs exactly there, and that is the hang
+        # the serving watchdog exists to turn into a loud abort
+        with self._watched("serving decode_step"), \
+                annotate("chainermn.serving_decode"):
+            self.caches, nxt, self._keys = self._decode_fn(
+                self.params, self.caches, jnp.asarray(self._token),
+                jnp.asarray(self._pos), jnp.asarray(self._active),
+                self._keys)
+            nxt = np.asarray(nxt)
+        self._c_decode_steps.inc()
+        self._events.emit("decode_step", active=int(self._active.sum()))
+        self._guard.check()
         out = {}
         for slot in np.flatnonzero(self._active):
             slot = int(slot)

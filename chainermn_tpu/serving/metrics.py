@@ -1,8 +1,18 @@
 """Serving observability: TTFT, per-token latency, throughput, queue depth
 and slot occupancy — the serving counterpart of the training side's
-``extensions.StepTimer``/``collective_stats`` layer, reporting through the
-same :func:`chainermn_tpu.extensions.latency_report` percentile convention
-so training and serving benchmark records stay field-compatible.
+``extensions.StepTimer``/``collective_stats`` layer.
+
+Since the monitor subsystem landed, this class keeps NO private sample
+lists: every series lives in the process-wide
+:class:`chainermn_tpu.monitor.MetricsRegistry` (labelled ``instance=N``
+per scheduler so concurrent/successive schedulers never mix), which makes
+the same numbers scrapeable through ``monitor.exposition()`` and
+embeddable via ``monitor.snapshot()`` while :meth:`report` stays
+field-compatible with the PR-1 records (``ttft_p50_s`` etc. via the same
+:func:`chainermn_tpu.extensions.latency_report` convention). First-token
+recordings also emit ``first_token`` events into the flight recorder, so
+a TTFT outlier in a report can be traced to the specific ``slot_admit``
+events around it.
 
 All timestamps are caller-supplied ``time.perf_counter()`` values (the
 scheduler owns the clock); this module only aggregates, so it is trivially
@@ -11,9 +21,16 @@ testable and thread-agnostic (the scheduler serializes all calls).
 
 from __future__ import annotations
 
+import itertools
 from typing import Optional
 
+import numpy as np
+
 from chainermn_tpu.extensions import latency_report
+from chainermn_tpu.monitor import EventLog, MetricsRegistry
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+
+_instance_ids = itertools.count()
 
 
 class ServingMetrics:
@@ -31,20 +48,33 @@ class ServingMetrics:
       0.0 until two tokens exist).
 
     Gauges (queue depth, slot occupancy) are sampled once per scheduler
-    step and reported as means — occupancy is the fraction of the slot
-    pool decoding, the continuous-batching utilization number.
+    step and reported as mean + p50/p99 — occupancy is the fraction of
+    the slot pool decoding, the continuous-batching utilization number;
+    its p99 says whether the pool ever actually fills under the offered
+    load, which the mean alone hides.
     """
 
-    def __init__(self, n_slots: int) -> None:
+    def __init__(self, n_slots: int, *,
+                 registry: Optional[MetricsRegistry] = None,
+                 events: Optional[EventLog] = None) -> None:
         self.n_slots = n_slots
-        self.requests_submitted = 0
-        self.requests_completed = 0
-        self.requests_cancelled = 0
-        self.tokens_generated = 0
-        self._ttft: list[float] = []
-        self._tpot: list[float] = []
-        self._queue_depth: list[int] = []
-        self._occupancy: list[float] = []
+        self._registry = registry if registry is not None else get_registry()
+        self._events = events if events is not None else get_event_log()
+        labels = {"instance": str(next(_instance_ids))}
+        reg = self._registry
+        self._c_submitted = reg.counter(
+            "serving_requests_submitted_total", labels)
+        self._c_completed = reg.counter(
+            "serving_requests_completed_total", labels)
+        self._c_cancelled = reg.counter(
+            "serving_requests_cancelled_total", labels)
+        self._c_tokens = reg.counter("serving_tokens_total", labels)
+        self._h_ttft = reg.histogram("serving_ttft_seconds", labels, unit="s")
+        self._h_tpot = reg.histogram("serving_tpot_seconds", labels, unit="s")
+        self._h_queue = reg.histogram("serving_queue_depth", labels)
+        self._h_occ = reg.histogram("serving_slot_occupancy", labels)
+        self._g_queue = reg.gauge("serving_queue_depth_now", labels)
+        self._g_active = reg.gauge("serving_active_slots", labels)
         self._t_first_token: Optional[float] = None
         self._t_last_token: Optional[float] = None
 
@@ -53,27 +83,32 @@ class ServingMetrics:
     # ------------------------------------------------------------------ #
 
     def record_submit(self) -> None:
-        self.requests_submitted += 1
+        self._c_submitted.inc()
 
-    def record_first_token(self, t_submit: float, t_token: float) -> None:
-        self._ttft.append(t_token - t_submit)
+    def record_first_token(self, t_submit: float, t_token: float,
+                           req_id: Optional[int] = None) -> None:
+        ttft = t_token - t_submit
+        self._h_ttft.observe(ttft)
         self._record_token_time(t_token)
-        self.tokens_generated += 1
+        self._c_tokens.inc()
+        # the flight-recorder hook: a TTFT outlier names its request, so
+        # it can be joined against the surrounding slot_admit events
+        self._events.emit("first_token", req=req_id,
+                          ttft_s=round(ttft, 6))
 
     def record_token(self, t_prev_token: float, t_token: float) -> None:
-        self._tpot.append(t_token - t_prev_token)
+        self._h_tpot.observe(t_token - t_prev_token)
         self._record_token_time(t_token)
-        self.tokens_generated += 1
+        self._c_tokens.inc()
 
     def record_done(self, cancelled: bool = False) -> None:
-        if cancelled:
-            self.requests_cancelled += 1
-        else:
-            self.requests_completed += 1
+        (self._c_cancelled if cancelled else self._c_completed).inc()
 
     def record_step(self, queue_depth: int, active_slots: int) -> None:
-        self._queue_depth.append(queue_depth)
-        self._occupancy.append(active_slots / self.n_slots)
+        self._h_queue.observe(queue_depth)
+        self._h_occ.observe(active_slots / self.n_slots)
+        self._g_queue.set(queue_depth)
+        self._g_active.set(active_slots)
 
     def _record_token_time(self, t: float) -> None:
         if self._t_first_token is None:
@@ -83,6 +118,22 @@ class ServingMetrics:
     # ------------------------------------------------------------------ #
     # reporting                                                           #
     # ------------------------------------------------------------------ #
+
+    @property
+    def requests_submitted(self) -> int:
+        return self._c_submitted.value
+
+    @property
+    def requests_completed(self) -> int:
+        return self._c_completed.value
+
+    @property
+    def requests_cancelled(self) -> int:
+        return self._c_cancelled.value
+
+    @property
+    def tokens_generated(self) -> int:
+        return self._c_tokens.value
 
     @property
     def tokens_per_sec(self) -> float:
@@ -103,14 +154,17 @@ class ServingMetrics:
             "tokens_per_sec": round(self.tokens_per_sec, 2),
             "n_slots": self.n_slots,
         }
-        out.update(latency_report(self._ttft, "ttft"))
-        out.update(latency_report(self._tpot, "tpot"))
-        if self._queue_depth:
-            out["queue_depth_mean"] = round(
-                sum(self._queue_depth) / len(self._queue_depth), 3)
-        if self._occupancy:
-            out["slot_occupancy_mean"] = round(
-                sum(self._occupancy) / len(self._occupancy), 3)
+        out.update(latency_report(self._h_ttft.samples, "ttft"))
+        out.update(latency_report(self._h_tpot.samples, "tpot"))
+        for hist, prefix in ((self._h_queue, "queue_depth"),
+                             (self._h_occ, "slot_occupancy")):
+            samples = hist.samples
+            if not samples:
+                continue
+            t = np.asarray(samples, np.float64)
+            out[f"{prefix}_mean"] = round(float(t.mean()), 3)
+            out[f"{prefix}_p50"] = round(float(np.percentile(t, 50)), 3)
+            out[f"{prefix}_p99"] = round(float(np.percentile(t, 99)), 3)
         return out
 
 

@@ -34,6 +34,8 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from chainermn_tpu.monitor import annotate
+from chainermn_tpu.monitor._state import get_event_log
 from chainermn_tpu.serving.metrics import ServingMetrics
 
 
@@ -99,6 +101,7 @@ class FCFSScheduler:
         self.engine = engine
         self.eos_id = eos_id
         self.metrics = metrics or ServingMetrics(engine.n_slots)
+        self._events = get_event_log()
         self._queue: deque[Request] = deque()
         self._by_slot: dict[int, Request] = {}
         self._lock = threading.Lock()
@@ -122,6 +125,8 @@ class FCFSScheduler:
             req.id = next(self._ids)
             self._queue.append(req)
             self.metrics.record_submit()
+        self._events.emit("submit", req=req.id, prompt_len=len(prompt),
+                          max_new=int(max_new_tokens))
         return req
 
     def cancel(self, req: Request) -> bool:
@@ -142,6 +147,8 @@ class FCFSScheduler:
             # path sees the CANCELLED state and releases the slot itself
             req.state = RequestState.CANCELLED
             self.metrics.record_done(cancelled=True)
+        self._events.emit("slot_retire", req=req.id, slot=req.slot,
+                          reason="cancelled")
         req._done.set()
         return True
 
@@ -165,26 +172,31 @@ class FCFSScheduler:
         step, so a retirement's slot never sits idle for a step."""
         emitted = 0
         # 1. admission: one prefill per free slot, FCFS
-        while self.engine.free_slots:
-            with self._lock:
-                if not self._queue:
-                    break
-                req = self._queue.popleft()
-                req.state = RequestState.PREFILL
-            slot, first = self.engine.prefill(req.prompt, req.rng)
-            now = time.perf_counter()
-            with self._lock:
-                if req.state is RequestState.CANCELLED:
-                    # cancelled while its prefill was in flight (it had no
-                    # slot yet, so cancel() left the release to us)
-                    self.engine.release(slot)
-                    continue
-                req.slot = slot
-                self._by_slot[slot] = req
-                req.state = RequestState.DECODE
-            self.metrics.record_first_token(req.t_submit, now)
-            self._deliver(req, first, now)
-            emitted += 1
+        with annotate("chainermn.serving_admit"):
+            while self.engine.free_slots:
+                with self._lock:
+                    if not self._queue:
+                        break
+                    req = self._queue.popleft()
+                    req.state = RequestState.PREFILL
+                slot, first = self.engine.prefill(req.prompt, req.rng)
+                now = time.perf_counter()
+                with self._lock:
+                    if req.state is RequestState.CANCELLED:
+                        # cancelled while its prefill was in flight (it had
+                        # no slot yet, so cancel() left the release to us)
+                        self.engine.release(slot)
+                        continue
+                    req.slot = slot
+                    self._by_slot[slot] = req
+                    req.state = RequestState.DECODE
+                self._events.emit("slot_admit", req=req.id, slot=slot,
+                                  prompt_len=len(req.prompt),
+                                  queue_depth=self.queue_depth)
+                self.metrics.record_first_token(req.t_submit, now,
+                                                req_id=req.id)
+                self._deliver(req, first, now)
+                emitted += 1
         # 2. decode: every active slot, one token, one compiled call
         for slot, tok in self.engine.decode_step().items():
             req = self._by_slot.get(slot)
@@ -224,9 +236,9 @@ class FCFSScheduler:
                 pass  # a consumer's callback must not kill the engine loop
         hit_eos = self.eos_id is not None and int(tok) == self.eos_id
         if hit_eos or len(req.tokens) >= req.max_new_tokens:
-            self._retire(req)
+            self._retire(req, "eos" if hit_eos else "length")
 
-    def _retire(self, req: Request) -> None:
+    def _retire(self, req: Request, reason: str) -> None:
         with self._lock:
             if req.finished:   # a concurrent cancel() won the race
                 return
@@ -234,6 +246,8 @@ class FCFSScheduler:
             self._by_slot.pop(req.slot, None)
             req.state = RequestState.DONE
             self.metrics.record_done()
+        self._events.emit("slot_retire", req=req.id, slot=req.slot,
+                          reason=reason, tokens=len(req.tokens))
         req._done.set()
 
 

@@ -18,6 +18,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+from chainermn_tpu.monitor import annotate, instrument
 from chainermn_tpu.utils import axis_size as _axis_size
 from chainermn_tpu.utils import pcast_varying
 
@@ -77,6 +78,12 @@ def make_classification_train_step(
     train_kwargs = dict(train_kwargs or {})
 
     def step(variables, opt_state, images, labels):
+        # profiler scope: every op this body traces carries the name in its
+        # HLO metadata, so XProf device rows read as "train_step/..."
+        with annotate("chainermn.train_step"):
+            return step_body(variables, opt_state, images, labels)
+
+    def step_body(variables, opt_state, images, labels):
         params = variables["params"]
         rest = {k: v for k, v in variables.items() if k != "params"}
         mutable = list(rest.keys())
@@ -116,6 +123,7 @@ def jit_train_step(
     donate: bool = True,
     train_kwargs: Optional[dict] = None,
     label_smoothing: float = 0.0,
+    monitored: bool = True,
 ) -> Callable:
     """The full jitted SPMD train step over the communicator's mesh.
 
@@ -124,6 +132,13 @@ def jit_train_step(
     batch, sharded over the mesh). Buffer donation keeps params/opt-state
     updates in-place on HBM (the reference's grow-only arenas play this role,
     SURVEY.md S2.9).
+
+    ``monitored=True`` (default) returns the step wrapped in
+    :func:`chainermn_tpu.monitor.instrument`: step start/end events, a
+    step counter + step-time histogram in the process registry, recompile
+    detection, and periodic device-memory gauges — call-transparent
+    (``lower``/``_cache_size`` still delegate to the jitted function) and
+    a few host dict ops per step.
     """
     body = make_classification_train_step(
         model, optimizer, comm, train_kwargs, label_smoothing
@@ -141,7 +156,8 @@ def jit_train_step(
         and getattr(comm, "check_vma", True),
     )
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(sm, donate_argnums=donate_argnums)
+    jitted = jax.jit(sm, donate_argnums=donate_argnums)
+    return instrument(jitted, "train_step") if monitored else jitted
 
 
 def _shard_positions(model, seq_axis, t_local):
@@ -166,6 +182,7 @@ def _jit_tp_lm_train_step(
     tensor_axis: str,
     shard_sequence: bool,
     donate: bool,
+    monitored: bool = True,
 ) -> Callable:
     """The tensor-parallel LM step (dispatched to by :func:`jit_lm_train_step`
     when the model was built with ``tensor_axis``).
@@ -246,6 +263,10 @@ def _jit_tp_lm_train_step(
     vocab_parallel = getattr(model, "vocab_parallel_head", False)
 
     def body(params, opt_state, tokens, targets):
+        with annotate("chainermn.lm_tp_train_step"):
+            return body_inner(params, opt_state, tokens, targets)
+
+    def body_inner(params, opt_state, tokens, targets):
         pos_offset = _shard_positions(model, seq_axis, tokens.shape[1])
 
         def loss_fn(p):
@@ -280,7 +301,8 @@ def _jit_tp_lm_train_step(
         out_specs=(P(), P(), P(), P()),
     )
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(sm, donate_argnums=donate_argnums)
+    jitted = jax.jit(sm, donate_argnums=donate_argnums)
+    return instrument(jitted, "lm_tp_train_step") if monitored else jitted
 
 
 def jit_lm_train_step(
@@ -291,6 +313,7 @@ def jit_lm_train_step(
     donate: bool = True,
     moe_aux_weight: float = 0.01,
     fused_ce: bool = False,
+    monitored: bool = True,
 ) -> Callable:
     """Jitted next-token-prediction step for :class:`TransformerLM`-shaped
     models. Call as ``step(params, opt_state, tokens, targets)`` ->
@@ -311,6 +334,10 @@ def jit_lm_train_step(
     global positions are threaded through ``pos_offset`` (a vector under
     zigzag). Gradients are averaged over the axis by the multi-node
     optimizer either way, so params stay replicated.
+
+    ``monitored=True`` (default) wraps the jitted step in
+    :func:`chainermn_tpu.monitor.instrument` (step events + metrics +
+    recompile tracking), call-transparently — see :func:`jit_train_step`.
     """
     # Mismatched model/step configs run without error but compute the wrong
     # attention (the axis IS bound inside shard_map either way) — reject.
@@ -329,6 +356,7 @@ def jit_lm_train_step(
         return _jit_tp_lm_train_step(
             model, optimizer, comm, tensor_axis,
             shard_sequence=shard_sequence, donate=donate,
+            monitored=monitored,
         )
     if moe_experts and getattr(model, "moe_axis", None) != comm.axis_name:
         raise ValueError(
@@ -355,6 +383,10 @@ def jit_lm_train_step(
             )
 
     def body(params, opt_state, tokens, targets):
+        with annotate("chainermn.lm_train_step"):
+            return body_inner(params, opt_state, tokens, targets)
+
+    def body_inner(params, opt_state, tokens, targets):
         pos_offset = _shard_positions(
             model, comm.axis_name if shard_sequence else None, tokens.shape[1]
         )
@@ -428,4 +460,5 @@ def jit_lm_train_step(
         and getattr(comm, "check_vma", True),
     )
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(sm, donate_argnums=donate_argnums)
+    jitted = jax.jit(sm, donate_argnums=donate_argnums)
+    return instrument(jitted, "lm_train_step") if monitored else jitted

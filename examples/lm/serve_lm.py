@@ -8,10 +8,19 @@ background client thread), and pushes a burst of ragged random prompts
 through it: some blocking, one streamed token-by-token. Prints the serving
 metrics (TTFT/TPOT percentiles, tokens/s, slot occupancy) at the end.
 
+Also the telemetry demo: the burst runs inside a
+:func:`chainermn_tpu.monitor.annotate` profiler scope (capture with
+``jax.profiler.trace`` and the span shows up named in XProf/Perfetto),
+``--watchdog SECONDS`` arms the engine's hang watchdog (a wedged
+collective dumps the flight recorder + thread stacks instead of hanging
+the client), and ``--prometheus`` prints the process-wide
+:func:`chainermn_tpu.monitor.exposition` text — the same series a
+Prometheus scraper would pull.
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python examples/lm/serve_lm.py --requests 16 --slots 4
+        python examples/lm/serve_lm.py --requests 16 --slots 4 --prometheus
 
     # tensor-parallel decode through the same scheduler:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -31,6 +40,7 @@ import chainermn_tpu
 from chainermn_tpu.utils import apply_env_platform
 
 apply_env_platform()
+from chainermn_tpu import monitor  # noqa: E402
 from chainermn_tpu.models import TransformerLM  # noqa: E402
 from chainermn_tpu.serving import ServingClient, ServingEngine  # noqa: E402
 
@@ -53,6 +63,13 @@ def main() -> None:
     ap.add_argument("--tensor-parallel", action="store_true",
                     help="shard heads over the mesh; decode runs inside "
                          "the communicator's shard_map")
+    ap.add_argument("--watchdog", type=float, default=0.0,
+                    help="arm the engine hang watchdog: a decode step "
+                         "exceeding this many seconds dumps the flight "
+                         "recorder + thread stacks and aborts (0: off)")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="print the Prometheus text exposition of the "
+                         "process metrics registry at the end")
     args = ap.parse_args()
 
     comm = chainermn_tpu.create_communicator("tpu") if args.tensor_parallel \
@@ -77,10 +94,12 @@ def main() -> None:
     engine = ServingEngine(
         model, params, n_slots=args.slots, prefill_len=args.prefill_len,
         temperature=args.temperature, comm=comm,
+        watchdog=args.watchdog or None,
     )
     eos = None if args.eos_id < 0 else args.eos_id
     t0 = time.time()
-    with ServingClient(engine, eos_id=eos) as client:
+    with monitor.annotate("chainermn.serve_lm_burst"), \
+            ServingClient(engine, eos_id=eos) as client:
         # one streaming request: tokens arrive as they are decoded
         stream_toks: list[int] = []
         streamed = client.submit(
@@ -111,6 +130,9 @@ def main() -> None:
         print(f"  {k}: {v}")
     print(f"engine executables: {engine.compile_counts()} "
           "(zero recompiles after warmup)")
+    if args.prometheus:
+        print("\n# process metrics registry (Prometheus exposition)")
+        print(monitor.exposition(), end="")
 
 
 if __name__ == "__main__":

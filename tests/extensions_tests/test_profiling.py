@@ -110,6 +110,72 @@ def test_parse_hlo_async_variadic_and_reduce_scatter():
     assert stats["reduce-scatter"] == {"count": 1, "bytes": 128 * 4}
 
 
+def test_parse_hlo_alltoall_start_tuple_and_instance_suffixes():
+    """Tuple-typed ``all-to-all-start`` (operands, results) pairs count the
+    result half once under the base name; ``.N`` instance suffixes — which
+    real post-optimization HLO appends to every duplicated op — must fold
+    into the same base-op bucket instead of minting ``all-reduce.7`` keys."""
+    from chainermn_tpu.extensions import parse_hlo_collectives
+
+    hlo = """
+  %a2a = (f32[4,32]{1,0}, f32[4,32]{1,0}) all-to-all-start(f32[4,32]{1,0} %p0), channel_id=3
+  %a2ad = f32[4,32]{1,0} all-to-all-done((f32[4,32]{1,0}, f32[4,32]{1,0}) %a2a)
+  %ar.1 = f32[64]{0} all-reduce.1(f32[64]{0} %p1), replica_groups={}
+  %ar.2 = f32[64]{0} all-reduce.2(f32[64]{0} %p2), replica_groups={}
+  %ars.7 = f32[16]{0} all-reduce-start.7(f32[16]{0} %p3)
+  %ard.7 = f32[16]{0} all-reduce-done.7(f32[16]{0} %ars.7)
+"""
+    stats = parse_hlo_collectives(hlo)
+    # tuple all-to-all-start: (operand, result) — result half, counted once
+    assert stats["all-to-all"] == {"count": 1, "bytes": 4 * 32 * 4}
+    # .N suffixes: three distinct instances, one base-op bucket; the
+    # suffixed -done is still recognized as a done and skipped
+    assert stats["all-reduce"] == {"count": 3, "bytes": (64 + 64 + 16) * 4}
+    assert not any(k.startswith("all-reduce.") for k in stats)
+
+
+def test_parse_hlo_f8_dtypes():
+    """f8 payloads (fp8 wire-compressed collectives) count at 1 byte/elem —
+    and a dtype the table doesn't know is skipped, not crashed on."""
+    from chainermn_tpu.extensions import parse_hlo_collectives
+
+    hlo = """
+  %ag = f8e4m3fn[1024,8]{1,0} all-gather(f8e4m3fn[128,8]{1,0} %p0), dimensions={0}
+  %ar = f8e5m2[256]{0} all-reduce(f8e5m2[256]{0} %p1), replica_groups={}
+  %weird = q4[64]{0} all-reduce(q4[64]{0} %p2), replica_groups={}
+"""
+    stats = parse_hlo_collectives(hlo)
+    assert stats["all-gather"] == {"count": 1, "bytes": 1024 * 8}
+    # the q4 instance still counts, but contributes no (unknown) bytes
+    assert stats["all-reduce"] == {"count": 2, "bytes": 256}
+    assert stats["total_bytes"] == 1024 * 8 + 256
+
+
+def test_collective_stats_memoizes_lowered_hlo(comm):
+    """Repeated collective_stats on the same jitted fn + abstract shapes
+    must reuse the lowered HLO text (the AOT lower().compile() does not
+    share the jit executable cache — without the memo every call paid a
+    full second XLA compile); new shapes re-lower."""
+    from chainermn_tpu.extensions import collective_stats
+    from chainermn_tpu.extensions.profiling import _hlo_memo_info
+
+    def body(x):
+        return comm.allreduce(x, "sum")
+
+    f = jax.jit(comm.shard_map(body, in_specs=comm.data_spec, out_specs=P()))
+    x = jnp.zeros((comm.size, 32), jnp.float32)
+    before = dict(_hlo_memo_info)
+    s1 = collective_stats(f, x)
+    assert _hlo_memo_info["misses"] == before["misses"] + 1
+    s2 = collective_stats(f, x)
+    assert s2 == s1
+    assert _hlo_memo_info["hits"] == before["hits"] + 1
+    assert _hlo_memo_info["misses"] == before["misses"] + 1  # no re-lower
+    # a different abstract shape is a different executable: one more miss
+    collective_stats(f, jnp.zeros((comm.size, 64), jnp.float32))
+    assert _hlo_memo_info["misses"] == before["misses"] + 2
+
+
 def test_watchdog_warn_rearms_during_long_hang():
     sink = io.StringIO()
     dog = Watchdog(timeout=0.15, on_timeout="warn", _sink=sink)

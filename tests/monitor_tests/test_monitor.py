@@ -1,0 +1,258 @@
+"""Monitor subsystem: registry snapshot/exposition, event log, recompile
+guard, instrumented steps, cross-rank aggregation merge semantics."""
+
+import io
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from chainermn_tpu import monitor
+from chainermn_tpu.monitor import (
+    EventLog,
+    MetricsRegistry,
+    RecompileGuard,
+    merge_rank_payloads,
+)
+
+
+# --------------------------------------------------------------------- #
+# registry                                                               #
+# --------------------------------------------------------------------- #
+
+def test_registry_get_or_create_and_kinds():
+    reg = MetricsRegistry()
+    c = reg.counter("requests_total", {"zone": "a"})
+    assert reg.counter("requests_total", {"zone": "a"}) is c
+    # different labels -> different instrument
+    assert reg.counter("requests_total", {"zone": "b"}) is not c
+    with pytest.raises(TypeError):
+        reg.gauge("requests_total", {"zone": "a"})
+    with pytest.raises(ValueError):
+        reg.counter("bad name!")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_registry_snapshot_shape_and_json():
+    reg = MetricsRegistry()
+    reg.counter("steps_total", {"step": "t"}).inc(5)
+    reg.gauge("queue_depth").set(3)
+    h = reg.histogram("lat_seconds", unit="s")
+    for v in (0.010, 0.020, 0.030):
+        h.observe(v)
+    hq = reg.histogram("depth")   # unit-less
+    hq.observe(1.0)
+    hq.observe(3.0)
+    snap = reg.snapshot()
+    json.dumps(snap)  # must be JSON-able as-is (bench embeds it verbatim)
+    assert snap["counters"]['steps_total{step="t"}'] == 5
+    assert snap["gauges"]["queue_depth"] == 3.0
+    lat = snap["histograms"]["lat_seconds"]
+    # seconds-valued series reuse the latency_report field convention
+    assert lat["count"] == 3 and lat["p50_s"] == pytest.approx(0.020)
+    assert "p99_s" in lat and "mean_s" in lat
+    dep = snap["histograms"]["depth"]
+    assert dep["p50"] == pytest.approx(2.0) and "p50_s" not in dep
+
+
+def test_registry_histogram_reservoir_is_bounded():
+    reg = MetricsRegistry()
+    h = reg.histogram("x", max_samples=10)
+    for i in range(100):
+        h.observe(float(i))
+    assert h.count == 100 and h.sum == sum(range(100))
+    assert len(h.samples) == 10 and h.samples[0] == 90.0  # newest retained
+
+
+def test_registry_exposition_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("served_total", {"inst": "0"}).inc(7)
+    reg.gauge("occupancy").set(0.5)
+    h = reg.histogram("ttft_seconds", {"inst": "0"}, unit="s")
+    h.observe(0.25)
+    text = reg.exposition()
+    assert "# TYPE served_total counter" in text
+    assert 'served_total{inst="0"} 7' in text
+    assert "# TYPE occupancy gauge" in text
+    assert "# TYPE ttft_seconds summary" in text
+    assert 'ttft_seconds{inst="0",quantile="0.5"} 0.25' in text
+    assert 'ttft_seconds_count{inst="0"} 1' in text
+    assert text.endswith("\n")
+
+
+# --------------------------------------------------------------------- #
+# cross-rank aggregation                                                 #
+# --------------------------------------------------------------------- #
+
+class _FakeComm:
+    """allgather_obj stub: replays pre-built per-rank payloads, mimicking
+    the communicator's object transport without processes."""
+
+    def __init__(self, payloads):
+        self._payloads = payloads
+
+    def allgather_obj(self, obj):
+        return self._payloads
+
+
+def test_aggregate_merges_ranks():
+    # two "ranks" with disjoint counter values and different latency tails
+    regs = [MetricsRegistry() for _ in range(2)]
+    for r, reg in enumerate(regs):
+        reg.counter("steps_total").inc(10 * (r + 1))
+        reg.gauge("occupancy").set(float(r))
+        h = reg.histogram("ttft_seconds", unit="s")
+        for v in ([0.01] * 9 if r == 0 else [0.01] * 4 + [1.0] * 5):
+            h.observe(v)
+    payloads = [reg._rank_payload() for reg in regs]
+    fleet = regs[0].aggregate(_FakeComm(payloads))
+    assert fleet["ranks"] == 2
+    assert fleet["counters"]["steps_total"] == 30          # summed
+    assert fleet["gauges"]["occupancy"] == pytest.approx(0.5)  # averaged
+    tt = fleet["histograms"]["ttft_seconds"]
+    assert tt["count"] == 18
+    # pooled percentiles: rank 1's 1.0s tail must dominate the fleet p99
+    # even though rank 0 alone would report ~0.01
+    assert tt["p99_s"] > 0.5
+    assert tt["p50_s"] == pytest.approx(0.01)
+
+
+def test_aggregate_single_process_real_comm():
+    """On one process the communicator's allgather_obj degenerates to
+    [self] — aggregate must still return a well-formed fleet view."""
+    import chainermn_tpu
+
+    comm = chainermn_tpu.create_communicator("tpu")
+    reg = MetricsRegistry()
+    reg.counter("c").inc(2)
+    fleet = reg.aggregate(comm)
+    assert fleet["ranks"] == 1 and fleet["counters"]["c"] == 2
+
+
+def test_merge_rank_payloads_handles_empty():
+    assert merge_rank_payloads([])["ranks"] == 0
+    out = merge_rank_payloads([{"counters": {}, "gauges": {}, "hist": {}}])
+    assert out["counters"] == {} and out["histograms"] == {}
+
+
+# --------------------------------------------------------------------- #
+# event log                                                              #
+# --------------------------------------------------------------------- #
+
+def test_event_log_ring_and_dump():
+    log = EventLog(capacity=8)
+    for i in range(20):
+        log.emit("step_end", n=i)
+    assert len(log) == 8
+    tail = log.tail(3)
+    assert [e["n"] for e in tail] == [17, 18, 19]
+    assert all(e["kind"] == "step_end" and "t" in e for e in tail)
+    sink = io.StringIO()
+    n = log.dump(file=sink, last=5)
+    out = sink.getvalue()
+    assert n == 5
+    assert "flight recorder: last 5" in out
+    # events are JSONL between the banners
+    events = [json.loads(line) for line in out.splitlines()
+              if line.startswith("{")]
+    assert len(events) == 5 and events[-1]["n"] == 19
+    # per-device memory stats always present (even when the backend
+    # exposes none — the dump says so instead of omitting the section)
+    assert "device memory:" in out
+    assert "device 0" in out
+
+
+def test_emit_is_cheap_and_threadsafe_shape():
+    log = EventLog(capacity=128)
+    log.emit("slot_admit", req=1, slot=0)
+    (ev,) = log.tail(1)
+    assert ev["req"] == 1 and ev["slot"] == 0 and ev["i"] >= 0
+
+
+# --------------------------------------------------------------------- #
+# annotate                                                               #
+# --------------------------------------------------------------------- #
+
+def test_annotate_host_and_traced():
+    with monitor.annotate("chainermn.test_region"):
+        x = 1 + 1
+    assert x == 2
+
+    @jax.jit
+    def f(a):
+        with monitor.annotate("chainermn.inner"):
+            return a * 2
+
+    assert float(f(jnp.float32(3.0))) == 6.0
+
+
+# --------------------------------------------------------------------- #
+# recompile guard + instrument                                           #
+# --------------------------------------------------------------------- #
+
+def test_recompile_guard_catches_shape_driven_recompile():
+    reg = MetricsRegistry()
+    log = EventLog()
+    f = jax.jit(lambda x: x * 2)
+    guard = RecompileGuard(registry=reg, events=log)
+    guard.watch("f", f)
+    f(jnp.zeros((2,)))                       # warmup compile
+    assert guard.check() == {}               # 0 -> 1 is not a recompile
+    f(jnp.zeros((2,)))                       # cache hit
+    assert guard.check() == {}
+    f(jnp.zeros((3,)))                       # shape change -> retrace
+    assert guard.check() == {"f": 1}
+    assert guard.recompiles == {"f": 1}
+    assert reg.counter("recompiles_total", {"fn": "f"}).value == 1
+    kinds = [e["kind"] for e in log.tail()]
+    assert "compile" in kinds and "recompile" in kinds
+    with pytest.raises(AssertionError):
+        guard.assert_no_recompiles()
+
+
+def test_recompile_guard_raise_mode():
+    f = jax.jit(lambda x: x + 1)
+    guard = RecompileGuard(registry=MetricsRegistry(), events=EventLog(),
+                           on_recompile="raise")
+    f(jnp.zeros((2,)))
+    guard.watch("f", f)
+    f(jnp.zeros((4,)))
+    with pytest.raises(RuntimeError, match="recompiled"):
+        guard.check()
+    with pytest.raises(ValueError):
+        RecompileGuard(on_recompile="explode")
+
+
+def test_instrument_wraps_transparently():
+    reg = MetricsRegistry()
+    log = EventLog()
+    f = jax.jit(lambda x: x * 3)
+    mf = monitor.instrument(f, "triple", registry=reg, events=log)
+    out = mf(jnp.asarray(2.0))
+    assert float(out) == 6.0
+    # metrics + events recorded
+    assert reg.counter("steps_total", {"step": "triple"}).value == 1
+    hist = reg.histogram("step_time_seconds", {"step": "triple"}, unit="s")
+    assert hist.count == 1
+    kinds = [e["kind"] for e in log.tail()]
+    assert kinds.count("step_start") == 1 and kinds.count("step_end") == 1
+    # delegation: AOT/introspection surface of the jitted fn still works
+    assert hasattr(mf, "lower")
+    assert mf.lower(jnp.asarray(2.0)).compile() is not None
+    assert mf._cache_size() >= 1
+    # re-instrumenting wraps the ORIGINAL fn, not the wrapper
+    mf2 = monitor.instrument(mf, "renamed", registry=reg, events=log)
+    assert mf2.inner is f
+
+
+def test_default_singletons_shared():
+    assert monitor.get_registry() is monitor.get_registry()
+    assert monitor.get_event_log() is monitor.get_event_log()
+    monitor.emit("test_event", k=1)
+    assert any(e["kind"] == "test_event"
+               for e in monitor.get_event_log().tail(5))
+    snap = monitor.snapshot()
+    assert set(snap) == {"counters", "gauges", "histograms"}
+    json.dumps(snap)
