@@ -793,6 +793,220 @@ def monitor_main() -> None:
     _scratch_write(record)
 
 
+def resilience_main() -> None:
+    """``bench.py --mode resilience``: fault-injection / recovery cell.
+
+    One JSON record proving the resilience loop live, with the numbers the
+    ISSUE names: **checkpoint save/restore latency** (the recovery path's
+    I/O cost), **MTTR** — wall-clock from an injected crash at a chosen
+    training step to the first completed post-resume step — plus a
+    **bit-exactness** verdict (the faulted run's final loss must equal an
+    uninterrupted reference run's, RNG/iterator state round-tripping
+    through the snapshot), and the serving degradation counts
+    (rejected / shed / errored / restarts) from a burst driven into a
+    bounded queue with an injected engine raise. Embeds the registry
+    ``snapshot`` like every other mode.
+
+    Knobs: ``CHAINERMN_TPU_RESIL_STEPS`` (default 16),
+    ``CHAINERMN_TPU_RESIL_FAULT_STEP`` (default 9),
+    ``CHAINERMN_TPU_RESIL_SAVE_EVERY`` (default 4) and the
+    ``CHAINERMN_TPU_SERVE_*`` sizes shared with serving mode.
+    """
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    plat = os.environ.get("CHAINERMN_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    enable_compilation_cache(jax)
+
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu import monitor
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.resilience import FaultInjector, resilient_fit
+    from chainermn_tpu.serving import (
+        QueueFullError,
+        RequestState,
+        ServingEngine,
+    )
+    from chainermn_tpu.training import jit_lm_train_step
+
+    e = os.environ.get
+    n_steps = int(e("CHAINERMN_TPU_RESIL_STEPS", "16"))
+    fault_step = int(e("CHAINERMN_TPU_RESIL_FAULT_STEP", "9"))
+    save_every = int(e("CHAINERMN_TPU_RESIL_SAVE_EVERY", "4"))
+    n_slots = int(e("CHAINERMN_TPU_SERVE_SLOTS", "2"))
+    prefill_len = int(e("CHAINERMN_TPU_SERVE_PREFILL_LEN", "8"))
+    max_new = int(e("CHAINERMN_TPU_SERVE_MAX_NEW", "8"))
+    vocab = int(e("CHAINERMN_TPU_SERVE_VOCAB", "64"))
+    d_model = int(e("CHAINERMN_TPU_SERVE_DMODEL", "32"))
+    n_layers = int(e("CHAINERMN_TPU_SERVE_LAYERS", "1"))
+    n_heads = int(e("CHAINERMN_TPU_SERVE_HEADS", "4"))
+    seq_len = 16
+
+    devs = jax.devices()
+    log(f"resilience smoke: devices={len(devs)} "
+        f"kind={devs[0].device_kind!r} steps={n_steps} "
+        f"fault_step={fault_step}")
+    try:
+        # ---- auto-resume training: crash at fault_step, recover -------- #
+        lm = TransformerLM(vocab_size=vocab, d_model=d_model,
+                           n_heads=n_heads, n_layers=n_layers,
+                           max_len=seq_len)
+        comm = chainermn_tpu.create_communicator("tpu")
+        rng = np.random.RandomState(0)
+        toks = rng.randint(1, vocab, (64, seq_len)).astype(np.int32)
+        tgts = np.roll(toks, -1, axis=1)
+        batch = 2 * max(len(devs), 1)
+        params0 = comm.bcast_data(
+            lm.init(jax.random.PRNGKey(0), jnp.asarray(toks[:1])))
+        # multi-node wrapper: grads allreduced before the update, so every
+        # device's replica stays bitwise identical — the property that
+        # makes a replica-0 snapshot restore bit-exact
+        opt = chainermn_tpu.create_multi_node_optimizer(
+            optax.sgd(0.1), comm)
+        jitted = jit_lm_train_step(lm, opt, comm, donate=False)
+
+        def step_fn(state, batch_idx):
+            sel = np.asarray(batch_idx)
+            p, s, loss, _ = jitted(state["params"], state["opt"],
+                                   jnp.asarray(toks[sel]),
+                                   jnp.asarray(tgts[sel]))
+            return {"params": p, "opt": s, "loss": float(loss)}
+
+        def init_state():
+            return {"params": params0,
+                    "opt": jax.device_put(opt.init(params0),
+                                          comm.named_sharding()),
+                    "loss": None}
+
+        def restore_hook(state):
+            # snapshots hold host arrays; put them back on the mesh with
+            # the original (replicated) shardings so the resumed step
+            # reuses the same executable -> bit-exact trajectory
+            return {"params": jax.device_put(state["params"],
+                                             comm.named_sharding()),
+                    "opt": jax.device_put(state["opt"],
+                                          comm.named_sharding()),
+                    "loss": state["loss"]}
+
+        def run(path, injector=None):
+            ckpt = chainermn_tpu.create_multi_node_checkpointer(
+                "bench", comm, path=path)
+            it = chainermn_tpu.SerialIterator(
+                list(range(len(toks))), batch_size=batch, shuffle=True,
+                seed=7)
+            if injector is None:
+                return resilient_fit(step_fn, init_state(), it, n_steps,
+                                     ckpt, save_every=save_every,
+                                     restore_hook=restore_hook)
+            with injector:
+                return resilient_fit(step_fn, init_state(), it, n_steps,
+                                     ckpt, save_every=save_every,
+                                     restore_hook=restore_hook,
+                                     dump_on_failure=False)
+
+        with tempfile.TemporaryDirectory() as ref_dir:
+            ref_state, ref_report = run(ref_dir)
+        inj = FaultInjector(seed=0)
+        inj.arm("trainer.step", kind="raise", after=fault_step, times=1)
+        with tempfile.TemporaryDirectory() as crash_dir:
+            state, report = run(crash_dir, injector=inj)
+        bit_exact = bool(state["loss"] == ref_state["loss"])
+        mttr_s = report["mttr_s"][0] if report["mttr_s"] else None
+        ck = report["checkpoint_stats"]
+        log(f"crash at step {fault_step}: restores={report['restores']} "
+            f"mttr={mttr_s:.3f}s save={ck['save'] * 1e3:.1f}ms "
+            f"load={ck['load'] * 1e3:.1f}ms bit_exact={bit_exact}")
+
+        # ---- serving degradation burst (deterministic scenario) -------- #
+        from chainermn_tpu.serving import FCFSScheduler
+
+        eng_params = lm.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, prefill_len), jnp.int32))
+        engine = ServingEngine(lm, eng_params, n_slots=n_slots,
+                               prefill_len=prefill_len,
+                               cache_len=prefill_len + max_new)
+        sched = FCFSScheduler(engine, max_queue=4)
+
+        def prompt():
+            return rng.randint(
+                1, vocab, rng.randint(1, prefill_len + 1)).astype(np.int32)
+
+        reqs = []
+        for _ in range(n_slots):           # occupy every slot
+            reqs.append(sched.submit(prompt(), max_new))
+        sched.step()
+        for _ in range(3):                 # doomed: shed before admission
+            reqs.append(sched.submit(prompt(), 2, deadline_s=0.01))
+        rejected = 0
+        for _ in range(3):                 # overflow the bounded queue
+            try:
+                reqs.append(sched.submit(prompt(), 2))
+            except QueueFullError:
+                rejected += 1
+        time.sleep(0.05)                   # the doomed deadlines expire
+        sinj = FaultInjector(seed=0)
+        sinj.arm("serving.decode", kind="raise", times=1)
+        with sinj:                         # in-flight fail -> warm restart
+            sched.run_until_idle()
+        terminal = all(
+            r.state in (RequestState.DONE, RequestState.ERRORED,
+                        RequestState.CANCELLED) for r in reqs)
+        sm = sched.metrics.report()
+
+        snap = monitor.snapshot()
+        record = {
+            "metric": "resilience_mttr",
+            "value": round(mttr_s * 1e3, 3) if mttr_s is not None else None,
+            "unit": "ms",
+            "mode": "resilience",
+            "n_chips": len(devs),
+            "device_kind": devs[0].device_kind,
+            "bit_exact_resume": bit_exact,
+            "checkpoint_save_ms": round(ck["save"] * 1e3, 3),
+            "checkpoint_load_ms": round(ck["load"] * 1e3, 3),
+            "trainer": {
+                "steps": report["steps"],
+                "failures": report["failures"],
+                "restores": report["restores"],
+                "fault_step": fault_step,
+                "save_every": save_every,
+            },
+            "serving": {
+                "submitted": len(reqs),
+                "rejected": rejected,
+                "shed": sm["requests_shed"],
+                "errored": sm["requests_errored"],
+                "engine_restarts": sm["engine_restarts"],
+                "all_terminal": terminal,
+            },
+            "faults_injected": len(inj.fired_log) + len(sinj.fired_log),
+            "monitor": snap,
+        }
+    except Exception as exc:  # one parseable line, never a bare traceback
+        log(f"resilience smoke failed: {type(exc).__name__}: {exc}")
+        record = {
+            "metric": "resilience_mttr",
+            "value": None,
+            "unit": "ms",
+            "mode": "resilience",
+            "error": type(exc).__name__,
+            "detail": str(exc)[-500:],
+        }
+        print(json.dumps(record))
+        raise SystemExit(1)
+    print(json.dumps(record))
+    _scratch_write(record)
+
+
 def _failure_record(err_class: str, detail: str, attempts_run: int) -> dict:
     rec = {
         "metric": "resnet50_imagenet_train_throughput",
@@ -1082,8 +1296,9 @@ def parent_main() -> None:
 
 
 def _cli_mode(argv) -> str:
-    """``--mode serving`` / ``--mode monitor`` / ``--mode=...`` (default:
-    the ResNet training benchmark with its retry-parent machinery)."""
+    """``--mode serving`` / ``--mode monitor`` / ``--mode resilience`` /
+    ``--mode=...`` (default: the ResNet training benchmark with its
+    retry-parent machinery)."""
     for i, a in enumerate(argv):
         if a == "--mode" and i + 1 < len(argv):
             return argv[i + 1]
@@ -1098,8 +1313,11 @@ def main() -> None:
         serving_main()
     elif mode == "monitor":
         monitor_main()
+    elif mode == "resilience":
+        resilience_main()
     elif mode != "train":
-        raise SystemExit(f"unknown --mode {mode!r} (train|serving|monitor)")
+        raise SystemExit(
+            f"unknown --mode {mode!r} (train|serving|monitor|resilience)")
     elif "--child" in sys.argv:
         # child stdout carries ONLY the JSON record; everything else is stderr
         child_main()

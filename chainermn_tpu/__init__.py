@@ -21,6 +21,7 @@ from chainermn_tpu.extensions import (
 )
 from chainermn_tpu.global_except_hook import add_hook as add_global_except_hook
 from chainermn_tpu import monitor
+from chainermn_tpu import resilience
 from chainermn_tpu.iterators import (
     SerialIterator,
     create_multi_node_iterator,
@@ -79,5 +80,6 @@ __all__ = [
     "add_global_except_hook",
     "functions",
     "monitor",
+    "resilience",
     "__version__",
 ]

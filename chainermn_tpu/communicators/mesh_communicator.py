@@ -29,6 +29,7 @@ from chainermn_tpu.communicators import _object_comm
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase, ReduceOp
 from chainermn_tpu.monitor import annotate
 from chainermn_tpu.parallel import mesh as mesh_lib
+from chainermn_tpu.resilience.faults import inject
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -412,6 +413,11 @@ class MeshCommunicator(CommunicatorBase):
         """Run ``body`` (written against per-rank local arrays) over
         rank-major global inputs. ``args`` is a tuple; each element is a
         pytree whose every leaf has leading axis == global size."""
+        # fault cut-point: the host boundary of every eager collective
+        # (traced collectives fuse into compiled programs and cannot host-
+        # inject — a device-program failure is the engine/step boundary's
+        # scenario, exercised at serving.*/trainer.step instead)
+        inject(f"comm.{opname}")
         leaves, treedef = jax.tree_util.tree_flatten(args)
         gsize = self._global_size
         multiproc = jax.process_count() > 1
@@ -595,6 +601,9 @@ class MeshCommunicator(CommunicatorBase):
         return self._obj.gather_obj(obj, root)
 
     def allgather_obj(self, obj):
+        # cut-point: the host object channel the checkpoint agreement and
+        # registry aggregation ride (a raise here = a lost DCN peer)
+        inject("comm.allgather_obj")
         return self._obj.allgather_obj(obj)
 
     def allreduce_obj(self, obj, reduce_func: Callable | None = None):

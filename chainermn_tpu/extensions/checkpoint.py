@@ -12,6 +12,23 @@ unverified cite). Reference semantics, kept exactly:
   the whole job back to the last iteration everyone still has;
 - resume requires the same world size (snapshots are per-rank local).
 
+Torn-snapshot hardening (beyond the reference):
+
+- every snapshot carries a **CRC32 checksum footer**; ``maybe_load``
+  verifies it (and the unpickle) before trusting a file, so a torn write
+  that survived the atomic rename (truncated flush, lost page) is
+  *detected*, not resumed from;
+- a corrupt newest-common iteration is **skipped back** collectively:
+  every rank re-agrees without it and tries the next-newest, until an
+  iteration loads intact on all ranks (footer-less legacy files are
+  accepted — the unpickle is then the only integrity check);
+- orphaned ``.tmp`` files from crashed saves are swept at startup;
+- the save/load paths carry fault-injection cut-points
+  (``checkpoint.save`` / ``checkpoint.write`` / ``checkpoint.load``) and
+  an optional :class:`~chainermn_tpu.resilience.retry.RetryPolicy` for
+  host-transient I/O, and publish save/load latency histograms plus a
+  ``checkpoint_corrupt_total`` counter into the monitor registry.
+
 Serialization: state is any pytree of jax/numpy arrays plus picklable leaves
 (e.g. ``{"variables": ..., "opt_state": ..., "iterator": it.state_dict()}``).
 Arrays are fetched to host (``jax.device_get``) and pickled; writes are
@@ -26,12 +43,39 @@ from __future__ import annotations
 import os
 import pickle
 import re
+import struct
 import time
+import zlib
 from typing import Any, Optional
 
 import jax
 
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.resilience.faults import inject, torn_fraction
+
+# Footer: | payload ... | MAGIC (8B) | crc32 (4B, LE) | payload_len (8B, LE) |
+_FOOTER_MAGIC = b"CMNTPUC1"
+_FOOTER_TAIL = struct.Struct("<IQ")
+_FOOTER_LEN = len(_FOOTER_MAGIC) + _FOOTER_TAIL.size
+
+
+def _add_footer(payload: bytes) -> bytes:
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return payload + _FOOTER_MAGIC + _FOOTER_TAIL.pack(crc, len(payload))
+
+
+def _strip_footer(data: bytes) -> tuple[bytes, Optional[bool]]:
+    """``(payload, verified)`` — ``True``: checksum matched; ``False``:
+    footer present but corrupt; ``None``: legacy file without a footer
+    (the unpickle is then the only check)."""
+    if len(data) >= _FOOTER_LEN and data[-_FOOTER_LEN:-_FOOTER_TAIL.size] \
+            == _FOOTER_MAGIC:
+        crc, ln = _FOOTER_TAIL.unpack(data[-_FOOTER_TAIL.size:])
+        payload = data[:-_FOOTER_LEN]
+        ok = ln == len(payload) and (zlib.crc32(payload) & 0xFFFFFFFF) == crc
+        return payload, ok
+    return data, None
 
 
 class MultiNodeCheckpointer:
@@ -45,6 +89,7 @@ class MultiNodeCheckpointer:
         n_retains: int = 5,
         *,
         rank: Optional[int] = None,
+        retry=None,
     ) -> None:
         if not re.fullmatch(r"[A-Za-z0-9_.-]+", name):
             raise ValueError(f"checkpoint name must be filename-safe, got {name!r}")
@@ -54,7 +99,16 @@ class MultiNodeCheckpointer:
         self.path = os.path.abspath(path or os.getcwd())
         os.makedirs(self.path, exist_ok=True)
         self._n_retains = int(n_retains)
+        self._retry = retry
         self.stats: dict[str, list[float]] = {"save": [], "load": []}
+        reg = get_registry()
+        labels = {"name": name}
+        self._h_save = reg.histogram("checkpoint_save_seconds", labels,
+                                     unit="s")
+        self._h_load = reg.histogram("checkpoint_load_seconds", labels,
+                                     unit="s")
+        self._c_corrupt = reg.counter("checkpoint_corrupt_total", labels)
+        self._events = get_event_log()
         self._sweep_tmp()
 
     def _sweep_tmp(self) -> None:
@@ -101,17 +155,38 @@ class MultiNodeCheckpointer:
     def save(self, state: Any, iteration: int) -> str:
         """Snapshot this rank's ``state`` at ``iteration``; GC old ones."""
         t0 = time.time()
+        inject("checkpoint.save", iteration=int(iteration))
         target = self.filename(iteration)
         tmp = target + ".tmp"
         payload = {
             "world_size": self._world_size(),
             "state": jax.device_get(state),
         }
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f, protocol=4)
-        os.replace(tmp, target)
+        blob = _add_footer(pickle.dumps(payload, protocol=4))
+        # torn-write cut-point: a fired fault silently truncates the bytes
+        # that reach disk — the data-loss case only the checksum catches
+        frac = torn_fraction("checkpoint.write", iteration=int(iteration))
+        data = blob if frac is None else blob[: int(len(blob) * frac)]
+
+        def write() -> None:
+            with open(tmp, "wb") as f:
+                f.write(data[: len(data) // 2])
+                # mid-write cut-point: a raise here leaves a torn .tmp —
+                # the crash the atomic rename + startup sweep absorb
+                inject("checkpoint.write", iteration=int(iteration))
+                f.write(data[len(data) // 2:])
+            os.replace(tmp, target)
+
+        if self._retry is not None:
+            self._retry.call(write, op="checkpoint.save")
+        else:
+            write()
         self._gc()
-        self.stats["save"].append(time.time() - t0)
+        dt = time.time() - t0
+        self.stats["save"].append(dt)
+        self._h_save.observe(dt)
+        self._events.emit("checkpoint_save", iteration=int(iteration),
+                          bytes=len(data))
         return target
 
     def _gc(self) -> None:
@@ -124,31 +199,70 @@ class MultiNodeCheckpointer:
 
     # -- load ------------------------------------------------------------ #
 
+    def _try_load(self, iteration: int) -> Optional[dict]:
+        """Read + verify + unpickle one local snapshot; None when corrupt
+        (counted and event-logged, never raised — corruption is a vote to
+        skip back, not a crash)."""
+        try:
+            def read() -> bytes:
+                with open(self.filename(iteration), "rb") as f:
+                    return f.read()
+
+            data = (self._retry.call(read, op="checkpoint.load")
+                    if self._retry is not None else read())
+            payload_bytes, verified = _strip_footer(data)
+            if verified is False:
+                raise ValueError("checksum mismatch (torn write?)")
+            payload = pickle.loads(payload_bytes)
+            if not isinstance(payload, dict) or "state" not in payload:
+                raise ValueError("malformed snapshot payload")
+            return payload
+        except Exception as e:
+            self._c_corrupt.inc()
+            self._events.emit("checkpoint_corrupt",
+                              iteration=int(iteration),
+                              error=f"{type(e).__name__}: {e}"[:200])
+            return None
+
     def maybe_load(self, state: Any = None) -> tuple[Any, int]:
-        """Resume from the newest iteration available on ALL ranks.
+        """Resume from the newest iteration available AND intact on ALL
+        ranks.
 
         Returns ``(loaded_state, iteration)``; when no common snapshot
         exists, returns ``(state, 0)`` unchanged (fresh start) — the
         reference's ``resume = checkpointer.maybe_load(trainer)`` contract.
+        A corrupt copy anywhere (checksum/unpickle failure) makes every
+        rank discard that iteration and re-agree on the next-newest — the
+        skip-back loop is collective, so ranks never split over which
+        snapshot to trust.
         """
+        inject("checkpoint.load")
         local = set(self._local_iterations())
-        all_sets = self._comm.allgather_obj(local)
-        common = set.intersection(*map(set, all_sets)) if all_sets else set()
-        if not common:
-            return state, 0
-        it = max(common)
-        t0 = time.time()
-        with open(self.filename(it), "rb") as f:
-            payload = pickle.load(f)
-        world_now = self._world_size()
-        if payload["world_size"] != world_now:
-            raise RuntimeError(
-                f"snapshot '{self.name}' iteration {it} was taken with "
-                f"{payload['world_size']} processes but this job has "
-                f"{world_now}; per-rank snapshots require the same world size"
-            )
-        self.stats["load"].append(time.time() - t0)
-        return payload["state"], it
+        while True:
+            all_sets = self._comm.allgather_obj(local)
+            common = set.intersection(*map(set, all_sets)) if all_sets else set()
+            if not common:
+                return state, 0
+            it = max(common)
+            t0 = time.time()
+            payload = self._try_load(it)
+            oks = self._comm.allgather_obj(payload is not None)
+            if all(oks):
+                world_now = self._world_size()
+                if payload["world_size"] != world_now:
+                    raise RuntimeError(
+                        f"snapshot '{self.name}' iteration {it} was taken with "
+                        f"{payload['world_size']} processes but this job has "
+                        f"{world_now}; per-rank snapshots require the same "
+                        "world size"
+                    )
+                dt = time.time() - t0
+                self.stats["load"].append(dt)
+                self._h_load.observe(dt)
+                self._events.emit("checkpoint_load", iteration=int(it))
+                return payload["state"], it
+            # someone's copy of `it` is corrupt: skip back collectively
+            local.discard(it)
 
     # -- misc ------------------------------------------------------------ #
 

@@ -327,12 +327,15 @@ class Watchdog:
         # Flight recorder: what the system was DOING when it wedged — the
         # last N structured events (slot admits/retires, steps, compiles)
         # plus per-device memory stats, not just where threads are parked.
+        # once="failure": one dump per failure episode per sink — a warn-
+        # mode re-fire or the excepthook that follows an abort re-prints
+        # thread stacks but not a duplicate flight record.
         try:
             from chainermn_tpu.monitor import emit, get_event_log
 
             emit("watchdog_fire", where=where, timeout_s=self._timeout,
                  mode=self._mode)
-            get_event_log().dump(file=self._sink)
+            get_event_log().dump(file=self._sink, once="failure")
         except Exception:
             pass
         if self._mode == "abort":

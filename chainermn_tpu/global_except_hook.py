@@ -40,12 +40,14 @@ def _make_hook(prev_hook, exit_code: int):
             # the last events + device memory so the crash record says what
             # the process was doing, not just where it raised. Only when
             # already imported — a bare crash must not drag telemetry in.
+            # once="failure": a Watchdog fire or resilient-trainer boundary
+            # that already dumped this episode suppresses this layer's dump.
             mon = sys.modules.get("chainermn_tpu.monitor")
             if mon is not None:
                 try:
                     log = mon.get_event_log()
                     if len(log):
-                        log.dump(file=sys.stderr)
+                        log.dump(file=sys.stderr, once="failure")
                 except Exception:
                     pass
             sys.stderr.flush()

@@ -17,6 +17,7 @@ import json
 import sys
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Optional
 
@@ -68,6 +69,13 @@ class EventLog:
         self._ring: deque = deque(maxlen=capacity)
         self._seq = itertools.count()
         self._lock = threading.Lock()
+        # per-sink once-guard: {sink -> set of dump keys already written}.
+        # Weak keys so test sinks (StringIO) drop out with their tests;
+        # sys.stderr persists — which is exactly the sink the guard exists
+        # for (one failure must produce ONE dump across Watchdog /
+        # global_except_hook / the resilient-trainer boundary).
+        self._dump_guard: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary())
 
     def emit(self, kind: str, **fields) -> None:
         ev = {"i": next(self._seq), "t": round(time.time(), 6),
@@ -88,11 +96,39 @@ class EventLog:
         with self._lock:
             self._ring.clear()
 
-    def dump(self, file=None, last: int = 64, memory: bool = True) -> int:
+    def dump(self, file=None, last: int = 64, memory: bool = True,
+             once: Optional[str] = None) -> int:
         """Write the flight-recorder tail; returns the number of events
         dumped. Format: a banner, one JSON object per line (oldest first),
-        then per-device memory stats — grep-able and machine-parseable."""
+        then per-device memory stats — grep-able and machine-parseable.
+
+        ``once``: a failure-episode key — a second guarded dump with the
+        same key to the same sink is suppressed (one line notes it), so
+        layered failure paths (Watchdog fire -> exception -> excepthook)
+        produce exactly one dump. :meth:`reset_dump_guard` re-arms after a
+        successful recovery so the NEXT failure dumps again.
+        """
         sink = file or sys.stderr
+        if once is not None:
+            with self._lock:
+                try:
+                    keys = self._dump_guard.get(sink)
+                    if keys is None:
+                        keys = set()
+                        self._dump_guard[sink] = keys
+                except TypeError:      # un-weakref-able sink: never suppress
+                    keys = set()
+                if once in keys:
+                    try:
+                        print(
+                            "chainermn_tpu.monitor flight recorder: already "
+                            f"dumped for {once!r}; suppressing duplicate",
+                            file=sink,
+                        )
+                    except Exception:
+                        pass
+                    return 0
+                keys.add(once)
         evs = self.tail(last)
         print(
             f"chainermn_tpu.monitor flight recorder: last {len(evs)} "
@@ -114,6 +150,12 @@ class EventLog:
         except Exception:
             pass
         return len(evs)
+
+    def reset_dump_guard(self) -> None:
+        """Forget every once-key: the failure episode is over (recovery
+        succeeded), so a future failure dumps a fresh flight record."""
+        with self._lock:
+            self._dump_guard = weakref.WeakKeyDictionary()
 
 
 __all__ = ["EventLog", "device_memory_lines"]

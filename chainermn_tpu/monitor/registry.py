@@ -26,7 +26,10 @@ import numpy as np
 
 # module import, not the package facade: chainermn_tpu.extensions/__init__
 # may be mid-initialization when the communicator layer pulls monitor in
-from chainermn_tpu.extensions.profiling import latency_report
+# NOTE: `latency_report` is imported lazily inside Histogram.stats().
+# `extensions/__init__` imports `checkpoint`, which imports this package
+# (registry counters + flight-recorder events on checkpoint I/O); a
+# module-level import here would close that cycle.
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
@@ -146,6 +149,8 @@ class Histogram(_Instrument):
         if not samples:
             return out
         if self.unit == "s":
+            from chainermn_tpu.extensions.profiling import latency_report
+
             rep = latency_report(samples, "h")       # h_mean_s, h_p50_s, ...
             out.update({k[len("h_"):]: v for k, v in rep.items()})
         else:
@@ -319,6 +324,8 @@ def merge_rank_payloads(payloads: list) -> dict:
         samples = ent["samples"]
         if samples:
             if ent["unit"] == "s":
+                from chainermn_tpu.extensions.profiling import latency_report
+
                 rep = latency_report(samples, "h")
                 out.update({f[len("h_"):]: v for f, v in rep.items()})
             else:

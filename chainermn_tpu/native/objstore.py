@@ -114,11 +114,19 @@ MAX_VALUE_LEN = 1 << 31
 
 class ObjStoreClient:
     """One TCP connection to the store (thread-safe; the C side serializes
-    roundtrips per connection)."""
+    roundtrips per connection).
 
-    def __init__(self, host: str, port: int) -> None:
+    ``retry`` (a :class:`~chainermn_tpu.resilience.retry.RetryPolicy`, or
+    None) wraps each put/get roundtrip — a dropped frame or an injected
+    ``objstore.put``/``objstore.get`` fault is absorbed before the caller
+    sees a failed transfer. The fault cut-points sit INSIDE the retried
+    body, so injected transients exercise the retry path exactly like
+    real ones."""
+
+    def __init__(self, host: str, port: int, *, retry=None) -> None:
         lib = _load()
         self._lib = lib
+        self.retry = retry
         self._h = lib.objstore_client_connect(host.encode(), port)
         if not self._h:
             raise RuntimeError(f"objstore connect failed: {host}:{port}")
@@ -133,21 +141,39 @@ class ObjStoreClient:
                 f"{len(value)}B; caps {MAX_KEY_LEN}/{MAX_VALUE_LEN}) — "
                 "chunk the payload (NativeObjectComm does this automatically)"
             )
+        if self.retry is not None:
+            return self.retry.call(self._put_once, kb, value,
+                                   op="objstore.put")
+        return self._put_once(kb, value)
+
+    def _put_once(self, kb: bytes, value: bytes) -> None:
+        from chainermn_tpu.resilience.faults import inject
+
+        inject("objstore.put", key=kb.decode(), nbytes=len(value))
         buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) if value else None
         rc = self._lib.objstore_put(self._h, kb, len(kb), buf, len(value))
         if rc != 0:
-            raise RuntimeError(f"objstore put({key!r}) failed: rc={rc}")
+            raise RuntimeError(f"objstore put({kb!r}) failed: rc={rc}")
 
     def get(self, key: str, timeout_ms: int = 600_000) -> bytes:
         kb = key.encode()
+        if self.retry is not None:
+            return self.retry.call(self._get_once, kb, timeout_ms,
+                                   op="objstore.get")
+        return self._get_once(kb, timeout_ms)
+
+    def _get_once(self, kb: bytes, timeout_ms: int) -> bytes:
+        from chainermn_tpu.resilience.faults import inject
+
+        inject("objstore.get", key=kb.decode())
         out = ctypes.POINTER(ctypes.c_uint8)()
         n = ctypes.c_uint64(0)
         rc = self._lib.objstore_get(self._h, kb, len(kb), timeout_ms,
                                     ctypes.byref(out), ctypes.byref(n))
         if rc == 1:
-            raise TimeoutError(f"objstore get({key!r}) timed out ({timeout_ms}ms)")
+            raise TimeoutError(f"objstore get({kb!r}) timed out ({timeout_ms}ms)")
         if rc != 0:
-            raise RuntimeError(f"objstore get({key!r}) failed: rc={rc}")
+            raise RuntimeError(f"objstore get({kb!r}) failed: rc={rc}")
         try:
             return ctypes.string_at(out, n.value) if n.value else b""
         finally:

@@ -1,0 +1,48 @@
+"""``chainermn_tpu.resilience`` — failure as a first-class, tested scenario.
+
+The monitor subsystem (PR 2) made the system *observable*; this package
+makes it *survivable*, in three layers that compose with it:
+
+- **Fault injection** (:mod:`~chainermn_tpu.resilience.faults`): a
+  deterministic, seedable :class:`FaultInjector` over named cut-points
+  threaded through the framework's host-side boundaries — eager
+  ``MeshCommunicator`` collectives, ``ServingEngine`` device calls,
+  checkpoint I/O, the native dataloader/objstore paths. Every injected
+  fault (raise / delay / hang / torn-write) emits flight-recorder events
+  and registry counters, so chaos runs are diagnosed with the exact
+  tooling production failures are.
+- **Bounded retry** (:class:`RetryPolicy`): exponential backoff with
+  deterministic jitter around host-transient ops (checkpoint save/load,
+  objstore transfers, prefill admission).
+- **Auto-resume training** (:func:`resilient_fit` /
+  :class:`ResilientTrainer`): a step-level exception boundary that dumps
+  the flight recorder (idempotently — shared dump guard with ``Watchdog``
+  and ``global_except_hook``), restores the newest common
+  ``MultiNodeCheckpointer`` snapshot (state + iterator + any PRNG keys in
+  the state pytree), and replays bit-exactly under a restore budget.
+
+Serving-side graceful degradation (bounded admission queue, per-request
+deadlines, the terminal ``ERRORED`` state, warm engine restart) lives in
+:mod:`chainermn_tpu.serving` and consumes these primitives.
+"""
+
+from chainermn_tpu.resilience.faults import (
+    FaultInjector,
+    InjectedFault,
+    get_injector,
+    inject,
+    torn_fraction,
+)
+from chainermn_tpu.resilience.retry import RetryPolicy
+from chainermn_tpu.resilience.trainer import ResilientTrainer, resilient_fit
+
+__all__ = [
+    "FaultInjector",
+    "InjectedFault",
+    "ResilientTrainer",
+    "RetryPolicy",
+    "get_injector",
+    "inject",
+    "resilient_fit",
+    "torn_fraction",
+]

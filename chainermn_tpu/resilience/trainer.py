@@ -1,0 +1,197 @@
+"""Step-level exception boundary with checkpoint-restore recovery.
+
+The reference's recovery story is *fail-and-restart*: the job dies, the
+scheduler relaunches it, ``maybe_load`` resumes from the newest common
+snapshot (SURVEY.md S2.14). :func:`resilient_fit` closes the loop
+*inside* one launch as well: every training step runs inside an
+exception boundary that, on failure, dumps the monitor flight recorder
+(once per failure — the dump guard is shared with ``Watchdog`` and
+``global_except_hook`` so layered failure paths never stutter duplicate
+dumps), restores the newest common :class:`~chainermn_tpu.extensions.
+checkpoint.MultiNodeCheckpointer` snapshot, and replays from there under
+a bounded restore budget. Cross-launch resume falls out of the same
+path: a fresh process calling :func:`resilient_fit` over the same
+snapshot directory continues where the dead one stopped.
+
+Bit-exact resume contract: a snapshot carries the full replay state —
+the user ``state`` pytree (put your PRNG keys IN it; they round-trip
+through the pickle like any leaf) plus the iterator's
+``state_dict()`` — so the post-resume loss trajectory is identical to an
+uninterrupted run. Iteration ``k``'s snapshot holds the state *after*
+``k`` steps with the iterator positioned to draw batch ``k``; restore
+sets the loop index back to ``k`` and the replayed steps recompute the
+exact same math (``step_fn`` must be deterministic given ``(state,
+batch)`` — jitted steps on a fixed backend are).
+
+Buffer-donation note: the boundary never reuses the in-flight ``state``
+after a failure (it always restores from disk), so ``step_fn`` built
+with donated buffers is safe — a failed call may have consumed its
+inputs, and the restore path does not care.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, Optional
+
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.resilience.faults import inject
+from chainermn_tpu.resilience.retry import RetryPolicy
+
+
+class ResilientTrainer:
+    """Drive ``step_fn`` for ``n_steps`` with crash recovery.
+
+    Parameters
+    ----------
+    step_fn : callable
+        ``step_fn(state, batch) -> state`` — pure step over an arbitrary
+        ``state`` pytree (params, opt state, PRNG keys, host scalars).
+    checkpointer : MultiNodeCheckpointer
+        Owns snapshot naming, GC, checksum verification, and the
+        cross-rank newest-common-iteration agreement.
+    save_every : int
+        Snapshot cadence in steps (a snapshot is also taken at iteration
+        0 — before any batch — so a failure before the first periodic
+        save still has a restore point; and at ``n_steps``).
+    max_restores : int
+        Recovery budget; the failure that exhausts it re-raises.
+    retry : RetryPolicy, optional
+        Wrapped around checkpoint save/load I/O (host-transient faults
+        get absorbed before they count as a training failure). Default: 3
+        attempts.
+    dump_on_failure : bool
+        Dump the flight recorder (once per failure episode) to stderr at
+        the boundary.
+    restore_hook : callable, optional
+        ``restore_hook(state) -> state`` applied to every snapshot-loaded
+        state (resume and recovery alike) before stepping. Snapshots hold
+        host arrays (``jax.device_get``); a jitted ``step_fn`` whose math
+        depends on input placement (sharded params/opt state on a mesh)
+        needs them ``device_put`` back to the original shardings to keep
+        the resumed trajectory bit-exact.
+    """
+
+    def __init__(self, step_fn: Callable, checkpointer, *,
+                 save_every: int = 10, max_restores: int = 3,
+                 retry: Optional[RetryPolicy] = None,
+                 dump_on_failure: bool = True,
+                 restore_hook: Optional[Callable] = None) -> None:
+        if save_every < 1:
+            raise ValueError(f"save_every must be >= 1, got {save_every}")
+        self.step_fn = step_fn
+        self.checkpointer = checkpointer
+        self.save_every = int(save_every)
+        self.max_restores = int(max_restores)
+        self.retry = retry if retry is not None else RetryPolicy(3)
+        self.dump_on_failure = dump_on_failure
+        self.restore_hook = restore_hook
+        reg = get_registry()
+        self._c_failures = reg.counter("trainer_failures_total")
+        self._c_restores = reg.counter("trainer_restores_total")
+        self._h_mttr = reg.histogram("trainer_mttr_seconds", unit="s")
+        self._events = get_event_log()
+
+    # -- checkpoint plumbing --------------------------------------------- #
+
+    def _save(self, state, iterator, iteration: int) -> None:
+        snap = {"state": state, "iterator": iterator.state_dict()}
+        self.retry.call(self.checkpointer.save, snap, iteration,
+                        op="checkpoint.save")
+        self._events.emit("trainer_snapshot", iteration=iteration)
+
+    def _load(self):
+        return self.retry.call(self.checkpointer.maybe_load,
+                               op="checkpoint.load")
+
+    def _restore_state(self, state):
+        return state if self.restore_hook is None else \
+            self.restore_hook(state)
+
+    # -- the loop -------------------------------------------------------- #
+
+    def fit(self, state, iterator, n_steps: int, *,
+            on_step: Optional[Callable] = None) -> tuple:
+        """Run to ``n_steps`` total iterations (resuming included);
+        returns ``(state, report)`` where ``report`` carries
+        ``resumed_from`` / ``failures`` / ``restores`` / per-recovery
+        ``mttr_s`` (failure to first completed post-restore step) and the
+        checkpointer's save/load timing stats."""
+        loaded, start = self._load()
+        if loaded is not None:
+            state = self._restore_state(loaded["state"])
+            iterator.load_state_dict(loaded["iterator"])
+            if start:
+                self._events.emit("trainer_resume", iteration=start)
+        else:
+            # iteration-0 restore point: initial state, untouched iterator
+            self._save(state, iterator, 0)
+        resumed_from = start
+        failures = restores = 0
+        mttr: list = []
+        t_fail: Optional[float] = None
+        i = start
+        while i < n_steps:
+            try:
+                inject("trainer.step", step=i)
+                batch = next(iterator)
+                state = self.step_fn(state, batch)
+            except Exception as e:  # noqa: BLE001 — the recovery boundary
+                failures += 1
+                self._c_failures.inc()
+                self._events.emit("trainer_failure", step=i,
+                                  error=type(e).__name__, detail=str(e)[:200])
+                if t_fail is None:
+                    t_fail = time.perf_counter()
+                if self.dump_on_failure:
+                    get_event_log().dump(file=sys.stderr, once="failure")
+                if restores >= self.max_restores:
+                    self._events.emit("trainer_giving_up", step=i,
+                                      restores=restores)
+                    raise
+                loaded, it_r = self._load()
+                if loaded is None:
+                    raise  # no snapshot anywhere: nothing to restore
+                state = self._restore_state(loaded["state"])
+                iterator.load_state_dict(loaded["iterator"])
+                i = it_r
+                restores += 1
+                self._c_restores.inc()
+                self._events.emit("trainer_restore", iteration=it_r,
+                                  restores=restores)
+                get_event_log().reset_dump_guard()  # next failure dumps anew
+                continue
+            if t_fail is not None:
+                dt = time.perf_counter() - t_fail
+                mttr.append(dt)
+                self._h_mttr.observe(dt)
+                self._events.emit("trainer_recovered", step=i,
+                                  mttr_s=round(dt, 6))
+                t_fail = None
+            if on_step is not None:
+                on_step(i, state)
+            i += 1
+            if i % self.save_every == 0 or i == n_steps:
+                self._save(state, iterator, i)
+        report = {
+            "steps": int(n_steps),
+            "resumed_from": int(resumed_from),
+            "failures": int(failures),
+            "restores": int(restores),
+            "mttr_s": mttr,
+            "checkpoint_stats": self.checkpointer.get_stats(),
+        }
+        return state, report
+
+
+def resilient_fit(step_fn: Callable, state, iterator, n_steps: int,
+                  checkpointer, *, on_step: Optional[Callable] = None,
+                  **kwargs) -> tuple:
+    """One-call form of :class:`ResilientTrainer` (see its docstring):
+    ``state, report = resilient_fit(step, state, it, N, ckpt)``."""
+    trainer = ResilientTrainer(step_fn, checkpointer, **kwargs)
+    return trainer.fit(state, iterator, n_steps, on_step=on_step)
+
+
+__all__ = ["ResilientTrainer", "resilient_fit"]

@@ -28,10 +28,20 @@ the same params and rng.
 from chainermn_tpu.serving.client import ServingClient
 from chainermn_tpu.serving.engine import ServingEngine
 from chainermn_tpu.serving.metrics import ServingMetrics
-from chainermn_tpu.serving.scheduler import FCFSScheduler, Request, RequestState
+from chainermn_tpu.serving.scheduler import (
+    DeadlineExceededError,
+    EngineFailed,
+    FCFSScheduler,
+    QueueFullError,
+    Request,
+    RequestState,
+)
 
 __all__ = [
+    "DeadlineExceededError",
+    "EngineFailed",
     "FCFSScheduler",
+    "QueueFullError",
     "Request",
     "RequestState",
     "ServingClient",

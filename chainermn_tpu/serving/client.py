@@ -40,9 +40,16 @@ class ServingClient:
     """
 
     def __init__(self, engine, *, eos_id: Optional[int] = None,
-                 idle_wait_s: float = 0.05) -> None:
+                 idle_wait_s: float = 0.05,
+                 max_queue: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 retry=None, restart_on_error: bool = True,
+                 max_restarts: int = 8) -> None:
         self.engine = engine
-        self.scheduler = FCFSScheduler(engine, eos_id=eos_id)
+        self.scheduler = FCFSScheduler(
+            engine, eos_id=eos_id, max_queue=max_queue,
+            default_deadline_s=default_deadline_s, retry=retry,
+            restart_on_error=restart_on_error, max_restarts=max_restarts)
         self.metrics = self.scheduler.metrics
         self._work = threading.Event()
         self._stop = threading.Event()
@@ -57,23 +64,33 @@ class ServingClient:
     # ------------------------------------------------------------------ #
 
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
-               stream_cb: Optional[Callable[[int], None]] = None) -> Request:
+               stream_cb: Optional[Callable[[int], None]] = None,
+               deadline_s: Optional[float] = None) -> Request:
         """Enqueue a request; returns immediately. ``stream_cb`` (if set)
-        is invoked from the engine thread once per generated token."""
+        is invoked from the engine thread once per generated token.
+        Raises ``QueueFullError`` in the calling thread when the bounded
+        admission queue (``max_queue``) is at capacity — backpressure is
+        the submitter's signal, not a queued request's problem."""
         if self._failure is not None:
             raise RuntimeError("serving engine failed") from self._failure
         if self._stop.is_set():
             raise RuntimeError("client is closed")
         req = self.scheduler.submit(prompt, max_new_tokens, rng=rng,
-                                    stream_cb=stream_cb)
+                                    stream_cb=stream_cb,
+                                    deadline_s=deadline_s)
         self._work.set()
         return req
 
     def generate(self, prompt, max_new_tokens: int, *, rng=None,
-                 timeout: Optional[float] = None) -> np.ndarray:
+                 timeout: Optional[float] = None,
+                 deadline_s: Optional[float] = None) -> np.ndarray:
         """Blocking single-request decode: ``prompt + generated`` tokens,
-        the :func:`chainermn_tpu.models.generate`-shaped result."""
-        req = self.submit(prompt, max_new_tokens, rng=rng)
+        the :func:`chainermn_tpu.models.generate`-shaped result. A shed
+        or engine-failed (ERRORED) request re-raises its stored exception
+        here, in the caller's thread — degradation is loud, never a
+        silent hang."""
+        req = self.submit(prompt, max_new_tokens, rng=rng,
+                          deadline_s=deadline_s)
         if not req.wait(timeout):
             self.cancel(req)
             raise TimeoutError(

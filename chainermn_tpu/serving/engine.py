@@ -55,6 +55,7 @@ from chainermn_tpu.models.transformer import (
 )
 from chainermn_tpu.monitor import RecompileGuard, annotate
 from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.resilience.faults import inject
 
 
 class ServingEngine:
@@ -146,6 +147,8 @@ class ServingEngine:
         self._c_prefills = reg.counter("serving_prefills_total", labels)
         self._c_decode_steps = reg.counter("serving_decode_steps_total",
                                            labels)
+        self._c_restarts = reg.counter("serving_engine_restarts_total",
+                                       labels)
 
         if model.tensor_axis is not None:
             self._init_tp_caches(comm)
@@ -323,6 +326,9 @@ class ServingEngine:
         padded[0, : len(prompt)] = prompt
         with self._watched("serving prefill"), \
                 annotate("chainermn.serving_prefill"):
+            # fault cut-point INSIDE the watchdog window: an injected hang
+            # here exercises exactly the wedge hang detection exists for
+            inject("serving.prefill", slot=slot, prompt_len=len(prompt))
             self.caches, first, key = self._prefill_fn(
                 self.params, self.caches, jnp.asarray(padded),
                 jnp.int32(slot), jnp.int32(len(prompt)), rng)
@@ -348,6 +354,7 @@ class ServingEngine:
         # the serving watchdog exists to turn into a loud abort
         with self._watched("serving decode_step"), \
                 annotate("chainermn.serving_decode"):
+            inject("serving.decode", active=int(self._active.sum()))
             self.caches, nxt, self._keys = self._decode_fn(
                 self.params, self.caches, jnp.asarray(self._token),
                 jnp.asarray(self._pos), jnp.asarray(self._active),
@@ -378,6 +385,27 @@ class ServingEngine:
             return
         self._active[slot] = False
         self.free_slots.add(slot)
+
+    def restart(self) -> None:
+        """Warm restart after an engine-side failure: fresh KV caches and
+        cleared host slot mirrors, SAME compiled programs (the new arrays
+        have identical shapes/shardings, so nothing recompiles — pinned by
+        the restart test). Needed because a failed call may have consumed
+        the donated cache buffers; params are never donated and survive.
+        The scheduler drives this from its exception boundary; every
+        restart is a counted, event-logged recovery."""
+        if self.model.tensor_axis is not None:
+            self._init_tp_caches(self._comm)
+        else:
+            self.caches = init_kv_caches(self.model, self.n_slots,
+                                         self.cache_len)
+        self._token[:] = 0
+        self._pos[:] = 0
+        self._active[:] = False
+        self._keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
+        self.free_slots = set(range(self.n_slots))
+        self._c_restarts.inc()
+        self._events.emit("engine_restart")
 
     # ------------------------------------------------------------------ #
     # observability                                                       #

@@ -68,6 +68,16 @@ class ServingMetrics:
             "serving_requests_completed_total", labels)
         self._c_cancelled = reg.counter(
             "serving_requests_cancelled_total", labels)
+        # degradation counters (resilience layer): overload rejections at
+        # submit, deadline sheds from the queue, engine-failure erroreds,
+        # and warm engine restarts this scheduler drove
+        self._c_rejected = reg.counter(
+            "serving_requests_rejected_total", labels)
+        self._c_shed = reg.counter("serving_requests_shed_total", labels)
+        self._c_errored = reg.counter(
+            "serving_requests_errored_total", labels)
+        self._c_restarts = reg.counter(
+            "serving_scheduler_restarts_total", labels)
         self._c_tokens = reg.counter("serving_tokens_total", labels)
         self._h_ttft = reg.histogram("serving_ttft_seconds", labels, unit="s")
         self._h_tpot = reg.histogram("serving_tpot_seconds", labels, unit="s")
@@ -104,6 +114,18 @@ class ServingMetrics:
     def record_done(self, cancelled: bool = False) -> None:
         (self._c_cancelled if cancelled else self._c_completed).inc()
 
+    def record_rejected(self) -> None:
+        self._c_rejected.inc()
+
+    def record_shed(self) -> None:
+        self._c_shed.inc()
+
+    def record_errored(self) -> None:
+        self._c_errored.inc()
+
+    def record_restart(self) -> None:
+        self._c_restarts.inc()
+
     def record_step(self, queue_depth: int, active_slots: int) -> None:
         self._h_queue.observe(queue_depth)
         self._h_occ.observe(active_slots / self.n_slots)
@@ -132,6 +154,22 @@ class ServingMetrics:
         return self._c_cancelled.value
 
     @property
+    def requests_rejected(self) -> int:
+        return self._c_rejected.value
+
+    @property
+    def requests_shed(self) -> int:
+        return self._c_shed.value
+
+    @property
+    def requests_errored(self) -> int:
+        return self._c_errored.value
+
+    @property
+    def engine_restarts(self) -> int:
+        return self._c_restarts.value
+
+    @property
     def tokens_generated(self) -> int:
         return self._c_tokens.value
 
@@ -150,6 +188,10 @@ class ServingMetrics:
             "requests_submitted": self.requests_submitted,
             "requests_completed": self.requests_completed,
             "requests_cancelled": self.requests_cancelled,
+            "requests_rejected": self.requests_rejected,
+            "requests_shed": self.requests_shed,
+            "requests_errored": self.requests_errored,
+            "engine_restarts": self.engine_restarts,
             "tokens_generated": self.tokens_generated,
             "tokens_per_sec": round(self.tokens_per_sec, 2),
             "n_slots": self.n_slots,

@@ -4,16 +4,41 @@ The engine (:mod:`chainermn_tpu.serving.engine`) is pure mechanism: it
 advances whatever occupies its slots. This module is the policy layer — a
 first-come-first-served queue whose requests move through
 
-    QUEUED -> PREFILL -> DECODE -> DONE            (or CANCELLED)
+    QUEUED -> PREFILL -> DECODE -> DONE      (or CANCELLED, or ERRORED)
 
-One :meth:`FCFSScheduler.step` is one engine round: fill every freed slot
-from the queue (one prefill each — prefill interleaves with decode at step
-granularity, the classic continuous-batching schedule), advance all active
-slots one token, deliver tokens to per-request streams, and retire slots
-whose request hit EOS or its token budget. Retirement frees the slot for
-the NEXT step's admissions, so the pool refills without ever waiting for
-the whole batch to finish — the property that separates this from the
-offline ``generate()`` path.
+One :meth:`FCFSScheduler.step` is one engine round: shed expired QUEUED
+requests, fill every freed slot from the queue (one prefill each —
+prefill interleaves with decode at step granularity, the classic
+continuous-batching schedule), advance all active slots one token,
+deliver tokens to per-request streams, and retire slots whose request hit
+EOS or its token budget. Retirement frees the slot for the NEXT step's
+admissions, so the pool refills without ever waiting for the whole batch
+to finish — the property that separates this from the offline
+``generate()`` path.
+
+Graceful degradation (the resilience layer):
+
+- **Bounded admission** — ``max_queue`` rejects overload at submit time
+  with :class:`QueueFullError` instead of queueing unboundedly (the
+  caller sees backpressure immediately; a shed deep in the queue later
+  helps nobody).
+- **Deadlines** — a request carrying ``deadline_s`` (or the scheduler's
+  ``default_deadline_s``) that is still QUEUED past its deadline is shed:
+  terminal ``ERRORED`` with a stored :class:`DeadlineExceededError`, so
+  ``wait()`` raises instead of blocking on work that will never start.
+- **Engine exception boundary** — a raised device call fails every
+  in-flight request loudly (``ERRORED`` with the exception stored; no
+  ``wait()`` ever hangs on a dead engine), then — ``restart_on_error``,
+  the default — warm-restarts the engine (fresh caches and slot mirrors,
+  SAME compiled programs) and keeps serving the queue. Restarts are
+  bounded by ``max_restarts``; past the budget the exception propagates.
+- **Admission retry** — an optional
+  :class:`~chainermn_tpu.resilience.retry.RetryPolicy` around each
+  prefill absorbs transient faults before they count as engine failures.
+
+Every transition is observable: ``reject`` / ``shed`` / ``engine_error``
+/ ``engine_restart`` events in the flight recorder and matching
+``ServingMetrics`` registry counters.
 
 Thread model: ``submit``/``cancel`` are safe from any thread (they only
 touch the locked queue and request state); ``step`` must be driven from
@@ -25,18 +50,28 @@ from __future__ import annotations
 
 import enum
 import itertools
+import sys
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable, Iterator, Optional
 
 import jax
 import numpy as np
 
 from chainermn_tpu.monitor import annotate
 from chainermn_tpu.monitor._state import get_event_log
+from chainermn_tpu.resilience.retry import RetryPolicy
 from chainermn_tpu.serving.metrics import ServingMetrics
+
+
+class QueueFullError(RuntimeError):
+    """Submission rejected: the bounded admission queue is at capacity."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request was still queued past its deadline and was shed."""
 
 
 class RequestState(enum.Enum):
@@ -45,13 +80,19 @@ class RequestState(enum.Enum):
     DECODE = "decode"
     DONE = "done"
     CANCELLED = "cancelled"
+    ERRORED = "errored"
+
+
+class EngineFailed(RuntimeError):
+    """Stored on requests that were in flight when the engine raised (the
+    original engine exception is the ``__cause__``)."""
 
 
 @dataclass
 class Request:
     """One inference request and its full lifecycle state. Created by
     :meth:`FCFSScheduler.submit`; treat as read-only outside the scheduler
-    (``wait()``/``output`` are the consumer surface)."""
+    (``wait()``/``output``/``stream()`` are the consumer surface)."""
 
     prompt: np.ndarray
     max_new_tokens: int
@@ -62,27 +103,54 @@ class Request:
     slot: int = -1
     tokens: list = field(default_factory=list)
     error: Optional[BaseException] = None
+    deadline_s: Optional[float] = None
     t_submit: float = 0.0
+    t_deadline: Optional[float] = None
     t_last_token: float = 0.0
     _done: threading.Event = field(default_factory=threading.Event)
 
     @property
     def finished(self) -> bool:
-        return self.state in (RequestState.DONE, RequestState.CANCELLED)
+        return self.state in (RequestState.DONE, RequestState.CANCELLED,
+                              RequestState.ERRORED)
 
     @property
     def output(self) -> np.ndarray:
         """``prompt + generated`` tokens (the ``generate()``-shaped
-        result, without its trailing pad)."""
+        result, without its trailing pad). An ERRORED request re-raises
+        its stored exception instead of returning a silent partial."""
+        if self.error is not None:
+            raise self.error
         return np.concatenate(
             [self.prompt, np.asarray(self.tokens, np.int32)])
 
     def wait(self, timeout: Optional[float] = None) -> bool:
-        """Block until DONE/CANCELLED (or error); True if finished."""
+        """Block until DONE/CANCELLED/ERRORED; True if finished. An
+        ERRORED request re-raises its stored exception in the caller."""
         ok = self._done.wait(timeout)
         if self.error is not None:
             raise self.error
         return ok
+
+    def stream(self, poll_s: float = 0.01) -> Iterator[int]:
+        """Yield generated tokens as they arrive; returns at a terminal
+        state — re-raising the stored exception for ERRORED requests, so
+        a streaming consumer hears about the failure instead of seeing a
+        quietly truncated stream. (``tokens`` is append-only, so the
+        index scan is safe against the engine thread.)"""
+        i = 0
+        while True:
+            while i < len(self.tokens):
+                yield self.tokens[i]
+                i += 1
+            if self._done.is_set():
+                while i < len(self.tokens):
+                    yield self.tokens[i]
+                    i += 1
+                if self.error is not None:
+                    raise self.error
+                return
+            self._done.wait(poll_s)
 
 
 class FCFSScheduler:
@@ -94,13 +162,30 @@ class FCFSScheduler:
     (``max_new_tokens``) applies either way. Both are host-side policy
     BETWEEN engine steps; inside the compiled programs shapes never
     change (see the engine's ``jnp.where`` masking).
+
+    Degradation knobs (module docstring): ``max_queue``,
+    ``default_deadline_s``, ``retry`` (prefill admission),
+    ``restart_on_error``/``max_restarts``.
     """
 
     def __init__(self, engine, *, eos_id: Optional[int] = None,
-                 metrics: Optional[ServingMetrics] = None) -> None:
+                 metrics: Optional[ServingMetrics] = None,
+                 max_queue: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 restart_on_error: bool = True,
+                 max_restarts: int = 8) -> None:
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.engine = engine
         self.eos_id = eos_id
         self.metrics = metrics or ServingMetrics(engine.n_slots)
+        self.max_queue = max_queue
+        self.default_deadline_s = default_deadline_s
+        self._retry = retry
+        self._restart_on_error = restart_on_error
+        self._max_restarts = int(max_restarts)
+        self._restarts = 0
         self._events = get_event_log()
         self._queue: deque[Request] = deque()
         self._by_slot: dict[int, Request] = {}
@@ -112,16 +197,30 @@ class FCFSScheduler:
     # ------------------------------------------------------------------ #
 
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
-               stream_cb: Optional[Callable[[int], None]] = None) -> Request:
+               stream_cb: Optional[Callable[[int], None]] = None,
+               deadline_s: Optional[float] = None) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.engine.validate_request(len(prompt), max_new_tokens)
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             rng=rng if rng is not None else jax.random.PRNGKey(0),
-            stream_cb=stream_cb,
+            stream_cb=stream_cb, deadline_s=deadline_s,
         )
         req.t_submit = time.perf_counter()
+        if deadline_s is not None:
+            req.t_deadline = req.t_submit + float(deadline_s)
         with self._lock:
+            if (self.max_queue is not None
+                    and len(self._queue) >= self.max_queue):
+                self.metrics.record_rejected()
+                self._events.emit("reject", prompt_len=len(prompt),
+                                  queue_depth=len(self._queue))
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue} queued); "
+                    "retry later or raise max_queue"
+                )
             req.id = next(self._ids)
             self._queue.append(req)
             self.metrics.record_submit()
@@ -162,15 +261,21 @@ class FCFSScheduler:
         with self._lock:
             return len(self._queue)
 
+    @property
+    def engine_restarts(self) -> int:
+        """Warm restarts this scheduler has driven (for reports/tests)."""
+        return self._restarts
+
     # ------------------------------------------------------------------ #
     # the scheduling loop (one driving thread)                            #
     # ------------------------------------------------------------------ #
 
     def step(self) -> int:
         """One continuous-batching round; returns tokens emitted (0 when
-        idle). Admissions first — freed slots refill BEFORE the decode
-        step, so a retirement's slot never sits idle for a step."""
+        idle). Shedding, then admissions — freed slots refill BEFORE the
+        decode step, so a retirement's slot never sits idle for a step."""
         emitted = 0
+        self._shed_expired()
         # 1. admission: one prefill per free slot, FCFS
         with annotate("chainermn.serving_admit"):
             while self.engine.free_slots:
@@ -179,7 +284,17 @@ class FCFSScheduler:
                         break
                     req = self._queue.popleft()
                     req.state = RequestState.PREFILL
-                slot, first = self.engine.prefill(req.prompt, req.rng)
+                try:
+                    if self._retry is not None:
+                        slot, first = self._retry.call(
+                            self.engine.prefill, req.prompt, req.rng,
+                            op="serving.prefill")
+                    else:
+                        slot, first = self.engine.prefill(req.prompt, req.rng)
+                except Exception as e:  # noqa: BLE001 — degradation boundary
+                    if not self._engine_failure(e, admitting=req):
+                        raise
+                    continue  # engine restarted: keep admitting the queue
                 now = time.perf_counter()
                 with self._lock:
                     if req.state is RequestState.CANCELLED:
@@ -198,7 +313,13 @@ class FCFSScheduler:
                 self._deliver(req, first, now)
                 emitted += 1
         # 2. decode: every active slot, one token, one compiled call
-        for slot, tok in self.engine.decode_step().items():
+        try:
+            decoded = self.engine.decode_step()
+        except Exception as e:  # noqa: BLE001 — degradation boundary
+            if not self._engine_failure(e):
+                raise
+            decoded = {}
+        for slot, tok in decoded.items():
             req = self._by_slot.get(slot)
             if req is None:            # released mid-flight (cancelled)
                 continue
@@ -221,6 +342,74 @@ class FCFSScheduler:
             if max_steps is not None and steps >= max_steps:
                 break
         return total
+
+    # ------------------------------------------------------------------ #
+    # degradation internals                                               #
+    # ------------------------------------------------------------------ #
+
+    def _shed_expired(self) -> None:
+        """Fail QUEUED requests past their deadline (terminal ERRORED with
+        DeadlineExceededError stored) — work that can no longer meet its
+        deadline must not consume a slot another request could use."""
+        now = time.perf_counter()
+        expired: list[Request] = []
+        with self._lock:
+            if not self._queue:
+                return
+            keep: deque[Request] = deque()
+            for req in self._queue:
+                if req.t_deadline is not None and now >= req.t_deadline:
+                    req.error = DeadlineExceededError(
+                        f"request {req.id} spent its {req.deadline_s}s "
+                        "deadline in the admission queue"
+                    )
+                    req.state = RequestState.ERRORED
+                    self.metrics.record_shed()
+                    expired.append(req)
+                else:
+                    keep.append(req)
+            self._queue = keep
+        for req in expired:
+            self._events.emit("shed", req=req.id,
+                              waited_s=round(now - req.t_submit, 6))
+            req._done.set()
+
+    def _engine_failure(self, e: BaseException,
+                        admitting: Optional[Request] = None) -> bool:
+        """The engine raised mid-round: fail every in-flight request
+        loudly (their cache/slot state is unknown), dump the flight
+        recorder once, and — within the restart budget — warm-restart the
+        engine so the queue keeps being served. Returns True when the
+        engine was restarted; False tells the caller to re-raise."""
+        with self._lock:
+            victims = list(self._by_slot.values())
+            self._by_slot.clear()
+            if admitting is not None:
+                victims.append(admitting)
+            for req in victims:
+                if req.finished:
+                    continue
+                if req.error is None:
+                    failure = EngineFailed(
+                        f"engine failed while request {req.id} was in "
+                        f"flight: {type(e).__name__}: {e}")
+                    failure.__cause__ = e
+                    req.error = failure
+                req.state = RequestState.ERRORED
+                self.metrics.record_errored()
+        self._events.emit("engine_error", error=type(e).__name__,
+                          detail=str(e)[:200], in_flight=len(victims))
+        get_event_log().dump(file=sys.stderr, last=32, once="failure")
+        for req in victims:
+            req._done.set()
+        if not self._restart_on_error or self._restarts >= self._max_restarts:
+            return False
+        self.engine.restart()
+        self._restarts += 1
+        self.metrics.record_restart()
+        self._events.emit("engine_restart", restarts=self._restarts)
+        get_event_log().reset_dump_guard()  # recovered: next failure dumps
+        return True
 
     # ------------------------------------------------------------------ #
     # internals                                                           #
@@ -251,4 +440,11 @@ class FCFSScheduler:
         req._done.set()
 
 
-__all__ = ["FCFSScheduler", "Request", "RequestState"]
+__all__ = [
+    "DeadlineExceededError",
+    "EngineFailed",
+    "FCFSScheduler",
+    "QueueFullError",
+    "Request",
+    "RequestState",
+]

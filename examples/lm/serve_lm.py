@@ -17,6 +17,13 @@ the client), and ``--prometheus`` prints the process-wide
 :func:`chainermn_tpu.monitor.exposition` text — the same series a
 Prometheus scraper would pull.
 
+And the graceful-degradation demo: ``--max-queue N`` bounds the admission
+queue (overflow submissions are rejected with ``QueueFullError`` —
+backpressure at the submitter) and ``--deadline SECONDS`` sheds requests
+still queued past their deadline (``wait()`` raises
+``DeadlineExceededError`` instead of blocking on work that will never
+start). See README "Fault tolerance".
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -42,7 +49,11 @@ from chainermn_tpu.utils import apply_env_platform
 apply_env_platform()
 from chainermn_tpu import monitor  # noqa: E402
 from chainermn_tpu.models import TransformerLM  # noqa: E402
-from chainermn_tpu.serving import ServingClient, ServingEngine  # noqa: E402
+from chainermn_tpu.serving import (  # noqa: E402
+    QueueFullError,
+    ServingClient,
+    ServingEngine,
+)
 
 
 def main() -> None:
@@ -67,6 +78,16 @@ def main() -> None:
                     help="arm the engine hang watchdog: a decode step "
                          "exceeding this many seconds dumps the flight "
                          "recorder + thread stacks and aborts (0: off)")
+    ap.add_argument("--max-queue", type=int, default=0,
+                    help="bounded admission queue: submissions beyond this "
+                         "many queued requests are rejected with "
+                         "QueueFullError — backpressure instead of "
+                         "unbounded queueing (0: unbounded)")
+    ap.add_argument("--deadline", type=float, default=0.0,
+                    help="per-request deadline in seconds: work still "
+                         "queued past it is shed (terminal ERRORED, "
+                         "wait() raises DeadlineExceededError) instead of "
+                         "occupying a slot too late to matter (0: off)")
     ap.add_argument("--prometheus", action="store_true",
                     help="print the Prometheus text exposition of the "
                          "process metrics registry at the end")
@@ -98,34 +119,47 @@ def main() -> None:
     )
     eos = None if args.eos_id < 0 else args.eos_id
     t0 = time.time()
+    rejected = shed_or_failed = 0
     with monitor.annotate("chainermn.serve_lm_burst"), \
-            ServingClient(engine, eos_id=eos) as client:
+            ServingClient(engine, eos_id=eos,
+                          max_queue=args.max_queue or None,
+                          default_deadline_s=args.deadline or None) as client:
         # one streaming request: tokens arrive as they are decoded
         stream_toks: list[int] = []
         streamed = client.submit(
             rng.randint(2, args.vocab, 5).astype(np.int32), args.max_new,
             rng=jax.random.PRNGKey(1), stream_cb=stream_toks.append)
-        # a burst of blocking requests with ragged prompt lengths
-        handles = [
-            client.submit(
-                rng.randint(2, args.vocab,
-                            rng.randint(1, args.prefill_len + 1))
-                .astype(np.int32),
-                int(rng.randint(1, args.max_new + 1)),
-                rng=jax.random.PRNGKey(100 + i),
-            )
-            for i in range(args.requests - 1)
-        ]
-        for h in handles:
-            h.wait(timeout=600)
-        streamed.wait(timeout=600)
+        # a burst of blocking requests with ragged prompt lengths; with
+        # --max-queue the bounded queue may bounce some (backpressure is
+        # the submitter's signal — a real client would retry later)
+        handles = []
+        for i in range(args.requests - 1):
+            try:
+                handles.append(client.submit(
+                    rng.randint(2, args.vocab,
+                                rng.randint(1, args.prefill_len + 1))
+                    .astype(np.int32),
+                    int(rng.randint(1, args.max_new + 1)),
+                    rng=jax.random.PRNGKey(100 + i),
+                ))
+            except QueueFullError:
+                rejected += 1
+        for h in handles + [streamed]:
+            try:
+                h.wait(timeout=600)
+            except Exception as e:  # shed past --deadline, or engine-failed
+                shed_or_failed += 1
+                print(f"request {h.id}: {type(e).__name__}: {e}")
         report = client.metrics.report()
 
     print(f"streamed request: {len(stream_toks)} tokens "
           f"(first few: {stream_toks[:8]})")
-    done = sum(1 for h in handles if h.finished) + streamed.finished
+    done = sum(1 for h in handles if h.state.value == "done") \
+        + (streamed.state.value == "done")
     print(f"{done}/{args.requests} requests served in "
-          f"{time.time() - t0:.2f}s through {args.slots} slots")
+          f"{time.time() - t0:.2f}s through {args.slots} slots "
+          f"({rejected} rejected at admission, {shed_or_failed} "
+          "shed/failed)")
     for k, v in sorted(report.items()):
         print(f"  {k}: {v}")
     print(f"engine executables: {engine.compile_counts()} "

@@ -192,6 +192,69 @@ def run_pipeline(args, comm) -> None:
                            toks, tgts, n_seq, batch)
 
 
+def run_resilient(args, comm, step, params, opt_state,
+                  tokens_all, targets_all, n_seq, batch) -> None:
+    """``--resume``: the same jitted step driven by
+    :func:`chainermn_tpu.resilience.resilient_fit` — periodic snapshots of
+    (params, optimizer state, iterator position), a step-level exception
+    boundary that restores the newest intact snapshot on failure, and
+    cross-launch resume: rerunning the command continues from where the
+    last launch stopped, on the same loss trajectory."""
+    import chainermn_tpu.resilience as resilience
+
+    ckpt = chainermn_tpu.create_multi_node_checkpointer(
+        "train_lm", comm, path=args.checkpoint_dir)
+    # drop the ragged tail (as the non-resume loop's generator does): the
+    # sharded step needs every batch exactly `batch` rows
+    it = chainermn_tpu.SerialIterator(
+        list(range(n_seq - n_seq % batch)), batch_size=batch,
+        shuffle=True, seed=1)
+
+    def step_fn(state, sel):
+        sel = np.asarray(sel)
+        p, o, loss, _ = step(state["params"], state["opt_state"],
+                             jnp.asarray(tokens_all[sel]),
+                             jnp.asarray(targets_all[sel]))
+        return {"params": p, "opt_state": o, "loss": float(loss)}
+
+    def restore_hook(state):
+        # snapshots hold host arrays; put them back with the step's
+        # (replicated) shardings so the resumed trajectory is bit-exact
+        return {
+            "params": jax.device_put(state["params"],
+                                     comm.named_sharding()),
+            "opt_state": jax.device_put(state["opt_state"],
+                                        comm.named_sharding()),
+            "loss": state["loss"],
+        }
+
+    def on_step(i, state):
+        if (i + 1) % 20 == 0 and comm.rank == 0:
+            print(f"iter {i + 1:4d}  loss {state['loss']:.3f}")
+
+    injector = None
+    if args.inject_fault:
+        injector = resilience.FaultInjector(seed=0)
+        injector.arm("trainer.step", kind="raise",
+                     after=args.inject_fault, times=1)
+        injector.install()
+    try:
+        state, report = resilience.resilient_fit(
+            step_fn, {"params": params, "opt_state": opt_state,
+                      "loss": None},
+            it, args.iterations, ckpt, save_every=args.save_every,
+            restore_hook=restore_hook, on_step=on_step)
+    finally:
+        if injector is not None:
+            injector.uninstall()
+    if comm.rank == 0:
+        mttr = (f"  mttr {report['mttr_s'][0] * 1e3:.0f}ms"
+                if report["mttr_s"] else "")
+        print(f"done: loss {state['loss']:.3f}  resumed_from "
+              f"{report['resumed_from']}  failures {report['failures']}  "
+              f"restores {report['restores']}{mttr}")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description="ChainerMN-TPU example: LM")
     parser.add_argument("--vocab", type=int, default=64)
@@ -245,6 +308,23 @@ def main() -> None:
                         help="with --tensor-parallel: shard the LM head "
                              "over the vocab; full logits are never "
                              "materialized (sharded-vocab cross entropy)")
+    parser.add_argument("--resume", action="store_true",
+                        help="run the (plain-DP) training loop through "
+                             "resilience.resilient_fit: periodic snapshots "
+                             "(params + optimizer + iterator + loop "
+                             "index), auto-restore on a step failure, and "
+                             "cross-launch resume — rerun the same "
+                             "command after a crash and it continues from "
+                             "the newest intact snapshot")
+    parser.add_argument("--checkpoint-dir", default="./lm_checkpoints",
+                        help="with --resume: snapshot directory")
+    parser.add_argument("--save-every", type=int, default=20,
+                        help="with --resume: snapshot cadence in steps")
+    parser.add_argument("--inject-fault", type=int, default=0,
+                        help="with --resume: crash training at this step "
+                             "(a seeded resilience.FaultInjector raise) "
+                             "to demo the restore loop end to end "
+                             "(0: off)")
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--n-tokens", type=int, default=200_000)
     parser.add_argument("--max-len", type=int, default=None,
@@ -281,6 +361,10 @@ def main() -> None:
         raise SystemExit("--gspmd runs the dense model; --attention must be "
                          "full or flash (sequence-sharded kinds need the "
                          "shard_map step)")
+    if args.resume and (args.gspmd or args.pipeline):
+        raise SystemExit("--resume wraps the plain/SP/TP/MoE train loop in "
+                         "resilient_fit; the gspmd/pipeline modes build "
+                         "their own loops and would silently ignore it")
     if args.gspmd:
         return run_gspmd(args, comm)
     if args.pipeline:
@@ -395,6 +479,10 @@ def main() -> None:
         print(f"{n_params / 1e6:.2f}M params  attention={args.attention} "
               f"seq_parallel={args.seq_parallel} moe={args.moe_experts} "
               f"tensor_parallel={args.tensor_parallel} devices={comm.size}")
+
+    if args.resume:
+        return run_resilient(args, comm, step, params, opt_state,
+                             tokens_all, targets_all, n_seq, batch)
 
     from chainermn_tpu.parallel import MoeStatsAccumulator
 

@@ -1,0 +1,156 @@
+"""FaultInjector: determinism, kinds, cut-point no-op contract, telemetry."""
+
+import threading
+import time
+
+import pytest
+
+from chainermn_tpu.monitor import get_event_log, get_registry
+from chainermn_tpu.resilience import (
+    FaultInjector,
+    InjectedFault,
+    get_injector,
+    inject,
+    torn_fraction,
+)
+
+
+def test_inject_is_noop_without_injector():
+    assert get_injector() is None
+    inject("anything.at.all")              # must not raise
+    assert torn_fraction("anything") is None
+
+
+def test_context_manager_installs_and_uninstalls():
+    inj = FaultInjector()
+    with inj:
+        assert get_injector() is inj
+    assert get_injector() is None
+
+
+def test_raise_after_and_times():
+    inj = FaultInjector()
+    inj.arm("p", kind="raise", after=2, times=1)
+    with inj:
+        inject("p")                        # hit 1: within `after`
+        inject("p")                        # hit 2: within `after`
+        with pytest.raises(InjectedFault) as ei:
+            inject("p")                    # hit 3: fires
+        assert ei.value.point == "p"
+        inject("p")                        # `times` exhausted: no-op again
+    assert inj.fired_log == [("p", "raise")]
+
+
+def test_custom_exception():
+    inj = FaultInjector()
+    inj.arm("p", kind="raise", exc=ValueError("boom"))
+    with inj:
+        with pytest.raises(ValueError, match="boom"):
+            inject("p")
+
+
+def test_point_isolation():
+    inj = FaultInjector()
+    inj.arm("a", kind="raise")
+    with inj:
+        inject("b")                        # different point: untouched
+        with pytest.raises(InjectedFault):
+            inject("a")
+
+
+def test_delay_sleeps():
+    inj = FaultInjector()
+    inj.arm("p", kind="delay", delay_s=0.05, times=1)
+    with inj:
+        t0 = time.perf_counter()
+        inject("p")
+        assert time.perf_counter() - t0 >= 0.05
+        t0 = time.perf_counter()
+        inject("p")                        # exhausted: no sleep
+        assert time.perf_counter() - t0 < 0.04
+
+
+def test_hang_blocks_until_release():
+    inj = FaultInjector()
+    inj.arm("p", kind="hang", hang_s=60.0)
+    unblocked = threading.Event()
+
+    def victim():
+        inject("p")
+        unblocked.set()
+
+    with inj:
+        t = threading.Thread(target=victim, daemon=True)
+        t.start()
+        assert not unblocked.wait(0.15)    # genuinely wedged
+        inj.release()
+        assert unblocked.wait(5.0)         # release() cuts the hang short
+        t.join(5.0)
+
+
+def test_hang_times_out_on_its_own():
+    inj = FaultInjector()
+    inj.arm("p", kind="hang", hang_s=0.1)
+    with inj:
+        t0 = time.perf_counter()
+        inject("p")
+        assert time.perf_counter() - t0 >= 0.1
+
+
+def test_seeded_probability_is_deterministic():
+    def run(seed):
+        inj = FaultInjector(seed=seed)
+        inj.arm("p", kind="raise", p=0.5, times=None)
+        fired = []
+        with inj:
+            for _ in range(40):
+                try:
+                    inject("p")
+                    fired.append(False)
+                except InjectedFault:
+                    fired.append(True)
+        return fired
+
+    a, b = run(7), run(7)
+    assert a == b                          # replayable chaos
+    assert any(a) and not all(a)           # p=0.5 actually mixes
+    assert run(8) != a                     # and the seed matters
+
+
+def test_torn_fraction_only_answers_torn_write():
+    inj = FaultInjector()
+    inj.arm("w", kind="torn_write", frac=0.25, times=1)
+    inj.arm("w", kind="raise", after=10)   # raise-kind must not leak in
+    with inj:
+        assert torn_fraction("w") == 0.25
+        assert torn_fraction("w") is None  # times exhausted
+        inject("w")                        # raise still counting its after
+
+
+def test_clear():
+    inj = FaultInjector()
+    inj.arm("a", kind="raise")
+    inj.arm("b", kind="raise")
+    inj.clear("a")
+    with inj:
+        inject("a")                        # cleared: no-op
+        with pytest.raises(InjectedFault):
+            inject("b")
+    inj.clear()
+    with inj:
+        inject("b")                        # clear() drops everything
+
+
+def test_fault_emits_event_and_counter():
+    c = get_registry().counter("faults_injected_total",
+                               {"point": "tele", "kind": "raise"})
+    before = c.value
+    inj = FaultInjector()
+    inj.arm("tele", kind="raise", times=1)
+    with inj:
+        with pytest.raises(InjectedFault):
+            inject("tele", step=3)
+    assert c.value == before + 1
+    evs = [e for e in get_event_log().tail(50)
+           if e["kind"] == "fault_injected" and e.get("point") == "tele"]
+    assert evs and evs[-1]["step"] == 3

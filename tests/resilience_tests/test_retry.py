@@ -1,0 +1,100 @@
+"""RetryPolicy: bounded attempts, deterministic backoff, telemetry."""
+
+import pytest
+
+from chainermn_tpu.monitor import get_event_log, get_registry
+from chainermn_tpu.resilience import FaultInjector, InjectedFault, RetryPolicy
+from chainermn_tpu.resilience.faults import inject
+
+
+def _flaky(n_failures, exc=RuntimeError):
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= n_failures:
+            raise exc(f"transient {calls['n']}")
+        return "ok"
+
+    return fn, calls
+
+
+def test_succeeds_after_transients():
+    c = get_registry().counter("retries_total", {"op": "t.ok"})
+    before = c.value
+    fn, calls = _flaky(2)
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.001, jitter=0)
+    assert policy.call(fn, op="t.ok") == "ok"
+    assert calls["n"] == 3
+    assert c.value == before + 2           # two absorbed transients
+
+
+def test_exhaustion_reraises_last_error():
+    c = get_registry().counter("retries_exhausted_total", {"op": "t.bad"})
+    before = c.value
+    fn, calls = _flaky(99)
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.001, jitter=0)
+    with pytest.raises(RuntimeError, match="transient 3"):
+        policy.call(fn, op="t.bad")
+    assert calls["n"] == 3
+    assert c.value == before + 1
+    evs = [e for e in get_event_log().tail(50)
+           if e["kind"] == "retry_exhausted" and e.get("op") == "t.bad"]
+    assert evs and evs[-1]["attempts"] == 3
+
+
+def test_retry_on_filter_propagates_immediately():
+    fn, calls = _flaky(99, exc=ValueError)
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                         retry_on=(KeyError,))
+    with pytest.raises(ValueError):
+        policy.call(fn, op="t.filtered")
+    assert calls["n"] == 1                 # a shape error is not a transient
+
+
+def test_backoff_shape_and_determinism():
+    p = RetryPolicy(max_attempts=9, base_delay_s=0.1, multiplier=2.0,
+                    max_delay_s=0.5, jitter=0)
+    assert [p.delay_s(k) for k in (1, 2, 3, 4, 5)] == \
+        [0.1, 0.2, 0.4, 0.5, 0.5]          # exponential, capped
+    a = RetryPolicy(max_attempts=9, jitter=0.5, seed=3)
+    b = RetryPolicy(max_attempts=9, jitter=0.5, seed=3)
+    seq_a = [a.delay_s(k) for k in range(1, 6)]
+    assert seq_a == [b.delay_s(k) for k in range(1, 6)]   # seeded jitter
+    assert all(d > 0 for d in seq_a)
+
+
+def test_invalid_attempts_rejected():
+    with pytest.raises(ValueError):
+        RetryPolicy(0)
+
+
+def test_wrap_is_drop_in():
+    fn, calls = _flaky(1)
+    wrapped = RetryPolicy(3, base_delay_s=0.001, jitter=0).wrap(fn, op="t.w")
+    assert wrapped() == "ok" and calls["n"] == 2
+
+
+def test_absorbs_injected_fault():
+    """The chaos story end-to-end: an armed transient at a cut-point inside
+    the retried body is absorbed exactly like a real one."""
+    inj = FaultInjector()
+    inj.arm("t.cut", kind="raise", times=1)
+    policy = RetryPolicy(3, base_delay_s=0.001, jitter=0)
+
+    def op():
+        inject("t.cut")
+        return 42
+
+    with inj:
+        assert policy.call(op, op="t.cut") == 42
+    assert inj.fired_log == [("t.cut", "raise")]
+
+
+def test_injected_fault_outlasting_budget_escapes():
+    inj = FaultInjector()
+    inj.arm("t.cut2", kind="raise", times=None)    # every attempt fails
+    policy = RetryPolicy(3, base_delay_s=0.001, jitter=0)
+    with inj:
+        with pytest.raises(InjectedFault):
+            policy.call(lambda: inject("t.cut2"), op="t.cut2")

@@ -1,0 +1,308 @@
+"""Serving graceful degradation: bounded admission, deadline shedding, the
+terminal ERRORED state, error propagation, warm engine restart."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.resilience import FaultInjector, InjectedFault, RetryPolicy
+from chainermn_tpu.serving import (
+    DeadlineExceededError,
+    EngineFailed,
+    FCFSScheduler,
+    QueueFullError,
+    RequestState,
+    ServingClient,
+    ServingEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=1,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make(lm, params, n_slots=2, **kw):
+    engine = ServingEngine(lm, params, n_slots=n_slots, prefill_len=6,
+                           cache_len=32)
+    return engine, FCFSScheduler(engine, **kw)
+
+
+# --------------------------------------------------------------------- #
+# bounded admission                                                      #
+# --------------------------------------------------------------------- #
+
+
+def test_queue_full_rejects_at_submit(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1, max_queue=2)
+    r1 = sched.submit(np.array([1]), 2)
+    r2 = sched.submit(np.array([2]), 2)
+    with pytest.raises(QueueFullError):
+        sched.submit(np.array([3]), 2)
+    assert sched.metrics.report()["requests_rejected"] == 1
+    sched.run_until_idle()                 # accepted work is unaffected
+    assert r1.state is RequestState.DONE and r2.state is RequestState.DONE
+
+
+def test_queue_drains_reopen_admission(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1, max_queue=1)
+    sched.submit(np.array([1]), 1)
+    with pytest.raises(QueueFullError):
+        sched.submit(np.array([2]), 1)
+    sched.run_until_idle()
+    r = sched.submit(np.array([2]), 1)     # capacity is back
+    sched.run_until_idle()
+    assert r.state is RequestState.DONE
+
+
+def test_max_queue_validation(lm_and_params):
+    lm, params = lm_and_params
+    with pytest.raises(ValueError, match="max_queue"):
+        make(lm, params, max_queue=0)
+
+
+# --------------------------------------------------------------------- #
+# deadlines                                                              #
+# --------------------------------------------------------------------- #
+
+
+def test_expired_queued_requests_are_shed(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1, default_deadline_s=0.05)
+    r1 = sched.submit(np.array([1]), 8)    # admitted immediately
+    r2 = sched.submit(np.array([2]), 2)
+    r3 = sched.submit(np.array([3]), 2, deadline_s=30.0)  # generous override
+    sched.step()                           # r1 takes the only slot
+    time.sleep(0.1)                        # r2's deadline expires queued
+    sched.run_until_idle()
+    assert r1.state is RequestState.DONE
+    assert r2.state is RequestState.ERRORED
+    with pytest.raises(DeadlineExceededError):
+        r2.wait(timeout=1)
+    with pytest.raises(DeadlineExceededError):
+        _ = r2.output
+    assert r3.state is RequestState.DONE   # per-request deadline respected
+    assert sched.metrics.report()["requests_shed"] == 1
+
+
+def test_deadline_only_governs_queue_wait(lm_and_params):
+    """A request ADMITTED before its deadline runs to completion — the
+    deadline bounds queue wait, not decode time."""
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1, default_deadline_s=0.05)
+    r = sched.submit(np.array([1]), 6)
+    sched.step()                           # admitted within deadline
+    time.sleep(0.1)
+    sched.run_until_idle()
+    assert r.state is RequestState.DONE and len(r.tokens) == 6
+
+
+# --------------------------------------------------------------------- #
+# engine exception boundary + warm restart                               #
+# --------------------------------------------------------------------- #
+
+
+def test_engine_raise_errors_in_flight_and_restarts(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=2)
+    r0 = sched.submit(np.array([7, 8]), 2)     # warm both executables
+    sched.run_until_idle()
+    assert r0.state is RequestState.DONE
+    compiles_before = engine.compile_counts()
+    inj = FaultInjector()
+    inj.arm("serving.decode", kind="raise", after=1, times=1)
+    with inj:
+        r1 = sched.submit(np.array([1, 2]), 6)
+        r2 = sched.submit(np.array([3, 4]), 6)
+        sched.run_until_idle()
+        # both were in flight when decode raised: terminal ERRORED, loudly
+        for r in (r1, r2):
+            assert r.state is RequestState.ERRORED
+            with pytest.raises(EngineFailed) as ei:
+                r.wait(timeout=1)
+            assert isinstance(ei.value.__cause__, InjectedFault)
+        # the engine warm-restarted: same compiled programs, fresh slots
+        assert sched.engine_restarts == 1
+        assert engine.free_slots == {0, 1}
+        r3 = sched.submit(np.array([5, 6]), 4)
+        sched.run_until_idle()
+    assert r3.state is RequestState.DONE
+    # zero recompiles across the restart (same shapes/shardings)
+    assert engine.compile_counts() == compiles_before
+    # post-restart output is still correct, not just terminal
+    ref = generate(lm, params, jnp.asarray([[5, 6]], jnp.int32), 4)
+    np.testing.assert_array_equal(r3.output, np.asarray(ref[0]))
+    m = sched.metrics.report()
+    assert m["requests_errored"] == 2 and m["engine_restarts"] == 1
+
+
+def test_prefill_raise_errors_admitting_request(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1)
+    inj = FaultInjector()
+    inj.arm("serving.prefill", kind="raise", times=1)
+    with inj:
+        r1 = sched.submit(np.array([1, 2]), 3)
+        r2 = sched.submit(np.array([3, 4]), 3)
+        sched.run_until_idle()
+    assert r1.state is RequestState.ERRORED    # the admitting victim
+    assert r2.state is RequestState.DONE       # queue kept being served
+
+
+def test_prefill_retry_absorbs_transient(lm_and_params):
+    """With an admission RetryPolicy, an injected transient prefill fault
+    never becomes an engine failure — the request just completes."""
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1,
+                         retry=RetryPolicy(3, base_delay_s=0.001, jitter=0))
+    inj = FaultInjector()
+    inj.arm("serving.prefill", kind="raise", times=1)
+    with inj:
+        r = sched.submit(np.array([1, 2]), 3)
+        sched.run_until_idle()
+    assert r.state is RequestState.DONE
+    assert sched.engine_restarts == 0
+    assert sched.metrics.report()["requests_errored"] == 0
+
+
+def test_restart_disabled_reraises(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1, restart_on_error=False)
+    inj = FaultInjector()
+    inj.arm("serving.decode", kind="raise", times=1)
+    with inj:
+        r = sched.submit(np.array([1, 2]), 4)
+        with pytest.raises(InjectedFault):
+            sched.run_until_idle()
+    assert r.state is RequestState.ERRORED     # still no silent hang
+
+
+def test_restart_budget_exhausted_reraises(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1, max_restarts=1)
+    inj = FaultInjector()
+    inj.arm("serving.decode", kind="raise", times=None)
+    with inj:
+        sched.submit(np.array([1, 2]), 4)
+        sched.submit(np.array([3, 4]), 4)
+        with pytest.raises(InjectedFault):
+            sched.run_until_idle()
+    assert sched.engine_restarts == 1
+
+
+# --------------------------------------------------------------------- #
+# error propagation surfaces (satellite)                                 #
+# --------------------------------------------------------------------- #
+
+
+def test_streaming_iterator_reraises(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1)
+    inj = FaultInjector()
+    inj.arm("serving.decode", kind="raise", after=2, times=1)
+    with inj:
+        r = sched.submit(np.array([1, 2]), 8)
+        sched.run_until_idle()
+    got = []
+    with pytest.raises(EngineFailed):
+        for tok in r.stream():
+            got.append(tok)
+    assert got == r.tokens and len(got) >= 1   # delivered prefix, then raise
+
+
+def test_stream_of_successful_request_terminates(lm_and_params):
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1)
+    r = sched.submit(np.array([1, 2]), 4)
+    sched.run_until_idle()
+    assert list(r.stream()) == r.tokens and len(r.tokens) == 4
+
+
+def test_client_reraises_in_caller_thread(lm_and_params):
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                           cache_len=32)
+    inj = FaultInjector()
+    inj.arm("serving.decode", kind="raise", after=1, times=1)
+    with inj, ServingClient(engine) as client:
+        with pytest.raises(EngineFailed):
+            client.generate(np.array([1, 2]), 6, timeout=120)
+        # the engine restarted under the client thread: still serving
+        out = client.generate(np.array([5, 6]), 4, timeout=120)
+    ref = generate(lm, params, jnp.asarray([[5, 6]], jnp.int32), 4)
+    np.testing.assert_array_equal(out, np.asarray(ref[0]))
+
+
+def test_no_stranded_clients_on_transient_hang(lm_and_params):
+    """Acceptance: with an injected engine hang, every submitted request
+    reaches a terminal state — in-flight work completes once the stall
+    clears, queued work past its deadline is shed, nothing blocks
+    forever."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                           cache_len=32)
+    inj = FaultInjector()
+    inj.arm("serving.decode", kind="hang", hang_s=0.4, after=1, times=1)
+    reqs = []
+    with inj, ServingClient(engine, default_deadline_s=0.2) as client:
+        for i in range(6):                 # 2 in flight, 4 queued
+            reqs.append(client.submit(np.array([1 + i, 2 + i]), 8))
+        t0 = time.perf_counter()
+        states = []
+        for r in reqs:
+            try:
+                finished = r.wait(timeout=30)
+                assert finished
+                states.append(r.state)
+            except DeadlineExceededError:
+                states.append(r.state)
+        waited = time.perf_counter() - t0
+    assert waited < 30                     # nobody blocked forever
+    assert all(s in (RequestState.DONE, RequestState.ERRORED)
+               for s in states)
+    assert RequestState.DONE in states     # in-flight survived the stall
+    assert states.count(RequestState.ERRORED) >= 1   # expired queue shed
+
+
+def test_degradation_is_observable(lm_and_params):
+    """Every reject/shed/errored/restart shows up in the registry snapshot
+    and the flight recorder (acceptance)."""
+    from chainermn_tpu import monitor
+
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=1, max_queue=2,
+                         default_deadline_s=0.03)
+    sched.submit(np.array([1]), 6)
+    queued = sched.submit(np.array([2]), 2)
+    with pytest.raises(QueueFullError):
+        sched.submit(np.array([3]), 2)     # 2 already queued: bounced
+    sched.step()
+    time.sleep(0.06)
+    inj = FaultInjector()
+    inj.arm("serving.decode", kind="raise", times=1)
+    with inj:
+        sched.run_until_idle()
+    assert queued.state is RequestState.ERRORED
+    snap = monitor.snapshot()
+    for name in ("serving_requests_rejected_total",
+                 "serving_requests_shed_total",
+                 "serving_requests_errored_total",
+                 "serving_scheduler_restarts_total",
+                 "faults_injected_total"):
+        hits = {k: v for k, v in snap["counters"].items()
+                if k.startswith(name)}
+        assert any(v > 0 for v in hits.values()), (name, hits)
+    kinds = [e["kind"] for e in monitor.get_event_log().tail(200)]
+    for kind in ("reject", "shed", "engine_error", "engine_restart",
+                 "fault_injected"):
+        assert kind in kinds, kind

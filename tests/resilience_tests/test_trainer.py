@@ -1,0 +1,255 @@
+"""resilient_fit: crash-resume bit-exactness, restore budget, checkpoint
+torn-write hardening (kill mid-write / silent truncation), dump idempotence."""
+
+import io
+import pickle
+
+import jax
+import numpy as np
+import pytest
+
+from chainermn_tpu import (
+    SerialIterator,
+    create_communicator,
+    create_multi_node_checkpointer,
+)
+from chainermn_tpu.monitor import get_event_log, get_registry
+from chainermn_tpu.resilience import (
+    FaultInjector,
+    InjectedFault,
+    ResilientTrainer,
+    RetryPolicy,
+    resilient_fit,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return create_communicator("naive")
+
+
+def _dataset():
+    return [float(i) for i in range(20)]
+
+
+def _iterator():
+    return SerialIterator(_dataset(), batch_size=3, shuffle=True, seed=5)
+
+
+def _step(state, batch):
+    """Deterministic step over a state pytree that includes a PRNG key —
+    the key must round-trip through the snapshot for bit-exact resume."""
+    key, sub = jax.random.split(state["key"])
+    noise = float(jax.random.uniform(sub, ()))
+    w = state["w"] * 0.9 + float(np.mean(batch)) + 0.01 * noise
+    return {"w": w, "key": key}
+
+
+def _init_state():
+    return {"w": 0.0, "key": jax.random.PRNGKey(42)}
+
+
+def _run(tmp_path, comm, n_steps, *, name, injector=None, save_every=4,
+         **fit_kw):
+    ckpt = create_multi_node_checkpointer(name, comm, path=str(tmp_path))
+    traj: list[tuple[int, float]] = []
+
+    def on_step(i, state):
+        traj.append((i, state["w"]))
+
+    if injector is not None:
+        with injector:
+            state, report = resilient_fit(
+                _step, _init_state(), _iterator(), n_steps, ckpt,
+                save_every=save_every, on_step=on_step, **fit_kw)
+    else:
+        state, report = resilient_fit(
+            _step, _init_state(), _iterator(), n_steps, ckpt,
+            save_every=save_every, on_step=on_step, **fit_kw)
+    return state, report, traj
+
+
+def test_crash_resume_bit_exact(tmp_path, comm):
+    """Acceptance: a fault injected at step k, recovered via snapshot
+    restore, leaves the post-resume trajectory IDENTICAL to an
+    uninterrupted run (state + RNG key + iterator order all round-trip)."""
+    ref_state, ref_report, ref_traj = _run(
+        tmp_path / "ref", comm, 12, name="ref")
+    assert ref_report["failures"] == 0 and ref_report["restores"] == 0
+
+    inj = FaultInjector()
+    inj.arm("trainer.step", kind="raise", after=7, times=1)  # fails at i=7
+    state, report, traj = _run(tmp_path / "crash", comm, 12, name="crash",
+                               injector=inj)
+    assert report["failures"] == 1 and report["restores"] == 1
+    assert report["mttr_s"] and report["mttr_s"][0] > 0
+
+    # replayed steps (4..7 re-run from the iteration-4 snapshot) must equal
+    # their first-pass values exactly — and the whole run must equal the
+    # uninterrupted reference, float-for-float
+    final = {}
+    for i, w in traj:
+        if i in final:
+            assert w == final[i], f"replay of step {i} diverged"
+        final[i] = w
+    assert final == dict(ref_traj)
+    assert state["w"] == ref_state["w"]
+    np.testing.assert_array_equal(np.asarray(state["key"]),
+                                  np.asarray(ref_state["key"]))
+
+
+def test_cross_launch_resume(tmp_path, comm):
+    """A fresh process over the same snapshot dir continues where the last
+    one stopped, and lands on the same trajectory."""
+    ref_state, _, ref_traj = _run(tmp_path / "r", comm, 10, name="x")
+
+    _run(tmp_path / "s", comm, 6, name="y")           # "first launch"
+    state, report, traj = _run(tmp_path / "s", comm, 10, name="y")
+    assert report["resumed_from"] == 6                 # snapshot at n_steps
+    assert [i for i, _ in traj] == [6, 7, 8, 9]
+    assert dict(traj) == {i: w for i, w in ref_traj if i >= 6}
+    assert state["w"] == ref_state["w"]
+
+
+def test_restore_budget_exhausted_reraises(tmp_path, comm):
+    c = get_registry().counter("trainer_failures_total")
+    before = c.value
+    inj = FaultInjector()
+    inj.arm("trainer.step", kind="raise", times=None)  # every step fails
+    with pytest.raises(InjectedFault):
+        _run(tmp_path, comm, 8, name="doomed", injector=inj,
+             max_restores=2, dump_on_failure=False)
+    # initial failure + one per restore attempt + the one that gives up
+    assert c.value == before + 3
+    evs = [e["kind"] for e in get_event_log().tail(100)]
+    assert "trainer_giving_up" in evs
+
+
+def test_transient_checkpoint_io_absorbed_by_retry(tmp_path, comm):
+    """An injected transient in checkpoint I/O is retried away before it
+    counts as a training failure."""
+    inj = FaultInjector()
+    inj.arm("checkpoint.save", kind="raise", after=1, times=1)
+    state, report, _ = _run(
+        tmp_path, comm, 8, name="t", injector=inj,
+        retry=RetryPolicy(3, base_delay_s=0.001, jitter=0))
+    assert report["failures"] == 0 and report["restores"] == 0
+    assert inj.fired_log == [("checkpoint.save", "raise")]
+
+
+# --------------------------------------------------------------------- #
+# torn-snapshot hardening (satellite)                                    #
+# --------------------------------------------------------------------- #
+
+
+def test_kill_mid_write_resume_succeeds(tmp_path, comm):
+    """Fault-injection acceptance: die mid-write of the snapshot tmp file;
+    the next launch sweeps the orphan and resumes from the previous
+    intact iteration."""
+    ckpt = create_multi_node_checkpointer("k", comm, path=str(tmp_path))
+    ckpt.save({"w": 1.0}, 1)
+    inj = FaultInjector()
+    inj.arm("checkpoint.write", kind="raise", times=1)
+    with inj:
+        with pytest.raises(InjectedFault):
+            ckpt.save({"w": 2.0}, 2)
+    import os
+    assert os.path.exists(ckpt.filename(2) + ".tmp")   # torn tmp left
+    assert not os.path.exists(ckpt.filename(2))        # rename never ran
+
+    ckpt2 = create_multi_node_checkpointer("k", comm, path=str(tmp_path))
+    assert not os.path.exists(ckpt.filename(2) + ".tmp")  # startup sweep
+    state, it = ckpt2.maybe_load()
+    assert it == 1 and state["w"] == 1.0
+
+
+def test_mid_write_crash_absorbed_by_retry(tmp_path, comm):
+    ckpt = create_multi_node_checkpointer(
+        "kr", comm, path=str(tmp_path),
+        retry=RetryPolicy(3, base_delay_s=0.001, jitter=0))
+    inj = FaultInjector()
+    inj.arm("checkpoint.write", kind="raise", times=1)
+    with inj:
+        ckpt.save({"w": 2.0}, 2)                       # 2nd attempt lands
+    state, it = ckpt.maybe_load()
+    assert it == 2 and state["w"] == 2.0
+
+
+def test_torn_write_detected_and_skipped_back(tmp_path, comm):
+    """A truncation that survives the atomic rename is caught by the
+    checksum footer; maybe_load skips back to the newest intact
+    iteration and counts the corruption."""
+    c = get_registry().counter("checkpoint_corrupt_total", {"name": "torn"})
+    before = c.value
+    ckpt = create_multi_node_checkpointer("torn", comm, path=str(tmp_path))
+    ckpt.save({"w": 1.0}, 1)
+    ckpt.save({"w": 2.0}, 2)
+    inj = FaultInjector()
+    inj.arm("checkpoint.write", kind="torn_write", frac=0.5, times=1)
+    with inj:
+        ckpt.save({"w": 3.0}, 3)                       # silently truncated
+    import os
+    assert os.path.exists(ckpt.filename(3))            # rename DID run
+    state, it = ckpt.maybe_load()
+    assert it == 2 and state["w"] == 2.0               # skipped back
+    assert c.value == before + 1
+    evs = [e for e in get_event_log().tail(100)
+           if e["kind"] == "checkpoint_corrupt"]
+    assert evs and evs[-1]["iteration"] == 3
+
+
+def test_legacy_footerless_snapshot_still_loads(tmp_path, comm):
+    ckpt = create_multi_node_checkpointer("leg", comm, path=str(tmp_path))
+    with open(ckpt.filename(5), "wb") as f:            # pre-hardening file
+        pickle.dump({"world_size": 1, "state": {"w": 5.0}}, f, protocol=4)
+    state, it = ckpt.maybe_load()
+    assert it == 5 and state["w"] == 5.0
+
+
+def test_resilient_fit_survives_torn_write_then_crash(tmp_path, comm):
+    """Compose the two failure modes: iteration 8's snapshot is torn, the
+    next step crashes — recovery must land on iteration 4 (the newest
+    INTACT snapshot), then still finish bit-exact vs the reference."""
+    ref_state, _, _ = _run(tmp_path / "ref", comm, 12, name="ref")
+
+    inj = FaultInjector()
+    inj.arm("checkpoint.write", kind="torn_write", frac=0.6, times=1,
+            after=2)                                   # 3rd write = iter 8
+    inj.arm("trainer.step", kind="raise", after=9, times=1)   # fails at i=9
+    state, report, _ = _run(tmp_path / "t", comm, 12, name="t",
+                            injector=inj)
+    assert report["failures"] == 1 and report["restores"] == 1
+    assert state["w"] == ref_state["w"]
+    evs = [e for e in get_event_log().tail(200) if e["kind"] ==
+           "trainer_restore"]
+    assert evs and evs[-1]["iteration"] == 4           # skipped past torn 8
+
+
+# --------------------------------------------------------------------- #
+# dump idempotence (satellite bugfix)                                    #
+# --------------------------------------------------------------------- #
+
+
+def test_one_failure_one_dump():
+    """Layered failure paths (trainer boundary -> watchdog -> excepthook)
+    share the per-sink once-guard: a single failure episode produces
+    exactly one flight-recorder dump; recovery re-arms it."""
+    log = get_event_log()
+    log.reset_dump_guard()
+    log.emit("something")
+    sink = io.StringIO()
+    assert log.dump(file=sink, once="failure") > 0
+    assert log.dump(file=sink, once="failure") == 0    # suppressed
+    out = sink.getvalue()
+    assert out.count("flight recorder: last") == 1
+    assert "suppressing duplicate" in out
+    log.reset_dump_guard()                             # episode over
+    assert log.dump(file=sink, once="failure") > 0     # next failure dumps
+
+
+def test_unguarded_dump_unaffected():
+    log = get_event_log()
+    log.emit("x")
+    sink = io.StringIO()
+    assert log.dump(file=sink) > 0
+    assert log.dump(file=sink) > 0                     # no once key: always

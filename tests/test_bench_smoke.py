@@ -160,6 +160,46 @@ def test_bench_monitor_mode_soak():
     assert rec["serving"]["requests_completed"] == 32
 
 
+def test_bench_resilience_mode_smoke():
+    """``bench.py --mode resilience`` (acceptance criterion): one parseable
+    JSON record proving the recovery loop live — an injected crash at a
+    chosen training step restored bit-exactly from the snapshot (MTTR +
+    checkpoint save/load latency measured), and the deterministic serving
+    degradation scenario (bounded queue, deadline sheds, engine raise +
+    warm restart) with every request terminal."""
+    env = dict(
+        os.environ,
+        CHAINERMN_TPU_BENCH_PLATFORM="cpu",
+        CHAINERMN_TPU_SERVE_DMODEL="32",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "resilience"],
+        env=env, capture_output=True, text=True, timeout=540, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "resilience_mttr" and rec["unit"] == "ms"
+    # MTTR: injected crash -> first completed post-resume step
+    assert rec["value"] and rec["value"] > 0
+    assert rec["checkpoint_save_ms"] > 0 and rec["checkpoint_load_ms"] > 0
+    # crash-resume bit-exactness (acceptance): faulted run's final loss
+    # equals the uninterrupted reference's, float-for-float
+    assert rec["bit_exact_resume"] is True
+    assert rec["trainer"]["failures"] == 1
+    assert rec["trainer"]["restores"] == 1
+    # the serving scenario is deterministic: counts are pinned, not >= 0
+    s = rec["serving"]
+    assert s["all_terminal"] is True
+    assert s["rejected"] == 2 and s["shed"] == 3
+    assert s["errored"] == 2 and s["engine_restarts"] == 1
+    # every injected fault is observable in the embedded registry snapshot
+    fired = {k: v for k, v in rec["monitor"]["counters"].items()
+             if k.startswith("faults_injected_total")}
+    assert sum(fired.values()) == rec["faults_injected"] >= 2
+
+
 def test_persist_measured_is_tpu_only(tmp_path, monkeypatch):
     """The evidence file must only ever hold real-chip records: a tiny-CPU
     smoke run (this very suite) once displaced the round's TPU measurement.
