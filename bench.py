@@ -1007,6 +1007,222 @@ def resilience_main() -> None:
     _scratch_write(record)
 
 
+def pipeline_main() -> None:
+    """``bench.py --mode pipeline``: async hot-loop overlap proof.
+
+    One JSON record demonstrating the ``dataflow`` claim: with a loader
+    that takes ``d`` ms per batch, the SYNCHRONOUS loop (draw batch ->
+    device_put -> step -> ``float(loss)`` per step) pays ``step + d`` per
+    iteration, while the pipelined loop (``DevicePrefetcher`` producer
+    thread + ``training.fit`` dispatch-ahead with batched loss fetches)
+    pays ~``max(step, d)`` — the loader delay and the H2D transfer hide
+    under device compute, and the per-step host sync disappears
+    (``loss_fetch_total`` counts one fetch per ``fetch_every`` steps).
+    Both loops consume the identical batch stream from identical initial
+    state with the SAME compiled executable, so their losses must match
+    float-for-float and the executable count stays 1 (zero recompiles
+    after warmup). Also measured: async checkpointing's critical-path
+    cost (the ``save_async`` enqueue = one device_get) vs the full save
+    duration that moved off-thread.
+
+    Knobs: ``CHAINERMN_TPU_PIPE_STEPS`` (default 30),
+    ``CHAINERMN_TPU_PIPE_DELAY_MS`` (default: auto, ~1.5x the measured
+    bare step), ``CHAINERMN_TPU_PIPE_FETCH_EVERY`` (default 8),
+    ``CHAINERMN_TPU_PIPE_DEPTH`` (default 2), plus the
+    ``CHAINERMN_TPU_SERVE_*`` model sizes shared with the other modes.
+    """
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    plat = os.environ.get("CHAINERMN_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    enable_compilation_cache(jax)
+
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu import monitor
+    from chainermn_tpu.dataflow import DevicePrefetcher
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.monitor import get_registry
+    from chainermn_tpu.training import fit, jit_lm_train_step
+
+    e = os.environ.get
+    n_steps = int(e("CHAINERMN_TPU_PIPE_STEPS", "30"))
+    fetch_every = int(e("CHAINERMN_TPU_PIPE_FETCH_EVERY", "8"))
+    depth = int(e("CHAINERMN_TPU_PIPE_DEPTH", "2"))
+    delay_env = e("CHAINERMN_TPU_PIPE_DELAY_MS", "")
+    seq_len = int(e("CHAINERMN_TPU_PIPE_SEQ_LEN", "16"))
+    vocab = int(e("CHAINERMN_TPU_SERVE_VOCAB", "64"))
+    d_model = int(e("CHAINERMN_TPU_SERVE_DMODEL", "64"))
+    n_layers = int(e("CHAINERMN_TPU_SERVE_LAYERS", "2"))
+    n_heads = int(e("CHAINERMN_TPU_SERVE_HEADS", "4"))
+
+    devs = jax.devices()
+    log(f"pipeline bench: devices={len(devs)} kind={devs[0].device_kind!r} "
+        f"steps={n_steps} fetch_every={fetch_every} depth={depth}")
+    try:
+        lm = TransformerLM(vocab_size=vocab, d_model=d_model,
+                           n_heads=n_heads, n_layers=n_layers,
+                           max_len=seq_len)
+        comm = chainermn_tpu.create_communicator("tpu")
+        batch = 2 * max(len(devs), 1)
+        pool = np.random.RandomState(0).randint(
+            1, vocab, (8 * batch, seq_len)).astype(np.int32)
+        params0 = comm.bcast_data(
+            lm.init(jax.random.PRNGKey(0), jnp.asarray(pool[:1])))
+        opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.1), comm)
+        # donate=False: the same params/opt arrays seed both loops
+        step = jit_lm_train_step(lm, opt, comm, donate=False,
+                                 monitored=False)
+        data_sharding = comm.named_sharding(*comm.data_spec)
+
+        def fresh():
+            return (jax.device_put(params0, comm.named_sharding()),
+                    jax.device_put(opt.init(params0),
+                                   comm.named_sharding()))
+
+        def batches(delay_s):
+            # the injected loader: d seconds of host-side work per batch,
+            # deterministic batch sequence (same seed for both loops)
+            r = np.random.RandomState(1)
+            while True:
+                if delay_s:
+                    time.sleep(delay_s)
+                sel = r.randint(0, len(pool), batch)
+                yield pool[sel], np.roll(pool[sel], -1, axis=1)
+
+        def put(b):
+            return jax.device_put(
+                (jnp.asarray(b[0]), jnp.asarray(b[1])), data_sharding)
+
+        # ---- bare step time (no loader delay, dispatch-ahead) ---------- #
+        params, opt_state = fresh()
+        gen = batches(0.0)
+        for _ in range(3):  # compile + warm
+            x, y = put(next(gen))
+            params, opt_state, loss, _ = step(params, opt_state, x, y)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            x, y = put(next(gen))
+            params, opt_state, loss, _ = step(params, opt_state, x, y)
+        float(loss)  # closing fetch (PERF.md relay-ack hazard)
+        bare_ms = (time.perf_counter() - t0) / n_steps * 1e3
+
+        delay_ms = float(delay_env) if delay_env else max(1.5 * bare_ms,
+                                                          20.0)
+        d = delay_ms / 1e3
+
+        # ---- synchronous loop: step + d per iteration ------------------ #
+        params, opt_state = fresh()
+        gen = batches(d)
+        sync_losses = []
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            x, y = put(next(gen))
+            params, opt_state, loss, _ = step(params, opt_state, x, y)
+            sync_losses.append(float(loss))  # the per-step host sync
+        sync_ms = (time.perf_counter() - t0) / n_steps * 1e3
+
+        # ---- pipelined loop: ~max(step, d) per iteration --------------- #
+        reg = get_registry()
+        c_fetch = reg.counter("loss_fetch_total", {"loop": "pipeline"})
+        fetches_before = c_fetch.value
+        params, opt_state = fresh()
+        pre = DevicePrefetcher(
+            batches(d), depth=depth, sharding=data_sharding,
+            transform=lambda b: (jnp.asarray(b[0]), jnp.asarray(b[1])),
+            name="pipeline")
+        # steady state: let the producer fill the queue before the clock
+        # starts (the first-fill delay is a one-time cost, paid while the
+        # sync loop's FIRST batch would also still be loading)
+        fill_deadline = time.perf_counter() + depth * d + 2.0
+        while (pre._q.qsize() < depth
+               and time.perf_counter() < fill_deadline):
+            pre._ensure_started()
+            time.sleep(0.005)
+        t0 = time.perf_counter()
+        params, opt_state, pipe_losses = fit(
+            step, params, opt_state, pre, n_steps,
+            fetch_every=fetch_every, name="pipeline")
+        pipe_ms = (time.perf_counter() - t0) / n_steps * 1e3
+        pre.close()
+        fetch_events = c_fetch.value - fetches_before
+
+        # ---- async checkpoint: critical-path cost vs moved-off work ---- #
+        with tempfile.TemporaryDirectory() as ckdir:
+            ck = chainermn_tpu.create_multi_node_checkpointer(
+                "pipe", comm, path=ckdir)
+            state = {"params": params, "opt": opt_state}
+            t0 = time.perf_counter()
+            ck.save(state, 1)
+            sync_save_ms = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+            ck.save_async(state, 2)
+            enqueue_ms = (time.perf_counter() - t0) * 1e3
+            ck.wait_async()
+            async_save_ms = ck.stats["save_async"][-1] * 1e3
+            ck.finalize()
+
+        max_ideal = max(bare_ms, delay_ms)
+        snap = monitor.snapshot()
+        h2d = next((v for k, v in snap["histograms"].items()
+                    if k.startswith("prefetch_h2d_seconds")
+                    and 'name="pipeline"' in k), {})
+        record = {
+            "metric": "pipeline_overlap_step_time",
+            "value": round(pipe_ms, 3),
+            "unit": "ms/step",
+            "mode": "pipeline",
+            "n_chips": len(devs),
+            "device_kind": devs[0].device_kind,
+            "n_steps": n_steps,
+            "fetch_every": fetch_every,
+            "prefetch_depth": depth,
+            "bare_step_ms": round(bare_ms, 3),
+            "loader_delay_ms": round(delay_ms, 3),
+            "sync_step_ms": round(sync_ms, 3),
+            "pipelined_step_ms": round(pipe_ms, 3),
+            "max_step_delay_ms": round(max_ideal, 3),
+            # sync/pipelined: how much wall the overlap bought
+            "overlap_ratio": round(sync_ms / pipe_ms, 4),
+            # max(step,d)/pipelined: 1.0 = perfect overlap (acceptance:
+            # pipelined <= 1.15 x max(step, d) in steady state)
+            "pipeline_efficiency": round(max_ideal / pipe_ms, 4),
+            "within_1p15_of_ideal": bool(pipe_ms <= 1.15 * max_ideal),
+            "losses_bit_identical": bool(sync_losses == pipe_losses),
+            "loss_fetch_events": int(fetch_events),
+            "h2d_ms_p50": round(h2d.get("p50_s", 0.0) * 1e3, 3),
+            "async_save_enqueue_ms": round(enqueue_ms, 3),
+            "async_save_ms": round(async_save_ms, 3),
+            "sync_save_ms": round(sync_save_ms, 3),
+            # the jit cache must hold exactly the warmup executable
+            "executables": int(step._cache_size()),
+            "monitor": snap,
+        }
+    except Exception as exc:  # one parseable line, never a bare traceback
+        log(f"pipeline bench failed: {type(exc).__name__}: {exc}")
+        record = {
+            "metric": "pipeline_overlap_step_time",
+            "value": None,
+            "unit": "ms/step",
+            "mode": "pipeline",
+            "error": type(exc).__name__,
+            "detail": str(exc)[-500:],
+        }
+        print(json.dumps(record))
+        raise SystemExit(1)
+    print(json.dumps(record))
+    _scratch_write(record)
+
+
 def _failure_record(err_class: str, detail: str, attempts_run: int) -> dict:
     rec = {
         "metric": "resnet50_imagenet_train_throughput",
@@ -1297,8 +1513,8 @@ def parent_main() -> None:
 
 def _cli_mode(argv) -> str:
     """``--mode serving`` / ``--mode monitor`` / ``--mode resilience`` /
-    ``--mode=...`` (default: the ResNet training benchmark with its
-    retry-parent machinery)."""
+    ``--mode pipeline`` / ``--mode=...`` (default: the ResNet training
+    benchmark with its retry-parent machinery)."""
     for i, a in enumerate(argv):
         if a == "--mode" and i + 1 < len(argv):
             return argv[i + 1]
@@ -1315,9 +1531,12 @@ def main() -> None:
         monitor_main()
     elif mode == "resilience":
         resilience_main()
+    elif mode == "pipeline":
+        pipeline_main()
     elif mode != "train":
         raise SystemExit(
-            f"unknown --mode {mode!r} (train|serving|monitor|resilience)")
+            f"unknown --mode {mode!r} "
+            "(train|serving|monitor|resilience|pipeline)")
     elif "--child" in sys.argv:
         # child stdout carries ONLY the JSON record; everything else is stderr
         child_main()

@@ -20,6 +20,7 @@ from chainermn_tpu.extensions import (
     create_multi_node_checkpointer,
 )
 from chainermn_tpu.global_except_hook import add_hook as add_global_except_hook
+from chainermn_tpu import dataflow
 from chainermn_tpu import monitor
 from chainermn_tpu import resilience
 from chainermn_tpu.iterators import (
@@ -78,6 +79,7 @@ __all__ = [
     "ObservationAggregator",
     "create_multi_node_checkpointer",
     "add_global_except_hook",
+    "dataflow",
     "functions",
     "monitor",
     "resilience",

@@ -1,0 +1,275 @@
+"""Background device prefetch: H2D transfer overlapped with device compute.
+
+The synchronous hot loop pays ``assemble + device_put + step`` per
+iteration; the reference hides assembly behind worker processes
+(MultiprocessIterator) but still pays the host->device transfer on the
+critical path. :class:`DevicePrefetcher` moves BOTH off it: a producer
+thread draws batches from any iterator, optionally collates them
+(``transform``), ``jax.device_put``s them onto the mesh with the step's
+input shardings, and parks them — already device-resident — in a bounded
+queue. Steady state, the training thread's per-iteration input cost is a
+queue pop.
+
+Contracts:
+
+- **drains cleanly** — :meth:`close` (also the context-manager exit)
+  stops the producer, unblocks it if it is waiting on a full queue, and
+  joins the thread; abandoning iteration early never leaks a thread;
+- **propagates producer exceptions** — an error raised while drawing,
+  collating, or transferring a batch re-raises in the consumer's
+  ``next()``, not silently on a daemon thread;
+- **resume stays bit-exact** — :meth:`state_dict` returns the *wrapped*
+  iterator's state positioned to draw the first batch the consumer has
+  NOT yet received (batches sitting prefetched in the queue are not
+  "consumed"), in the wrapped iterator's own format — so a snapshot taken
+  through the prefetcher restores interchangeably onto a bare iterator
+  and vice versa.
+
+Telemetry (process registry): ``prefetch_queue_depth{name=}`` gauge,
+``prefetch_h2d_seconds`` histogram (transfer time per batch, measured on
+the producer thread — i.e. off the critical path), ``prefetch_stall_total``
+counter + ``prefetch_stall_seconds`` histogram (consumer arrived at an
+empty queue: the producer is the bottleneck), ``prefetch_batches_total``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from chainermn_tpu.monitor._state import get_registry
+
+_DONE = "done"
+_ERROR = "error"
+_BATCH = "batch"
+
+
+class DevicePrefetcher:
+    """Wrap a batch iterator with a device-put-ahead producer thread.
+
+    Parameters
+    ----------
+    iterator : iterator or iterable
+        Yields batches. ``SerialIterator``, the multi-node iterators, a
+        ``NativeBatchLoader``, or any generator all work. If it exposes
+        ``state_dict``/``load_state_dict`` (and is its own iterator),
+        resume is supported — see :meth:`state_dict`.
+    depth : int
+        How many batches to keep ready (queue bound). ``depth`` batches
+        of device memory are pinned in addition to the one being stepped.
+    sharding : optional
+        Passed to ``jax.device_put`` (a ``Sharding`` applied to every
+        leaf, or a pytree of shardings matching the batch). ``None``
+        skips the transfer — host-side prefetch only.
+    transform : callable, optional
+        ``transform(batch) -> batch`` run on the producer thread before
+        the transfer (collation: list-of-records -> arrays).
+    snapshot : bool
+        Capture ``iterator.state_dict()`` after every draw so
+        :meth:`state_dict` is exact mid-epoch. Costs one state copy per
+        batch (O(dataset) for ``SerialIterator``'s order array) — turn
+        off for huge datasets when resume granularity of "wherever the
+        wrapped iterator was" is enough. Default: on when the wrapped
+        iterator supports it.
+    """
+
+    def __init__(self, iterator, *, depth: int = 2, sharding=None,
+                 transform: Optional[Callable] = None,
+                 snapshot: Optional[bool] = None,
+                 name: str = "prefetch") -> None:
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        # epoch metadata may live on the iterABLE (NativeBatchLoader sets
+        # epoch/is_new_epoch on itself while its generator yields), so keep
+        # the source object for attribute capture
+        self._src = iterator
+        self._it = iterator if hasattr(iterator, "__next__") else iter(iterator)
+        self._depth = int(depth)
+        self._sharding = sharding
+        self._transform = transform
+        self._name = name
+        self._stateful = (hasattr(self._it, "state_dict")
+                          and hasattr(self._it, "load_state_dict"))
+        self._snapshot = self._stateful if snapshot is None else bool(snapshot)
+        if self._snapshot and not self._stateful:
+            raise TypeError(
+                "snapshot=True needs the wrapped iterator to expose "
+                "state_dict()/load_state_dict()")
+        # state positioned to draw the next UNDELIVERED batch
+        self._resume_state = self._it.state_dict() if self._snapshot else None
+        self.epoch = getattr(self._src, "epoch", 0)
+        self.is_new_epoch = getattr(self._src, "is_new_epoch", False)
+
+        self._q: queue.Queue = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._finished = False
+
+        reg = get_registry()
+        labels = {"name": name}
+        self._g_depth = reg.gauge("prefetch_queue_depth", labels)
+        self._h_h2d = reg.histogram("prefetch_h2d_seconds", labels, unit="s")
+        self._c_stall = reg.counter("prefetch_stall_total", labels)
+        self._h_stall = reg.histogram("prefetch_stall_seconds", labels,
+                                      unit="s")
+        self._c_batches = reg.counter("prefetch_batches_total", labels)
+
+    # -- producer -------------------------------------------------------- #
+
+    def _offer(self, item) -> bool:
+        """Blocking put that stays interruptible by :meth:`close`."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = next(self._it)
+                except StopIteration:
+                    self._offer((_DONE, None, None, None))
+                    return
+                state = self._it.state_dict() if self._snapshot else None
+                meta = (getattr(self._src, "epoch", 0),
+                        getattr(self._src, "is_new_epoch", False))
+                if self._transform is not None:
+                    batch = self._transform(batch)
+                if self._sharding is not None:
+                    import jax
+
+                    t0 = time.perf_counter()
+                    batch = jax.device_put(batch, self._sharding)
+                    # force the transfer to finish HERE, on the producer's
+                    # timeline — a lazy put would resolve on the consumer's
+                    # first use, i.e. back on the critical path
+                    jax.block_until_ready(batch)
+                    self._h_h2d.observe(time.perf_counter() - t0)
+                if not self._offer((_BATCH, batch, state, meta)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+            self._offer((_ERROR, e, None, None))
+
+    def _ensure_started(self) -> None:
+        if self._thread is None and not self._finished:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._produce, name=f"prefetch-{self._name}",
+                daemon=True)
+            self._thread.start()
+
+    # -- consumer protocol ----------------------------------------------- #
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        if self._finished:
+            raise StopIteration
+        self._ensure_started()
+        if self._q.empty():
+            # the producer is behind: the input pipeline, not the step, is
+            # the bottleneck right now — count it and time the wait
+            self._c_stall.inc()
+            t0 = time.perf_counter()
+            item = self._q.get()
+            self._h_stall.observe(time.perf_counter() - t0)
+        else:
+            item = self._q.get()
+        self._g_depth.set(self._q.qsize())
+        kind, payload, state, meta = item
+        if kind == _DONE:
+            self._finished = True
+            self._join()
+            raise StopIteration
+        if kind == _ERROR:
+            self._finished = True
+            self._join()
+            raise payload
+        if self._snapshot:
+            self._resume_state = state
+        self.epoch, self.is_new_epoch = meta
+        self._c_batches.inc()
+        return payload
+
+    next = __next__
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def _join(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            # unblock a producer waiting on a full queue...
+            self._drain()
+            t.join(timeout=5.0)
+            self._thread = None
+        # ...and drain AGAIN: the freed slot can admit the producer's
+        # already-in-flight put before it re-checks the stop flag — a stale
+        # batch that must never survive into a restarted iteration
+        self._drain()
+        self._g_depth.set(0)
+
+    def close(self) -> None:
+        """Stop and join the producer; safe to call repeatedly. Prefetched
+        batches are discarded — iterating again after ``close`` without a
+        ``load_state_dict`` would silently skip them, so the prefetcher
+        stays stopped until repositioned."""
+        self._join()
+        self._finished = True
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:  # best effort; close() is the real contract
+        try:
+            self._stop.set()
+        except Exception:
+            pass
+
+    # -- checkpointing ---------------------------------------------------- #
+
+    def state_dict(self) -> dict:
+        """The wrapped iterator's state, positioned to draw the first batch
+        the consumer has not yet received — prefetched-but-undelivered
+        batches are NOT consumed. Interchangeable with the wrapped
+        iterator's own ``state_dict`` format."""
+        if not self._snapshot:
+            raise TypeError(
+                "state_dict() needs snapshot=True and a wrapped iterator "
+                "with state_dict()/load_state_dict()")
+        return self._resume_state
+
+    def load_state_dict(self, state: dict) -> None:
+        """Reposition the wrapped iterator; discards every prefetched
+        batch (they were drawn past the restore point)."""
+        if not self._stateful:
+            raise TypeError(
+                "load_state_dict() needs a wrapped iterator with "
+                "state_dict()/load_state_dict()")
+        self._join()
+        self._q = queue.Queue(maxsize=self._depth)  # belt + braces vs stale
+        self._it.load_state_dict(state)
+        self._resume_state = self._it.state_dict() if self._snapshot else None
+        self.epoch = getattr(self._src, "epoch", 0)
+        self.is_new_epoch = getattr(self._src, "is_new_epoch", False)
+        self._finished = False
+
+
+__all__ = ["DevicePrefetcher"]

@@ -29,6 +29,20 @@ Torn-snapshot hardening (beyond the reference):
   host-transient I/O, and publish save/load latency histograms plus a
   ``checkpoint_corrupt_total`` counter into the monitor registry.
 
+Background checkpointing (the ``dataflow`` async hot loop):
+
+- :meth:`MultiNodeCheckpointer.save_async` fixes the snapshot's content
+  with a ``jax.device_get`` on the calling thread, then runs the exact
+  sync-save I/O path (serialize + CRC footer + cut-points + retry +
+  atomic rename + GC) on a single writer thread — the training loop
+  resumes after the device fetch instead of after the disk write;
+- write **and GC share one lock**, so a snapshot is never deleted while
+  its successor is still ``.tmp`` and sync/async writes never interleave;
+- :meth:`MultiNodeCheckpointer.wait_async` is the completion barrier
+  (writer errors re-raise there and on the next ``save_async``);
+  ``maybe_load`` and ``finalize`` join pending saves first, so a restore
+  never races a pending write.
+
 Serialization: state is any pytree of jax/numpy arrays plus picklable leaves
 (e.g. ``{"variables": ..., "opt_state": ..., "iterator": it.state_dict()}``).
 Arrays are fetched to host (``jax.device_get``) and pickled; writes are
@@ -42,13 +56,16 @@ from __future__ import annotations
 
 import os
 import pickle
+import queue
 import re
 import struct
+import threading
 import time
 import zlib
 from typing import Any, Optional
 
 import jax
+import numpy as np
 
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase
 from chainermn_tpu.monitor._state import get_event_log, get_registry
@@ -58,6 +75,17 @@ from chainermn_tpu.resilience.faults import inject, torn_fraction
 _FOOTER_MAGIC = b"CMNTPUC1"
 _FOOTER_TAIL = struct.Struct("<IQ")
 _FOOTER_LEN = len(_FOOTER_MAGIC) + _FOOTER_TAIL.size
+
+
+def _host_copy(leaf):
+    """Fetch a leaf to host with OWNED bytes. ``jax.device_get`` copies
+    device arrays but passes host numpy arrays through by reference — an
+    aliased leaf would let the training loop mutate a snapshot that is
+    still queued for the async writer."""
+    out = jax.device_get(leaf)
+    if out is leaf and isinstance(out, np.ndarray):
+        out = out.copy()
+    return out
 
 
 def _add_footer(payload: bytes) -> bytes:
@@ -100,7 +128,8 @@ class MultiNodeCheckpointer:
         os.makedirs(self.path, exist_ok=True)
         self._n_retains = int(n_retains)
         self._retry = retry
-        self.stats: dict[str, list[float]] = {"save": [], "load": []}
+        self.stats: dict[str, list[float]] = {
+            "save": [], "load": [], "save_async": []}
         reg = get_registry()
         labels = {"name": name}
         self._h_save = reg.histogram("checkpoint_save_seconds", labels,
@@ -108,7 +137,21 @@ class MultiNodeCheckpointer:
         self._h_load = reg.histogram("checkpoint_load_seconds", labels,
                                      unit="s")
         self._c_corrupt = reg.counter("checkpoint_corrupt_total", labels)
+        self._h_async = reg.histogram("checkpoint_async_save_seconds",
+                                      labels, unit="s")
+        self._c_async_err = reg.counter("checkpoint_async_errors_total",
+                                        labels)
         self._events = get_event_log()
+        # One lock serializes every write+GC (sync save, async writer): a
+        # snapshot must never be GC-deleted while its successor is still
+        # `.tmp` — a crash in that window would leave NO intact newest
+        # snapshot even though the save "mostly worked".
+        self._io_lock = threading.Lock()
+        self._async_q: Optional[queue.Queue] = None
+        self._async_thread: Optional[threading.Thread] = None
+        self._async_cv = threading.Condition()
+        self._async_pending = 0
+        self._async_errors: list[BaseException] = []
         self._sweep_tmp()
 
     def _sweep_tmp(self) -> None:
@@ -156,12 +199,21 @@ class MultiNodeCheckpointer:
         """Snapshot this rank's ``state`` at ``iteration``; GC old ones."""
         t0 = time.time()
         inject("checkpoint.save", iteration=int(iteration))
+        target = self._write_snapshot(jax.device_get(state), iteration)
+        dt = time.time() - t0
+        self.stats["save"].append(dt)
+        self._h_save.observe(dt)
+        return target
+
+    def _write_snapshot(self, host_state: Any, iteration: int) -> str:
+        """Serialize + CRC footer + atomic rename + GC — the I/O half of a
+        save, shared by the sync path and the async writer thread. Write
+        AND GC run under one lock so a snapshot is never deleted while its
+        successor is still ``.tmp`` (and sync/async writes never
+        interleave)."""
         target = self.filename(iteration)
         tmp = target + ".tmp"
-        payload = {
-            "world_size": self._world_size(),
-            "state": jax.device_get(state),
-        }
+        payload = {"world_size": self._world_size(), "state": host_state}
         blob = _add_footer(pickle.dumps(payload, protocol=4))
         # torn-write cut-point: a fired fault silently truncates the bytes
         # that reach disk — the data-loss case only the checksum catches
@@ -177,17 +229,100 @@ class MultiNodeCheckpointer:
                 f.write(data[len(data) // 2:])
             os.replace(tmp, target)
 
-        if self._retry is not None:
-            self._retry.call(write, op="checkpoint.save")
-        else:
-            write()
-        self._gc()
-        dt = time.time() - t0
-        self.stats["save"].append(dt)
-        self._h_save.observe(dt)
+        with self._io_lock:
+            if self._retry is not None:
+                self._retry.call(write, op="checkpoint.save")
+            else:
+                write()
+            self._gc()
         self._events.emit("checkpoint_save", iteration=int(iteration),
                           bytes=len(data))
         return target
+
+    # -- async save ------------------------------------------------------ #
+
+    def save_async(self, state: Any, iteration: int) -> str:
+        """Snapshot without blocking the caller on serialization or disk.
+
+        The calling thread does only ``jax.device_get`` — the consistency
+        point: the snapshot's content is fixed here, so the training loop
+        is free to keep mutating device buffers (donation included) the
+        moment this returns. A single writer thread then runs the exact
+        sync-save I/O path (:meth:`_write_snapshot`): same CRC footer,
+        same ``checkpoint.write`` / torn-write cut-points, same retry
+        policy, same atomic rename, and GC under the same lock.
+
+        Failure surfacing: a writer-thread error is counted
+        (``checkpoint_async_errors_total``), event-logged, and re-raised
+        from the NEXT ``save_async`` or from :meth:`wait_async`;
+        :meth:`maybe_load` and :meth:`finalize` join pending saves first,
+        so a restore can never race (or trust) a half-written snapshot.
+        """
+        self.wait_async(raise_errors=True, join=False)
+        inject("checkpoint.save", iteration=int(iteration))
+        host_state = jax.tree_util.tree_map(_host_copy, state)
+        self._ensure_writer()
+        with self._async_cv:
+            self._async_pending += 1
+        self._async_q.put((host_state, int(iteration), time.time()))
+        self._events.emit("checkpoint_save_async_enqueued",
+                          iteration=int(iteration))
+        return self.filename(iteration)
+
+    def _ensure_writer(self) -> None:
+        if self._async_q is None:
+            self._async_q = queue.Queue()
+        if self._async_thread is None or not self._async_thread.is_alive():
+            self._async_thread = threading.Thread(
+                target=self._writer_loop, name=f"ckpt-writer-{self.name}",
+                daemon=True)
+            self._async_thread.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            job = self._async_q.get()
+            if job is None:
+                return
+            host_state, iteration, t_enq = job
+            try:
+                self._write_snapshot(host_state, iteration)
+                dt = time.time() - t_enq
+                self.stats["save_async"].append(dt)
+                self._h_async.observe(dt)
+            except BaseException as e:  # noqa: BLE001 — surfaced at join
+                self._c_async_err.inc()
+                self._events.emit(
+                    "checkpoint_async_error", iteration=int(iteration),
+                    error=f"{type(e).__name__}: {e}"[:200])
+                with self._async_cv:
+                    self._async_errors.append(e)
+            finally:
+                with self._async_cv:
+                    self._async_pending -= 1
+                    self._async_cv.notify_all()
+
+    def wait_async(self, raise_errors: bool = True, join: bool = True
+                   ) -> bool:
+        """Join every pending async save (the pre-restore / end-of-run
+        barrier). Returns True when all saves since the last wait landed
+        intact. ``raise_errors=False`` is the restore path's posture —
+        failures stay counted/evented only, because a missing snapshot is
+        already handled by the newest-common-iteration agreement."""
+        with self._async_cv:
+            if join:
+                while self._async_pending:
+                    self._async_cv.wait(timeout=0.5)
+            errs = list(self._async_errors)
+            self._async_errors.clear()
+        if errs and raise_errors:
+            raise errs[0]
+        return not errs
+
+    def _shutdown_writer(self) -> None:
+        if self._async_thread is not None and self._async_thread.is_alive():
+            self._async_q.put(None)
+            self._async_thread.join(timeout=5.0)
+        self._async_thread = None
 
     def _gc(self) -> None:
         its = self._local_iterations()
@@ -236,6 +371,10 @@ class MultiNodeCheckpointer:
         skip-back loop is collective, so ranks never split over which
         snapshot to trust.
         """
+        # pre-restore join: never race (or half-trust) a pending async
+        # save — a failed one is just a missing/old snapshot to the
+        # agreement below, so errors are not re-raised here
+        self.wait_async(raise_errors=False)
         inject("checkpoint.load")
         local = set(self._local_iterations())
         while True:
@@ -273,7 +412,10 @@ class MultiNodeCheckpointer:
         }
 
     def finalize(self) -> None:
-        """Remove every snapshot this rank owns (reference ``finalize``)."""
+        """Remove every snapshot this rank owns (reference ``finalize``).
+        Joins pending async saves and stops the writer thread first."""
+        self.wait_async(raise_errors=False)
+        self._shutdown_writer()
         for it in self._local_iterations():
             try:
                 os.remove(self.filename(it))

@@ -116,6 +116,7 @@ class SerialIterator:
             "order": np.asarray(self._order).tolist(),
             "rng": self._rng.get_state(),
             "exhausted": self._exhausted,
+            "is_new_epoch": self.is_new_epoch,
         }
 
     def load_state_dict(self, state: dict) -> None:
@@ -125,6 +126,10 @@ class SerialIterator:
         self._order = np.asarray(state["order"], dtype=np.int64)
         self._rng.set_state(state["rng"])
         self._exhausted = bool(state["exhausted"])
+        # a snapshot taken exactly at an epoch boundary must restore the
+        # boundary flag too (epoch-cadenced callers key off it); absent in
+        # pre-PR4 snapshots -> False, matching mid-epoch behavior
+        self.is_new_epoch = bool(state.get("is_new_epoch", False))
 
     # -- internals ------------------------------------------------------- #
 

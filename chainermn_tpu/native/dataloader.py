@@ -9,9 +9,13 @@ cite). The TPU rebuild's input path re-designs that as:
   from a contiguous uint8 array and fuse the uint8 -> float32
   ``(x/255 - mean) / std`` normalize, multithreaded, GIL released for the
   whole call;
-- **one-batch-ahead prefetch** on a Python thread: while the training step
-  runs, the next batch is being assembled — the loop's input cost is
-  max(0, assembly - step) instead of assembly + step.
+- **prefetch** on a Python producer thread (``prefetch_depth`` batches
+  ahead, default 2): while the training step runs, the next batches are
+  being assembled — the loop's input cost is max(0, assembly - step)
+  instead of assembly + step. Abandoning iteration early stops AND joins
+  the producer (no thread leak per epoch). Compose with
+  :class:`chainermn_tpu.dataflow.DevicePrefetcher` to also move the H2D
+  transfer off the critical path.
 
 Falls back to a numpy implementation when the g++ toolchain is missing
 (``native_available()`` tells you which path you got — same posture as the
@@ -98,6 +102,7 @@ class NativeBatchLoader:
         seed: int = 0,
         n_threads: Optional[int] = None,
         prefetch: bool = True,
+        prefetch_depth: int = 2,
     ) -> None:
         self._x = np.ascontiguousarray(images_u8)
         if self._x.dtype != np.uint8:
@@ -133,6 +138,11 @@ class NativeBatchLoader:
         self._n_threads = n_threads or min(8, os.cpu_count() or 1)
         self._native = native_available()
         self._prefetch = prefetch
+        if prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {prefetch_depth}")
+        self._prefetch_depth = int(prefetch_depth)
+        self._producers: list[threading.Thread] = []
         self.epoch = 0
         self.is_new_epoch = False
 
@@ -189,17 +199,31 @@ class NativeBatchLoader:
             return
         # per-iterator state: multiple live iterators (or a closed earlier
         # one) must not stop each other's producer
-        q: queue.Queue = queue.Queue(maxsize=2)
+        q: queue.Queue = queue.Queue(maxsize=self._prefetch_depth)
         stop = threading.Event()
+
+        def offer(item) -> bool:
+            # a bounded put that close() can always interrupt — a producer
+            # parked in a plain q.put() would outlive abandoned iteration
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             for sel, last in self._index_batches():
                 if stop.is_set():
                     return
-                q.put((self._assemble_sel(sel), last))
-            q.put(None)
+                if not offer((self._assemble_sel(sel), last)):
+                    return
+            offer(None)
 
         worker = threading.Thread(target=producer, daemon=True)
+        self._producers = [t for t in self._producers if t.is_alive()]
+        self._producers.append(worker)
         worker.start()
         try:
             while True:
@@ -212,12 +236,15 @@ class NativeBatchLoader:
                     self.epoch += 1
                 yield batch
         finally:
+            # abandoned-early or exhausted: stop, drain (unblocks a full-
+            # queue put), and JOIN — no daemon-thread leak per epoch
             stop.set()
-            # unblock a producer waiting on a full queue
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                pass
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            worker.join(timeout=5.0)
 
     def _assemble_sel(self, sel: np.ndarray):
         """Sample positions -> (normalized images, labels)."""

@@ -71,13 +71,26 @@ class ResilientTrainer:
         depends on input placement (sharded params/opt state on a mesh)
         needs them ``device_put`` back to the original shardings to keep
         the resumed trajectory bit-exact.
+    async_save : bool
+        Snapshot via ``checkpointer.save_async``: the loop blocks only on
+        the ``device_get`` (the consistency point) while serialization +
+        disk write + GC run on the checkpointer's writer thread. The
+        snapshot CONTENT is identical to the sync path, so resume stays
+        bit-exact. Recovery never races a pending write (``maybe_load``
+        joins first), and :meth:`fit` closes with a ``wait_async`` so the
+        final snapshot is durable — a writer failure raises there. With
+        async saves the trainer-level ``retry`` only covers enqueue-time
+        faults; give write-retry budget to the CHECKPOINTER
+        (``MultiNodeCheckpointer(retry=...)``), which applies it on the
+        writer thread.
     """
 
     def __init__(self, step_fn: Callable, checkpointer, *,
                  save_every: int = 10, max_restores: int = 3,
                  retry: Optional[RetryPolicy] = None,
                  dump_on_failure: bool = True,
-                 restore_hook: Optional[Callable] = None) -> None:
+                 restore_hook: Optional[Callable] = None,
+                 async_save: bool = False) -> None:
         if save_every < 1:
             raise ValueError(f"save_every must be >= 1, got {save_every}")
         self.step_fn = step_fn
@@ -87,6 +100,11 @@ class ResilientTrainer:
         self.retry = retry if retry is not None else RetryPolicy(3)
         self.dump_on_failure = dump_on_failure
         self.restore_hook = restore_hook
+        self.async_save = bool(async_save)
+        if self.async_save and not hasattr(checkpointer, "save_async"):
+            raise TypeError(
+                f"async_save=True needs a checkpointer with save_async(); "
+                f"{type(checkpointer).__name__} has none")
         reg = get_registry()
         self._c_failures = reg.counter("trainer_failures_total")
         self._c_restores = reg.counter("trainer_restores_total")
@@ -97,9 +115,11 @@ class ResilientTrainer:
 
     def _save(self, state, iterator, iteration: int) -> None:
         snap = {"state": state, "iterator": iterator.state_dict()}
-        self.retry.call(self.checkpointer.save, snap, iteration,
-                        op="checkpoint.save")
-        self._events.emit("trainer_snapshot", iteration=iteration)
+        save = (self.checkpointer.save_async if self.async_save
+                else self.checkpointer.save)
+        self.retry.call(save, snap, iteration, op="checkpoint.save")
+        self._events.emit("trainer_snapshot", iteration=iteration,
+                          asynchronous=self.async_save)
 
     def _load(self):
         return self.retry.call(self.checkpointer.maybe_load,
@@ -174,6 +194,10 @@ class ResilientTrainer:
             i += 1
             if i % self.save_every == 0 or i == n_steps:
                 self._save(state, iterator, i)
+        if self.async_save:
+            # end-of-run barrier: the final snapshot must be durable (and
+            # any writer failure loud) before the run reports success
+            self.checkpointer.wait_async()
         report = {
             "steps": int(n_steps),
             "resumed_from": int(resumed_from),

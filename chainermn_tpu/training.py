@@ -462,3 +462,81 @@ def jit_lm_train_step(
     donate_argnums = (0, 1) if donate else ()
     jitted = jax.jit(sm, donate_argnums=donate_argnums)
     return instrument(jitted, "lm_train_step") if monitored else jitted
+
+
+def fit(
+    step: Callable,
+    variables,
+    opt_state,
+    data,
+    n_steps: int,
+    *,
+    fetch_every: int = 8,
+    prefetch_depth: int = 0,
+    sharding=None,
+    transform: Optional[Callable] = None,
+    on_loss: Optional[Callable] = None,
+    name: str = "fit",
+) -> tuple:
+    """The async hot loop: drive a jitted step ``n_steps`` times with
+    dispatch-ahead loss handling and (optionally) device prefetch.
+
+    The synchronous pattern — ``batch = next(data); ...; float(loss)``
+    per step — pays host latencies on the critical path twice: the input
+    side (assembly + H2D after the step instead of under it) and the
+    output side (a device->host round trip per step; PERF.md measured
+    ~80 ms of RTT per blocked step through the axon tunnel). This loop
+    pays neither: batches arrive device-resident from a
+    :class:`~chainermn_tpu.dataflow.DevicePrefetcher` producer thread,
+    and losses stay ON DEVICE in a
+    :class:`~chainermn_tpu.dataflow.LossWindow`, fetched batched every
+    ``fetch_every`` steps — one round trip closes the whole window and
+    bounds in-flight dispatch at ``fetch_every`` steps.
+
+    Parameters
+    ----------
+    step : callable
+        ``step(variables, opt_state, x, y)`` returning
+        ``(variables, opt_state, loss)`` (:func:`jit_train_step`) or
+        ``(params, opt_state, loss, stats)`` (:func:`jit_lm_train_step`;
+        ``stats`` is dropped here — drive MoE telemetry loops manually).
+    data : iterator or iterable
+        Yields ``(x, y)`` batch pairs. With ``prefetch_depth > 0`` it is
+        wrapped in a ``DevicePrefetcher(depth=prefetch_depth,
+        sharding=sharding, transform=transform)``; otherwise batches are
+        fed as yielded (pass an already-wrapped prefetcher here to keep
+        its ``state_dict`` under your control).
+    fetch_every : int
+        Loss-fetch cadence AND the in-flight dispatch bound.
+        ``fetch_every=1`` degenerates to the synchronous per-step fetch.
+    on_loss : callable, optional
+        ``on_loss(step_index, float_loss)`` per loss, at fetch time
+        (i.e. up to ``fetch_every - 1`` steps late).
+
+    Returns
+    -------
+    ``(variables, opt_state, losses)`` — ``losses`` is every step's loss
+    as floats, in step order; the trailing drain doubles as the loop's
+    completion barrier, so on return all ``n_steps`` steps have finished
+    on device.
+    """
+    from chainermn_tpu.dataflow import DevicePrefetcher, LossWindow
+
+    prefetcher = None
+    if prefetch_depth:
+        data = prefetcher = DevicePrefetcher(
+            data, depth=prefetch_depth, sharding=sharding,
+            transform=transform, name=name)
+    it = data if hasattr(data, "__next__") else iter(data)
+    window = LossWindow(fetch_every, name=name, on_fetch=on_loss)
+    try:
+        for i in range(n_steps):
+            x, y = next(it)
+            out = step(variables, opt_state, x, y)
+            variables, opt_state = out[0], out[1]
+            window.push(i, out[2])
+        losses = window.drain()
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
+    return variables, opt_state, losses

@@ -167,6 +167,13 @@ def main() -> None:
                              "extension build degrades (all ranks together) "
                              "to numpy; an EXPLICIT --native-loader fails "
                              "hard instead")
+    parser.add_argument("--device-prefetch", type=int, default=0,
+                        help="wrap the pre-normalized input stream (native "
+                             "C++ or JPEG loader) in a dataflow."
+                             "DevicePrefetcher: a producer thread "
+                             "device_puts N batches ahead with the step's "
+                             "data sharding, so H2D overlaps the step "
+                             "(0: feed synchronously)")
     parser.add_argument("--fsdp", action="store_true",
                         help="ZeRO-3 layout: params/grads/moments scattered "
                              "over the data axis, XLA-partitioner-inserted "
@@ -406,6 +413,26 @@ def main() -> None:
 
         evaluate = chainermn_tpu.create_multi_node_evaluator(_local_eval, comm)
 
+    if args.device_prefetch:
+        if not pre_normalized:
+            raise SystemExit(
+                "--device-prefetch wraps the pre-normalized input stream "
+                "(native C++ or JPEG loader); the numpy SerialIterator "
+                "path collates inside the loop — use --native-loader or "
+                "--train-dir")
+        from chainermn_tpu.dataflow import DevicePrefetcher
+
+        # epoch/is_new_epoch on the wrapper track DELIVERED batches, so
+        # the epoch-cadenced eval below keys off the wrapper, not the
+        # producer-paced loader
+        batches = it = DevicePrefetcher(
+            it, depth=args.device_prefetch,
+            sharding=comm.named_sharding(*comm.data_spec),
+            name="imagenet")
+        if comm.rank == 0:
+            print(f"device prefetch: depth {args.device_prefetch} "
+                  "(H2D on a producer thread)")
+
     iteration = 0
     t0 = time.time()
     imgs = 0
@@ -436,6 +463,8 @@ def main() -> None:
         if args.iterations and iteration >= args.iterations:
             break
     jax.block_until_ready(loss)
+    if args.device_prefetch:
+        it.close()  # stop + join the producer thread
     if evaluate is not None and not it.is_new_epoch:
         # exited mid-epoch (--iterations): still report a final top-1
         metrics = evaluate()

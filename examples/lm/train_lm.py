@@ -243,7 +243,8 @@ def run_resilient(args, comm, step, params, opt_state,
             step_fn, {"params": params, "opt_state": opt_state,
                       "loss": None},
             it, args.iterations, ckpt, save_every=args.save_every,
-            restore_hook=restore_hook, on_step=on_step)
+            restore_hook=restore_hook, on_step=on_step,
+            async_save=args.async_save)
     finally:
         if injector is not None:
             injector.uninstall()
@@ -320,6 +321,25 @@ def main() -> None:
                         help="with --resume: snapshot directory")
     parser.add_argument("--save-every", type=int, default=20,
                         help="with --resume: snapshot cadence in steps")
+    parser.add_argument("--async-save", action="store_true",
+                        help="with --resume: background checkpointing — "
+                             "the loop blocks only on the device_get; "
+                             "serialize + write + GC run on the "
+                             "checkpointer's writer thread "
+                             "(dataflow async hot loop)")
+    parser.add_argument("--prefetch-depth", type=int, default=0,
+                        help="device-prefetch the batch stream this many "
+                             "batches ahead on a producer thread (H2D "
+                             "overlaps the step; dataflow."
+                             "DevicePrefetcher). 0: synchronous feeding")
+    parser.add_argument("--fetch-every", type=int, default=1,
+                        help="dispatch-ahead loss cadence: keep losses on "
+                             "device and fetch them batched every K steps "
+                             "(bounded in-flight window; loss prints lag "
+                             "up to K-1 steps). 1: per-step fetch. With "
+                             "either this >1 or --prefetch-depth the loop "
+                             "runs through training.fit (per-step MoE "
+                             "drop-fraction prints are skipped there)")
     parser.add_argument("--inject-fault", type=int, default=0,
                         help="with --resume: crash training at this step "
                              "(a seeded resilience.FaultInjector raise) "
@@ -365,6 +385,12 @@ def main() -> None:
         raise SystemExit("--resume wraps the plain/SP/TP/MoE train loop in "
                          "resilient_fit; the gspmd/pipeline modes build "
                          "their own loops and would silently ignore it")
+    if (args.prefetch_depth or args.fetch_every > 1) and (
+            args.gspmd or args.pipeline or args.resume):
+        raise SystemExit("--prefetch-depth/--fetch-every drive the plain "
+                         "loop through training.fit; the gspmd/pipeline/"
+                         "resume modes build their own loops and would "
+                         "silently ignore them")
     if args.gspmd:
         return run_gspmd(args, comm)
     if args.pipeline:
@@ -483,6 +509,38 @@ def main() -> None:
     if args.resume:
         return run_resilient(args, comm, step, params, opt_state,
                              tokens_all, targets_all, n_seq, batch)
+
+    if args.prefetch_depth or args.fetch_every > 1:
+        # the async hot loop: batches device_put by a producer thread,
+        # losses fetched batched — the host leaves the critical path
+        from jax.sharding import PartitionSpec as P
+
+        from chainermn_tpu.training import fit
+
+        data_spec = (P(None, comm.axis_name) if args.seq_parallel
+                     else P() if args.tensor_parallel
+                     else comm.data_spec)
+
+        def on_loss(i, v):
+            if (i + 1) % 20 == 0 and comm.rank == 0:
+                print(f"iter {i + 1:4d}  loss {v:.3f}")
+
+        t0 = time.time()
+        params, opt_state, losses = fit(
+            step, params, opt_state, batches(), args.iterations,
+            fetch_every=args.fetch_every,
+            prefetch_depth=args.prefetch_depth,
+            sharding=comm.named_sharding(*data_spec),
+            transform=lambda b: (jnp.asarray(b[0]), jnp.asarray(b[1])),
+            on_loss=on_loss, name="train_lm")
+        if comm.rank == 0:
+            tok_s = args.iterations * batch * args.seq_len / (
+                time.time() - t0)
+            print(f"done: {args.iterations} iterations (prefetch_depth="
+                  f"{args.prefetch_depth}, fetch_every={args.fetch_every}),"
+                  f" loss {losses[0]:.3f} -> {losses[-1]:.3f}  "
+                  f"{tok_s:.0f} tok/s incl. compile")
+        return
 
     from chainermn_tpu.parallel import MoeStatsAccumulator
 

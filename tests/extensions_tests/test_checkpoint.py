@@ -106,3 +106,141 @@ def test_iterator_state_in_snapshot(comm, tmp_path):
 def test_bad_name_rejected(comm, tmp_path):
     with pytest.raises(ValueError):
         create_multi_node_checkpointer("../evil", comm, path=str(tmp_path))
+
+
+# --------------------------------------------------------------------- #
+# background checkpointing (dataflow async hot loop)                     #
+# --------------------------------------------------------------------- #
+
+
+def test_save_async_roundtrip_and_content_identical(comm, tmp_path):
+    """An async snapshot's bytes go through the same serialize + footer +
+    rename path: content (and therefore resume) is identical to sync."""
+    cp = create_multi_node_checkpointer("a", comm, path=str(tmp_path))
+    cp.save(_state(3), 3)
+    with open(cp.filename(3), "rb") as f:
+        sync_bytes = f.read()
+    cp.finalize()
+    cp.save_async(_state(3), 3)
+    assert cp.wait_async() is True
+    with open(cp.filename(3), "rb") as f:
+        assert f.read() == sync_bytes
+    loaded, it = cp.maybe_load()
+    assert it == 3 and loaded["iteration"] == 3
+    assert cp.stats["save_async"] and cp.stats["save_async"][0] > 0
+
+
+def test_save_async_snapshot_content_fixed_at_call(comm, tmp_path):
+    """device_get on the calling thread is the consistency point: host
+    mutation after save_async returns must not reach the snapshot."""
+    cp = create_multi_node_checkpointer("c", comm, path=str(tmp_path))
+    state = {"w": np.arange(4.0)}
+    cp.save_async(state, 1)
+    state["w"][:] = -1.0          # mutate immediately after enqueue
+    cp.wait_async()
+    loaded, _ = cp.maybe_load()
+    np.testing.assert_array_equal(loaded["w"], np.arange(4.0))
+
+
+def test_maybe_load_joins_pending_async_save(comm, tmp_path):
+    """The pre-restore join: a maybe_load issued right after save_async
+    must see that snapshot (never race the writer)."""
+    cp = create_multi_node_checkpointer("j", comm, path=str(tmp_path))
+    for i in (1, 2, 3):
+        cp.save_async(_state(i), i)
+    loaded, it = cp.maybe_load()   # no explicit wait_async
+    assert it == 3 and loaded["iteration"] == 3
+
+
+def test_async_gc_under_lock_retains_newest(comm, tmp_path):
+    """The GC-race fix: GC runs on the writer thread under the write lock,
+    so a burst of async saves converges to exactly n_retains intact
+    newest snapshots — no .tmp is ever orphaned by a concurrent GC."""
+    cp = create_multi_node_checkpointer("g", comm, path=str(tmp_path),
+                                        n_retains=2)
+    for i in range(1, 7):
+        cp.save_async(_state(i), i)
+    cp.wait_async()
+    assert cp._local_iterations() == [5, 6]
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    loaded, it = cp.maybe_load()
+    assert it == 6 and loaded["iteration"] == 6
+
+
+def test_async_writer_error_surfaces_on_wait(comm, tmp_path):
+    from chainermn_tpu.resilience import FaultInjector, InjectedFault
+
+    cp = create_multi_node_checkpointer("e", comm, path=str(tmp_path))
+    inj = FaultInjector()
+    inj.arm("checkpoint.write", kind="raise", times=1)
+    with inj:
+        cp.save_async(_state(1), 1)
+        with pytest.raises(InjectedFault):
+            cp.wait_async()
+    # the failure left a torn .tmp at worst; a later save + load recover
+    cp.save_async(_state(2), 2)
+    assert cp.wait_async() is True
+    loaded, it = cp.maybe_load()
+    assert it == 2
+
+
+def test_async_error_reraised_on_next_save(comm, tmp_path):
+    from chainermn_tpu.resilience import FaultInjector, InjectedFault
+
+    cp = create_multi_node_checkpointer("e2", comm, path=str(tmp_path))
+    inj = FaultInjector()
+    inj.arm("checkpoint.write", kind="raise", times=1)
+    with inj:
+        cp.save_async(_state(1), 1)
+        cp.wait_async(raise_errors=False)  # drained silently...
+    # ...but counted: the restore-path posture never loses the signal
+    from chainermn_tpu.monitor import get_registry
+
+    c = get_registry().counter("checkpoint_async_errors_total",
+                               {"name": "e2"})
+    assert c.value >= 1
+    inj2 = FaultInjector()
+    inj2.arm("checkpoint.write", kind="raise", times=1)
+    with inj2:
+        cp.save_async(_state(2), 2)
+        import time as _time
+
+        deadline = _time.time() + 5
+        while cp._async_pending and _time.time() < deadline:
+            _time.sleep(0.01)
+        with pytest.raises(InjectedFault):
+            cp.save_async(_state(3), 3)   # pending error re-raises here
+
+
+def test_async_torn_write_detected_on_load(comm, tmp_path):
+    """torn_write cut-point fires on the writer thread too: the CRC footer
+    catches it and maybe_load skips back — the PR 3 guarantee holds
+    through the async path."""
+    from chainermn_tpu.resilience import FaultInjector
+
+    cp = create_multi_node_checkpointer("tw", comm, path=str(tmp_path))
+    cp.save_async(_state(1), 1)
+    cp.wait_async()            # iteration 1 durable before arming the fault
+    inj = FaultInjector()
+    inj.arm("checkpoint.write", kind="torn_write", frac=0.5, times=1)
+    with inj:
+        cp.save_async(_state(2), 2)
+        cp.wait_async()                    # truncation is SILENT: no error
+    assert os.path.exists(cp.filename(2))  # rename ran
+    loaded, it = cp.maybe_load()
+    assert it == 1 and loaded["iteration"] == 1   # checksum skipped back
+
+
+def test_async_with_checkpointer_retry_absorbs_transient(comm, tmp_path):
+    from chainermn_tpu.resilience import FaultInjector, RetryPolicy
+
+    cp = create_multi_node_checkpointer(
+        "r", comm, path=str(tmp_path),
+        retry=RetryPolicy(3, base_delay_s=0.001, jitter=0))
+    inj = FaultInjector()
+    inj.arm("checkpoint.write", kind="raise", times=1)
+    with inj:
+        cp.save_async(_state(5), 5)
+        assert cp.wait_async() is True     # retried away on the writer
+    loaded, it = cp.maybe_load()
+    assert it == 5

@@ -127,3 +127,45 @@ def test_independent_iterators():
     it1.close()
     rest = sum(1 for _ in it2)
     assert rest == 7  # it2 finished its epoch despite it1's close
+
+
+def test_prefetch_depth_configurable():
+    x, y = _data(n=64, seed=7)
+    kw = dict(batch_size=8, shuffle=True, repeat=False, seed=9)
+    sync = list(NativeBatchLoader(x, y, prefetch=False, **kw))
+    for depth in (1, 4):
+        deep = list(NativeBatchLoader(x, y, prefetch=True,
+                                      prefetch_depth=depth, **kw))
+        assert len(deep) == len(sync)
+        for (bs, ls), (bd, ld) in zip(sync, deep):
+            np.testing.assert_array_equal(ls, ld)
+            np.testing.assert_allclose(bs, bd)
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        NativeBatchLoader(x, y, 8, prefetch_depth=0)
+
+
+def test_abandoned_iteration_joins_producer():
+    """Closing (or abandoning) an iterator mid-epoch must stop AND join
+    its producer thread — no daemon-thread leak per epoch."""
+    import time
+
+    x, y = _data(n=64, seed=8)
+    loader = NativeBatchLoader(x, y, 4, shuffle=False, repeat=True,
+                               prefetch_depth=2)
+    it = iter(loader)
+    next(it)
+    assert loader._producers and loader._producers[-1].is_alive()
+    it.close()                       # abandon after one batch
+    deadline = time.time() + 5
+    while loader._producers[-1].is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not loader._producers[-1].is_alive()
+
+    # exhausting an epoch also leaves no live producer behind
+    loader2 = NativeBatchLoader(x, y, 8, repeat=False, prefetch_depth=3)
+    list(loader2)
+    deadline = time.time() + 5
+    while any(t.is_alive() for t in loader2._producers) \
+            and time.time() < deadline:
+        time.sleep(0.01)
+    assert not any(t.is_alive() for t in loader2._producers)
