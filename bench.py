@@ -554,7 +554,7 @@ def serving_main() -> None:
 
     import jax.numpy as jnp
 
-    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.models import TransformerLM, generate
     from chainermn_tpu.serving import FCFSScheduler, ServingEngine
 
     e = os.environ.get
@@ -622,6 +622,101 @@ def serving_main() -> None:
             "queue_depth_p99": m["queue_depth_p99"],
             "recompiles": engine.compile_counts(),
         }
+
+        # ---- prefix-heavy workload: shared system prompt, mixed tails - #
+        # The admission fast path's acceptance numbers (ISSUE 5): the SAME
+        # workload runs twice through bucketed batched-prefill engines —
+        # prefix cache ON vs OFF — so the TTFT delta isolates KV reuse.
+        # Every request shares a system-prompt prefix; tails are ragged.
+        buckets = tuple(
+            int(x) for x in e(
+                "CHAINERMN_TPU_SERVE_BUCKETS",
+                f"{max(1, prefill_len // 4)},{prefill_len}").split(","))
+        batch_k = int(e("CHAINERMN_TPU_SERVE_PREFILL_BATCH", "4"))
+        shared_len = min(int(e("CHAINERMN_TPU_SERVE_SHARED_PREFIX",
+                               str(3 * prefill_len // 4))), prefill_len - 1)
+        block = int(e("CHAINERMN_TPU_SERVE_PREFIX_BLOCK",
+                      str(max(1, prefill_len // 8))))
+        n_blocks = int(e("CHAINERMN_TPU_SERVE_PREFIX_BLOCKS", "64"))
+        min_insert = int(e("CHAINERMN_TPU_SERVE_MIN_INSERT", "2"))
+        shared = rng.randint(1, vocab, shared_len).astype(np.int32)
+        tail_max = prefill_len - shared_len
+        jobs = [
+            (np.concatenate([shared, rng.randint(
+                1, vocab, 1 + i % tail_max).astype(np.int32)]),
+             int(rng.randint(1, max_new + 1)))
+            for i in range(n_requests)
+        ]
+
+        def run_prefix_workload(prefix_on):
+            eng = ServingEngine(
+                model, params, n_slots=n_slots, prefill_buckets=buckets,
+                prefill_batch=batch_k,
+                prefix_cache_blocks=n_blocks if prefix_on else 0,
+                prefix_block_size=block,
+                prefix_min_insert_blocks=min_insert)
+            eng.warmup()                      # every program, off the clock
+            counts = eng.compile_counts_detailed()
+            seeder = FCFSScheduler(eng)       # seed the trie off the clock
+            seeder.submit(
+                np.concatenate([shared, np.array([1], np.int32)]), 1)
+            seeder.run_until_idle()
+            s = FCFSScheduler(eng)
+            t0 = time.time()
+            reqs = [s.submit(p, n) for p, n in jobs]
+            s.run_until_idle()
+            wall = time.time() - t0
+            assert eng.compile_counts_detailed() == counts, "recompiled!"
+            return eng, s.metrics.report(), reqs, wall
+
+        eng_on, m_on, reqs_on, wall_on = run_prefix_workload(True)
+        eng_off, m_off, _, wall_off = run_prefix_workload(False)
+        # token-for-token parity vs solo generate() (greedy), through
+        # prefix fetch + batched suffix prefill
+        parity = True
+        for i in (0, 1):
+            prompt, n = jobs[i]
+            ref = np.asarray(generate(model, params,
+                                      jnp.asarray(prompt)[None], n)[0])
+            parity = parity and bool(np.array_equal(reqs_on[i].output, ref))
+        pstats = eng_on.prefix_stats()
+        record["prefix_serving"] = {
+            "buckets": list(buckets),
+            "prefill_batch": batch_k,
+            "shared_prefix": shared_len,
+            "prefix_blocks": n_blocks,
+            "block_size": block,
+            # per-ADMISSION hit rate (fraction of admitted requests whose
+            # prompt was partly served from cache); the trie's own stats
+            # (below) count every match probe incl. re-scanned candidates
+            "hit_rate": m_on.get("prefix_hit_rate", 0.0),
+            "trie": pstats,
+            "evictions": pstats["evictions"],
+            "cached_prefix_frac_mean": m_on.get("cached_prefix_frac_mean",
+                                                0.0),
+            "prefill_batch_occupancy":
+                m_on.get("prefill_batch_size_mean", 0.0),
+            "ttft_p50_ms": round(m_on["ttft_p50_s"] * 1e3, 3),
+            "ttft_p99_ms": round(m_on["ttft_p99_s"] * 1e3, 3),
+            "ttft_p50_ms_off": round(m_off["ttft_p50_s"] * 1e3, 3),
+            "ttft_p99_ms_off": round(m_off["ttft_p99_s"] * 1e3, 3),
+            "ttft_p50_speedup": round(
+                m_off["ttft_p50_s"] / max(m_on["ttft_p50_s"], 1e-9), 3),
+            "tokens_per_sec": m_on["tokens_per_sec"],
+            "tokens_per_sec_off": m_off["tokens_per_sec"],
+            "wall_s": round(wall_on, 3),
+            "wall_s_off": round(wall_off, 3),
+            "recompiles_after_warmup":
+                sum(eng_on.recompiles.values())
+                + sum(eng_off.recompiles.values()),
+            "parity_vs_solo_generate": parity,
+            "compile_counts": eng_on.compile_counts_detailed(),
+        }
+        log(f"prefix serving: "
+            f"hit_rate={record['prefix_serving']['hit_rate']} "
+            f"ttft_p50 {record['prefix_serving']['ttft_p50_ms']}ms (on) vs "
+            f"{record['prefix_serving']['ttft_p50_ms_off']}ms (off), "
+            f"parity={parity}")
         from chainermn_tpu.monitor import snapshot as monitor_snapshot
 
         record["monitor"] = monitor_snapshot()

@@ -34,8 +34,14 @@ point                       where it fires
 ``comm.<op>``               eager ``MeshCommunicator`` collectives (allreduce,
                             bcast, allgather, ...), before the device program
 ``comm.allgather_obj``      host object-channel gather (checkpoint agreement)
-``serving.prefill``         ``ServingEngine.prefill``, inside the watchdog
-                            window (a hang here trips hang detection)
+``serving.prefill``         ``ServingEngine.prefill`` (single-request
+                            admission), inside the watchdog window (a
+                            hang here trips hang detection)
+``serving.prefill_batch``   ``ServingEngine.admit_batch`` — before the
+                            batched bucket-prefill device call, so a
+                            raise is contained to the admitting group
+``serving.prefix_copy``     prefix-cache block copies (``op='fetch'`` on
+                            a hit, ``op='insert'`` after admission)
 ``serving.decode``          ``ServingEngine.decode_step``, same window
 ``trainer.step``            each ``resilient_fit`` iteration, inside its
                             exception boundary

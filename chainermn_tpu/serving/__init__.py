@@ -7,12 +7,22 @@ This package is the traffic-facing counterpart — the ROADMAP's
 "serving heavy traffic" axis — built from four layers:
 
 - :class:`~chainermn_tpu.serving.engine.ServingEngine` — mechanism: a
-  fixed pool of cache slots in one persistent static-shape KV cache, two
-  compiled programs (per-slot ``prefill``, all-slots ``decode_step``),
-  zero recompiles after warmup, tensor-parallel via ``comm.shard_map``;
+  fixed pool of cache slots in one persistent static-shape KV cache, a
+  small fixed family of compiled programs (bucketed batched ``prefill``
+  — one program per padded-length bucket admitting up to
+  ``prefill_batch`` requests per call — the all-slots ``decode_step``,
+  and the prefix-copy pair), zero recompiles after :meth:`warmup`,
+  tensor-parallel via ``comm.shard_map``;
+- :class:`~chainermn_tpu.serving.prefix_cache.PrefixCacheIndex` — prefix
+  KV reuse: a host-side ref-counted trie over token blocks backed by a
+  device block store; on admission the longest cached prefix is copied
+  slot-locally and only the uncached suffix prefills (LRU eviction on
+  ref-zero leaves);
 - :class:`~chainermn_tpu.serving.scheduler.FCFSScheduler` — policy: FCFS
-  admission into freed slots between decode steps, request state machine,
-  EOS/length retirement, cancellation;
+  admission into freed slots between decode steps (cost-aware grouping:
+  same-bucket batches preferring shared cached prefixes, bounded prefill
+  interleave per decode step), request state machine, EOS/length
+  retirement, cancellation;
 - :class:`~chainermn_tpu.serving.metrics.ServingMetrics` — observability:
   TTFT/TPOT percentiles, tokens/s, queue depth, slot occupancy (the same
   reporting convention as ``extensions.StepTimer``);
@@ -26,8 +36,13 @@ the same params and rng.
 """
 
 from chainermn_tpu.serving.client import ServingClient
-from chainermn_tpu.serving.engine import ServingEngine
+from chainermn_tpu.serving.engine import (
+    AdmitPlan,
+    EngineStateError,
+    ServingEngine,
+)
 from chainermn_tpu.serving.metrics import ServingMetrics
+from chainermn_tpu.serving.prefix_cache import PrefixCacheIndex, PrefixMatch
 from chainermn_tpu.serving.scheduler import (
     DeadlineExceededError,
     EngineFailed,
@@ -38,9 +53,13 @@ from chainermn_tpu.serving.scheduler import (
 )
 
 __all__ = [
+    "AdmitPlan",
     "DeadlineExceededError",
     "EngineFailed",
+    "EngineStateError",
     "FCFSScheduler",
+    "PrefixCacheIndex",
+    "PrefixMatch",
     "QueueFullError",
     "Request",
     "RequestState",

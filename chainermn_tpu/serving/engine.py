@@ -4,44 +4,66 @@ The offline :func:`chainermn_tpu.models.generate` decodes ONE fixed batch
 start-to-finish; a traffic-facing server cannot wait for the slowest
 request before admitting the next. This engine owns a fixed pool of
 ``n_slots`` cache slots inside one persistent static-shape KV cache
-(:func:`~chainermn_tpu.models.transformer.init_kv_caches`-backed) and
-exposes exactly two compiled device programs:
+(:func:`~chainermn_tpu.models.transformer.init_kv_caches`-backed) and a
+small fixed family of compiled device programs:
 
-- ``prefill``: run one request's (padded) prompt through the model,
-  writing its K/V into ONE slot of the shared cache and sampling the first
-  generated token — admission cost is one prefill, independent of every
-  other slot's progress;
+- ``prefill`` (one program per **bucket**): run up to ``prefill_batch``
+  requests' (padded) prompt suffixes through the model in ONE call, each
+  batch row writing K/V into its OWN slot at its OWN start position (the
+  per-row ``[B, T]`` position form of ``TransformerLM.__call__`` over the
+  per-slot ``update_cache_and_attend``) and sampling its first token —
+  admission cost is one batched suffix prefill, amortized over the group;
 - ``decode_step``: advance ALL slots one token per call, each at its OWN
-  sequence position (the per-slot ``[B]`` position form of
-  ``update_cache_and_attend``); retired/free slots ride along masked by
-  ``jnp.where`` so shapes never change and nothing recompiles.
+  sequence position; retired/free slots ride along masked by ``jnp.where``
+  so shapes never change and nothing recompiles;
+- ``prefix_insert`` (when the prefix cache is on): copy a freshly
+  prefilled prompt's full KV blocks into the device block store backing
+  :class:`~chainermn_tpu.serving.prefix_cache.PrefixCacheIndex`, deferred
+  off the admission path. The matching *fetch* needs no program of its
+  own: each bucket's prefill gathers the matched blocks INSIDE its single
+  device call (a hit costs zero extra dispatches), then prefills only the
+  uncached suffix.
+
+Prompt padding is **bucketed**: instead of one ``prefill_len``-padded
+program, ``prefill_buckets`` is a small ladder (e.g. ``(64, 256, 1024)``)
+and each admission group runs the smallest bucket covering its (suffix)
+lengths — padding waste shrinks from ``max_len - len`` to the bucket gap
+at the cost of ``len(buckets)`` compiles, all performed once by
+:meth:`warmup` (``RecompileGuard`` pins zero growth after).
 
 Why this is correct without ever zeroing a slot between requests: the
 causal position mask only admits cache rows at positions ``<= q_pos``, and
 every such row was either written by THIS request's prefill (rows
 ``< prompt_len``) or overwritten by one of its decode steps (each step
 writes its query row before attending). Stale K/V from a previous tenant
-of the slot — and the padding rows a short prompt leaves behind — sit at
+of the slot — the padding rows a short prompt leaves behind, warmup's
+dummy rows, and the garbage tail of a copied prefix block span — sit at
 positions the mask excludes until the exact step that overwrites them.
-The engine-level parity test (staggered admissions vs solo ``generate()``,
-token-for-token) pins this.
+Prefix reuse adds one step to the argument: the copied rows ``[0, L)``
+were computed from the SAME first ``L`` tokens at the SAME positions
+(causality: K/V of a position depends only on tokens at or before it), so
+the suffix attends exactly the rows its own full prefill would have
+written. The engine-level parity tests (staggered admissions and shared-
+prefix admissions vs solo ``generate()``, token-for-token) pin both.
 
 Per-request sampling parity: each slot carries its own PRNG key and draws
 through the SAME ``_sampler`` split sequence as a solo ``generate()`` call
 (one split at prefill, one per decode step), via a per-slot vmap — so a
 request's tokens are independent of which other requests share the batch.
 
-Tensor-parallel decode reuses the ``_generate_tp_fn`` pattern: both
-programs are traced inside ``comm.shard_map`` with the cache's head axis
-sharded over the mesh (``P(None, None, axis)`` at rest), and a
-vocab-parallel head's local logits are ``all_gather``-ed before sampling —
-the scheduler drives TP decode through the identical slot API.
+Tensor-parallel decode reuses the ``_generate_tp_fn`` pattern: all
+programs are traced inside ``comm.shard_map`` with the cache's (and block
+store's) head axis sharded over the mesh (``P(None, None, axis)`` at
+rest), and a vocab-parallel head's local logits are ``all_gather``-ed
+before sampling — the scheduler drives TP decode through the identical
+slot API.
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Optional, Union
+from dataclasses import dataclass
+from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +78,32 @@ from chainermn_tpu.models.transformer import (
 from chainermn_tpu.monitor import RecompileGuard, annotate
 from chainermn_tpu.monitor._state import get_event_log, get_registry
 from chainermn_tpu.resilience.faults import inject
+from chainermn_tpu.serving.prefix_cache import PrefixCacheIndex, PrefixMatch
+
+
+@dataclass
+class AdmitPlan:
+    """One request's admission decision: the pinned prefix match (if any),
+    the suffix start position, and the prefill bucket its padded suffix
+    runs in. Built by :meth:`ServingEngine.plan_admission`; consumed by
+    :meth:`ServingEngine.admit_batch` (or discarded via
+    :meth:`ServingEngine.cancel_plan`, which unpins the match)."""
+
+    prompt: np.ndarray
+    rng: object
+    match: Optional[PrefixMatch]
+    start: int          # cached tokens reused (0 on miss)
+    bucket: int         # padded suffix length (one compiled program per)
+
+    @property
+    def cached_frac(self) -> float:
+        return self.start / len(self.prompt) if len(self.prompt) else 0.0
+
+
+class EngineStateError(RuntimeError):
+    """A device-program failure left the engine's donated buffers in an
+    unknown state — containment is impossible; the scheduler must fail all
+    in-flight work and warm-restart."""
 
 
 class ServingEngine:
@@ -73,10 +121,32 @@ class ServingEngine:
     n_slots : int
         Cache slots == max concurrently-decoding requests. The decode
         program's batch dimension; fixed at construction.
-    prefill_len : int
-        Every prompt is right-padded to this length so prefill compiles
-        ONCE. Padding rows write K/V the causal mask hides until decode
-        overwrites them (module docstring); longer prompts are rejected.
+    prefill_len : int, optional
+        Maximum admitted prompt length (== the largest bucket). With the
+        default single-bucket ladder every prompt is right-padded to this
+        length, the PR-1 behavior; padding rows write K/V the causal mask
+        hides until decode overwrites them (module docstring).
+    prefill_buckets : sequence of int, optional
+        Ascending ladder of padded prompt(-suffix) lengths, one compiled
+        prefill program each; an admission runs the smallest bucket
+        covering it. Default ``(prefill_len,)``. When both are given,
+        ``max(prefill_buckets)`` must equal ``prefill_len``.
+    prefill_batch : int
+        Batch dimension of every bucket's prefill program: up to this many
+        requests admit per device call (rows beyond the group ride along
+        masked). Clamped to ``n_slots``. Default 1 (the PR-1 shape).
+    prefix_cache_blocks / prefix_block_size : int
+        ``prefix_cache_blocks > 0`` enables ref-counted prefix KV reuse: a
+        device block store of that many ``prefix_block_size``-token blocks
+        plus a host trie (:class:`PrefixCacheIndex`). On admission the
+        longest cached prefix is copied slot-locally (compiled-once fetch
+        program) and only the suffix prefills; after admission the
+        prompt's full blocks are inserted back (compiled-once insert
+        program). 0 disables (default).
+    prefix_min_insert_blocks : int
+        Cost/benefit gate on inserts: skip caching prompts contributing
+        fewer than this many new full blocks (an insert is a device copy;
+        a unique ragged tail is never re-hit). Default 1 (cache all).
     cache_len : int, optional
         Per-slot KV capacity (prompt + generated); defaults to
         ``model.max_len``. A request needs ``len(prompt) + max_new <=
@@ -85,11 +155,11 @@ class ServingEngine:
         request (the compiled programs bake it in, exactly like
         ``generate()``'s lru-cache key).
     comm : communicator, optional
-        Required iff ``model.tensor_axis`` is set: both programs then run
-        inside its ``shard_map`` with head-sharded caches.
+        Required iff ``model.tensor_axis`` is set: all programs then run
+        inside its ``shard_map`` with head-sharded caches and block store.
     watchdog : Watchdog or float, optional
-        Hang detection around every device program call (prefill AND the
-        all-slots decode step). Default **off**. A float builds a
+        Hang detection around every device program call (prefill, decode,
+        prefix copies). Default **off**. A float builds a
         ``Watchdog(timeout=...)`` (abort mode — die loudly, the
         ``global_except_hook`` stance); pass a configured ``Watchdog``
         (e.g. ``on_timeout='warn'``) for report-only. On fire it dumps
@@ -99,7 +169,13 @@ class ServingEngine:
         thread forever.
     """
 
-    def __init__(self, model, params, *, n_slots: int, prefill_len: int,
+    def __init__(self, model, params, *, n_slots: int,
+                 prefill_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 prefill_batch: int = 1,
+                 prefix_cache_blocks: int = 0,
+                 prefix_block_size: int = 16,
+                 prefix_min_insert_blocks: int = 1,
                  cache_len: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0, comm=None,
                  watchdog: Optional[Union[Watchdog, float]] = None):
@@ -121,6 +197,31 @@ class ServingEngine:
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         cache_len = cache_len or model.max_len
+        if prefill_buckets is None:
+            if prefill_len is None:
+                raise ValueError("pass prefill_len or prefill_buckets")
+            if not 0 < prefill_len <= cache_len:
+                raise ValueError(
+                    f"prefill_len must be in (0, cache_len={cache_len}], "
+                    f"got {prefill_len}"
+                )
+            buckets = (int(prefill_len),)
+        else:
+            buckets = tuple(sorted({int(b) for b in prefill_buckets}))
+            if not buckets:
+                raise ValueError("prefill_buckets must be non-empty")
+            if prefill_len is not None and int(prefill_len) != buckets[-1]:
+                raise ValueError(
+                    f"prefill_len {prefill_len} != max(prefill_buckets) "
+                    f"{buckets[-1]} — the largest bucket IS the admission "
+                    "length limit; pass one or make them agree"
+                )
+            prefill_len = buckets[-1]
+        if not (0 < buckets[0] and buckets[-1] <= cache_len):
+            raise ValueError(
+                f"prefill buckets must be in (0, cache_len={cache_len}], "
+                f"got {buckets}"
+            )
         if not 0 < prefill_len <= cache_len:
             raise ValueError(
                 f"prefill_len must be in (0, cache_len={cache_len}], got "
@@ -131,10 +232,15 @@ class ServingEngine:
                 f"cache_len {cache_len} exceeds model.max_len "
                 f"{model.max_len}"
             )
+        if prefill_batch < 1:
+            raise ValueError(
+                f"prefill_batch must be >= 1, got {prefill_batch}")
         self.model = model
         self.params = params
         self.n_slots = int(n_slots)
         self.prefill_len = int(prefill_len)
+        self.prefill_buckets = buckets
+        self.prefill_batch = min(int(prefill_batch), self.n_slots)
         self.cache_len = int(cache_len)
         self._comm = comm
         self._sample = _sampler(float(temperature), int(top_k), float(top_p))
@@ -144,18 +250,44 @@ class ServingEngine:
         self._events = get_event_log()
         labels = {"engine": "serving"}
         reg = get_registry()
-        self._c_prefills = reg.counter("serving_prefills_total", labels)
+        self._reg = reg
+        self._c_prefills = {
+            b: reg.counter("serving_prefills_total",
+                           dict(labels, prefill_bucket=str(b)))
+            for b in buckets
+        }
         self._c_decode_steps = reg.counter("serving_decode_steps_total",
                                            labels)
         self._c_restarts = reg.counter("serving_engine_restarts_total",
                                        labels)
 
+        # prefix cache: host trie + device block store (built with caches)
+        self.prefix_cache: Optional[PrefixCacheIndex] = None
+        if prefix_cache_blocks:
+            if not 0 < prefix_block_size <= self.prefill_len:
+                raise ValueError(
+                    f"prefix_block_size must be in (0, prefill_len="
+                    f"{self.prefill_len}], got {prefix_block_size}"
+                )
+            self.prefix_cache = PrefixCacheIndex(prefix_cache_blocks,
+                                                 prefix_block_size)
+            # admission cost/benefit knob: an insert is a device copy, so
+            # skip prompts contributing fewer than this many NEW blocks
+            # (shared-prefix traffic caches the shared part on first
+            # sight either way; unique ragged tails are never re-hit)
+            self._min_insert = max(1, int(prefix_min_insert_blocks))
+            # both copy programs move this many whole blocks (static
+            # shapes); junk trailing ids are identity/masked writes
+            self._n_prog_blocks = max(1, self.prefill_len // prefix_block_size)
+
         if model.tensor_axis is not None:
             self._init_tp_caches(comm)
-            self._prefill_fn, self._decode_fn = self._build_tp_fns(comm)
+            self._build_tp_fns(comm)
         else:
             self.caches = init_kv_caches(model, self.n_slots, self.cache_len)
-            self._prefill_fn, self._decode_fn = self._build_fns()
+            if self.prefix_cache is not None:
+                self._store = self._init_store()
+            self._build_fns()
 
         # host-side slot mirror: the scheduler reads/writes through the
         # occupy/release API; the decode program consumes these as [B]
@@ -163,15 +295,39 @@ class ServingEngine:
         self._token = np.zeros((self.n_slots,), np.int32)
         self._pos = np.zeros((self.n_slots,), np.int32)
         self._active = np.zeros((self.n_slots,), bool)
-        self._keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
+        self._keys = self._fresh_keys()
         self.free_slots = set(range(self.n_slots))
+        self._warm = False
+        # deferred trie inserts: (prompt, slot) pairs copied store-side by
+        # flush_inserts() — off the TTFT-critical admission path, always
+        # flushed before the donor slot can be reused (scheduler end-of-
+        # step + the defensive flush at the next admission)
+        self._pending_inserts: list[tuple[np.ndarray, int]] = []
 
         # recompile tracking: the zero-recompile invariant as live
         # telemetry (compile/recompile events + recompiles_total counter),
         # checked after every device call — not only in tests
         self._guard = RecompileGuard()
-        self._guard.watch("serving_prefill", self._prefill_fn)
+        for b, fn in self._prefill_fns.items():
+            self._guard.watch(f"serving_prefill_{b}", fn)
         self._guard.watch("serving_decode", self._decode_fn)
+        if self.prefix_cache is not None:
+            self._guard.watch("serving_prefix_insert", self._insert_fn)
+
+    def _fresh_keys(self):
+        """Zeroed per-slot sampler keys. Under TP they are committed
+        replicated on the mesh up front — the sharding a real admission's
+        key writeback produces — so the decode program warmup-compiles on
+        the SAME argument shardings it will see forever (sharding is part
+        of the jit cache key; an uncommitted warmup key would cost one
+        recompile on first traffic)."""
+        keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
+        if self.model.tensor_axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            keys = jax.device_put(
+                keys, NamedSharding(self._comm.mesh, P()))
+        return keys
 
     def _watched(self, label: str):
         """Watchdog context for one device-program call (no-op when hang
@@ -180,39 +336,84 @@ class ServingEngine:
             return contextlib.nullcontext()
         return self.watchdog.step(label)
 
+    @property
+    def prefix_enabled(self) -> bool:
+        return self.prefix_cache is not None
+
     # ------------------------------------------------------------------ #
     # program construction                                                #
     # ------------------------------------------------------------------ #
 
-    def _prefill_body(self, vocab_gather=None):
-        """Shared prefill trace: slice the slot out of the pooled cache,
-        run the prompt through the model against it, splice the updated
-        slot back, sample the first token from the last REAL position."""
+    def _prefill_body(self, bucket: int, vocab_gather=None):
+        """Batched suffix-prefill trace for one bucket: gather each group
+        row's slot out of the pooled cache, splice each row's cached
+        prefix blocks in from the store (prefix cache on — the fetch is
+        INSIDE this program: a hit costs zero extra device calls), run the
+        padded suffixes at their per-row start positions in ONE model
+        call, splice the updated slots back (inactive rows write back
+        what was there), and sample each row's first token from its last
+        REAL position. Rows without a match carry junk block ids; the
+        garbage span they splice sits entirely under rows their own
+        prefill overwrites or the causal mask hides."""
         model, sample = self.model, self._sample
+        k = self.prefill_batch
+        prefix = self.prefix_cache is not None
+        span = self._n_prog_blocks * self.prefix_cache.block_size \
+            if prefix else 0
 
-        def body(params, caches, tokens, slot, length, key):
+        def slot_sample(lg, key):
+            nxt, key = sample(lg[None], key)
+            return nxt[0], key
+
+        def body(params, caches, tokens, slots, starts, last_idx, active,
+                 keys, store=None, fetch_ids=None):
             with annotate("chainermn.prefill"):
-                return body_inner(params, caches, tokens, slot, length, key)
+                return body_inner(params, caches, tokens, slots, starts,
+                                  last_idx, active, keys, store, fetch_ids)
 
-        def body_inner(params, caches, tokens, slot, length, key):
+        def body_inner(params, caches, tokens, slots, starts, last_idx,
+                       active, keys, store, fetch_ids):
             slot_c = [
-                {k: lax.dynamic_slice_in_dim(c[k], slot, 1, axis=0)
-                 for k in ("k", "v")}
+                {kk: jnp.take(c[kk], slots, axis=0) for kk in ("k", "v")}
                 for c in caches
             ]
-            logits, slot_c = model.apply(params, tokens, 0,
+            if prefix:
+                # per-row prefix splice: gather each row's matched blocks
+                # and overwrite its gathered slot rows [0, span)
+                for sc, st in zip(slot_c, store):
+                    for kk in ("k", "v"):
+                        rows = jnp.take(st[kk], fetch_ids.reshape(-1),
+                                        axis=0)
+                        rows = rows.reshape((k, span) + rows.shape[2:])
+                        sc[kk] = jnp.concatenate(
+                            [rows, sc[kk][:, span:]], axis=1)
+            pos = starts[:, None] + jnp.arange(bucket)[None, :]
+            logits, slot_c = model.apply(params, tokens, pos,
                                          kv_caches=slot_c)
-            caches = [
-                {k: lax.dynamic_update_slice_in_dim(c[k], s[k], slot, axis=0)
-                 for k in ("k", "v")}
-                for c, s in zip(caches, slot_c)
-            ]
-            # logits of the last PROMPT token, not the last padded row
-            lg = lax.dynamic_slice_in_dim(logits, length - 1, 1, axis=1)[:, 0]
+            # each row's logits at its last PROMPT token, not a padded row
+            lg = jax.vmap(
+                lambda row, i: lax.dynamic_slice_in_dim(row, i, 1, 0)[0]
+            )(logits, last_idx)
             if vocab_gather is not None:
                 lg = vocab_gather(lg)
-            nxt, key = sample(lg, key)
-            return caches, nxt[0], key
+            nxt, keys = jax.vmap(slot_sample)(lg, keys)
+            nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+            # write back per row; inactive rows re-write the pool's current
+            # content (identity), so rows beyond the group never corrupt a
+            # slot even if their (junk) slot index collides with a real one
+            out = []
+            for c, s in zip(caches, slot_c):
+                buf = dict(c)
+                for kk in ("k", "v"):
+                    arr = buf[kk]
+                    for i in range(k):
+                        cur = lax.dynamic_slice_in_dim(arr, slots[i], 1, 0)
+                        new = jnp.where(active[i], s[kk][i][None], cur)
+                        arr = lax.dynamic_update_slice_in_dim(
+                            arr, new, slots[i], 0)
+                    buf[kk] = arr
+                out.append(buf)
+            return out, nxt, keys
 
         return body
 
@@ -243,10 +444,54 @@ class ServingEngine:
 
         return body
 
+    def _insert_body(self):
+        """Prefix insert: copy each NEW full block's rows out of the donor
+        slot into its allocated store block. Sequential per-block updates;
+        blocks past ``n_used`` re-write the store's current content
+        (identity), so junk trailing ids never clobber a live block."""
+        bs = self.prefix_cache.block_size
+        n_prog = self._n_prog_blocks
+
+        def body(store, caches, slot, block_ids, row_starts, n_used):
+            with annotate("chainermn.prefix_insert"):
+                out = []
+                for st, c in zip(store, caches):
+                    buf = dict(st)
+                    for kk in ("k", "v"):
+                        arr = buf[kk]
+                        h, dh = c[kk].shape[2], c[kk].shape[3]
+                        for j in range(n_prog):
+                            blk = lax.dynamic_slice(
+                                c[kk], (slot, row_starts[j], 0, 0),
+                                (1, bs, h, dh))[0]
+                            cur = lax.dynamic_slice_in_dim(
+                                arr, block_ids[j], 1, 0)[0]
+                            new = jnp.where(j < n_used, blk, cur)
+                            arr = lax.dynamic_update_slice_in_dim(
+                                arr, new[None], block_ids[j], 0)
+                        buf[kk] = arr
+                    out.append(buf)
+                return out
+
+        return body
+
+    def _init_store(self, local_heads: Optional[int] = None):
+        pc = self.prefix_cache
+        h = local_heads or self.model.n_heads
+        dh = self.model.d_model // self.model.n_heads
+        z = lambda: jnp.zeros((pc.n_blocks, pc.block_size, h, dh),
+                              self.model.compute_dtype)
+        return [{"k": z(), "v": z()} for _ in range(self.model.n_layers)]
+
     def _build_fns(self):
-        prefill = jax.jit(self._prefill_body(), donate_argnums=(1,))
-        decode = jax.jit(self._decode_body(), donate_argnums=(1,))
-        return prefill, decode
+        self._prefill_fns = {
+            b: jax.jit(self._prefill_body(b), donate_argnums=(1,))
+            for b in self.prefill_buckets
+        }
+        self._decode_fn = jax.jit(self._decode_body(), donate_argnums=(1,))
+        if self.prefix_cache is not None:
+            self._insert_fn = jax.jit(self._insert_body(),
+                                      donate_argnums=(0,))
 
     def _init_tp_caches(self, comm):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -261,6 +506,10 @@ class ServingEngine:
         shard = NamedSharding(comm.mesh, P(None, None, axis))
         self.caches = jax.device_put(
             init_kv_caches(self.model, self.n_slots, self.cache_len), shard)
+        if self.prefix_cache is not None:
+            # full-head store buffers; device_put splits the head axis
+            # over the mesh exactly like the pooled caches
+            self._store = jax.device_put(self._init_store(), shard)
 
     def _build_tp_fns(self, comm):
         from jax.sharding import PartitionSpec as P
@@ -273,19 +522,80 @@ class ServingEngine:
 
         cache_spec = [{"k": P(None, None, axis), "v": P(None, None, axis)}
                       for _ in range(self.model.n_layers)]
-        prefill = jax.jit(comm.shard_map(
-            self._prefill_body(gather),
-            in_specs=(P(), cache_spec, P(), P(), P(), P()),
-            out_specs=(cache_spec, P(), P()),
-            check_vma=False,
-        ), donate_argnums=(1,))
-        decode = jax.jit(comm.shard_map(
+        prefill_specs = (P(), cache_spec, P(), P(), P(), P(), P(), P())
+        if self.prefix_cache is not None:
+            prefill_specs = prefill_specs + (cache_spec, P())
+        self._prefill_fns = {
+            b: jax.jit(comm.shard_map(
+                self._prefill_body(b, gather),
+                in_specs=prefill_specs,
+                out_specs=(cache_spec, P(), P()),
+                check_vma=False,
+            ), donate_argnums=(1,))
+            for b in self.prefill_buckets
+        }
+        self._decode_fn = jax.jit(comm.shard_map(
             self._decode_body(gather),
             in_specs=(P(), cache_spec, P(), P(), P(), P()),
             out_specs=(cache_spec, P(), P()),
             check_vma=False,
         ), donate_argnums=(1,))
-        return prefill, decode
+        if self.prefix_cache is not None:
+            self._insert_fn = jax.jit(comm.shard_map(
+                self._insert_body(),
+                in_specs=(cache_spec, cache_spec, P(), P(), P(), P()),
+                out_specs=cache_spec,
+                check_vma=False,
+            ), donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ #
+    # admission planning (host side, cheap)                               #
+    # ------------------------------------------------------------------ #
+
+    def bucket_for(self, suffix_len: int, start: int = 0) -> Optional[int]:
+        """Smallest bucket covering a ``suffix_len``-token prefill that
+        starts at row ``start`` and must stay inside ``cache_len``;
+        ``None`` when no bucket fits."""
+        for b in self.prefill_buckets:
+            if b >= suffix_len and start + b <= self.cache_len:
+                return b
+        return None
+
+    def plan_admission(self, prompt, rng=None) -> AdmitPlan:
+        """Decide how a prompt admits: match (and pin) the longest cached
+        prefix that still leaves a bucket fitting inside the slot, and
+        pick that bucket. Pure host work — no device call. The caller owns
+        the plan: feed it to :meth:`admit_batch` or return the pin with
+        :meth:`cancel_plan`."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.validate_request(len(prompt), 1)
+        match = None
+        if self.prefix_cache is not None:
+            max_blocks = self._n_prog_blocks
+            while True:
+                match = (self.prefix_cache.match(prompt, max_blocks)
+                         if max_blocks > 0 else None)
+                if match is None:
+                    break
+                if self.bucket_for(len(prompt) - match.length,
+                                   match.length) is not None:
+                    break
+                # a max-length match can leave no room for a bucket inside
+                # cache_len — shrink and retry (rare: near-capacity slots)
+                max_blocks = len(match.nodes) - 1
+                self.prefix_cache.release(match)
+        start = match.length if match is not None else 0
+        bucket = self.bucket_for(len(prompt) - start, start)
+        assert bucket is not None  # start=0 always fits (validate_request)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return AdmitPlan(prompt=prompt, rng=rng, match=match, start=start,
+                         bucket=bucket)
+
+    def cancel_plan(self, plan: AdmitPlan) -> None:
+        """Discard an unused plan, unpinning its prefix match."""
+        if plan.match is not None and self.prefix_cache is not None:
+            self.prefix_cache.release(plan.match)
 
     # ------------------------------------------------------------------ #
     # slot API (host side)                                                #
@@ -311,37 +621,237 @@ class ServingEngine:
                 f"cache_len={self.cache_len}"
             )
 
+    def warmup(self) -> None:
+        """Compile every device program once, on dummy no-op inputs (all
+        rows inactive — semantically identity; the garbage K/V rows they
+        write are covered by the stale-rows masking argument). After this,
+        NOTHING recompiles: the zero-recompile invariant holds across
+        every bucket, the decode step, and both prefix-copy programs —
+        asserted by tests and carried live by the ``RecompileGuard``."""
+        if self._warm:
+            return
+        if self.active_slots:
+            raise RuntimeError("warmup needs an idle engine")
+        k = self.prefill_batch
+        zeros_i = jnp.zeros((k,), jnp.int32)
+        extra = ()
+        if self.prefix_cache is not None:
+            extra = (self._store,
+                     jnp.zeros((k, self._n_prog_blocks), jnp.int32))
+        for b in self.prefill_buckets:
+            with self._watched(f"serving warmup prefill[{b}]"):
+                self.caches, _, _ = self._prefill_fns[b](
+                    self.params, self.caches,
+                    jnp.zeros((k, b), jnp.int32), zeros_i, zeros_i,
+                    zeros_i, jnp.zeros((k,), bool),
+                    jnp.zeros((k, 2), jnp.uint32), *extra)
+        with self._watched("serving warmup decode"):
+            self.caches, _, _ = self._decode_fn(
+                self.params, self.caches, jnp.asarray(self._token),
+                jnp.asarray(self._pos), jnp.asarray(self._active),
+                self._keys)
+        if self.prefix_cache is not None:
+            ids = jnp.zeros((self._n_prog_blocks,), jnp.int32)
+            with self._watched("serving warmup prefix"):
+                self._store = self._insert_fn(self._store, self.caches,
+                                              jnp.int32(0), ids, ids,
+                                              jnp.int32(0))
+        self._warm = True
+        self._guard.check()
+        self._events.emit("serving_warmup",
+                          buckets=list(self.prefill_buckets),
+                          prefill_batch=k,
+                          prefix=self.prefix_cache is not None)
+
     def prefill(self, prompt: np.ndarray, rng) -> tuple[int, int]:
-        """Admit one prompt into a free slot: runs the compiled prefill,
+        """Admit one prompt into a free slot (no prefix reuse — the PR-1
+        surface): runs the smallest covering bucket's compiled prefill,
         returns ``(slot, first_token)``. ``rng`` is the request's own PRNG
         key (its sampler split sequence matches a solo ``generate()``).
         Raises ``RuntimeError`` when no slot is free — admission control
         is the scheduler's job, not a silent queue here."""
-        if not self.free_slots:
-            raise RuntimeError("no free slot (scheduler admitted too many)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.validate_request(len(prompt), 1)
-        slot = min(self.free_slots)  # deterministic pick: stable tests/replay
-        padded = np.zeros((1, self.prefill_len), np.int32)
-        padded[0, : len(prompt)] = prompt
-        with self._watched("serving prefill"), \
-                annotate("chainermn.serving_prefill"):
-            # fault cut-point INSIDE the watchdog window: an injected hang
-            # here exercises exactly the wedge hang detection exists for
-            inject("serving.prefill", slot=slot, prompt_len=len(prompt))
-            self.caches, first, key = self._prefill_fn(
-                self.params, self.caches, jnp.asarray(padded),
-                jnp.int32(slot), jnp.int32(len(prompt)), rng)
-            first = int(first)
-        self.free_slots.discard(slot)
-        self._token[slot] = first
-        self._pos[slot] = len(prompt)
-        self._active[slot] = True
-        self._keys = self._keys.at[slot].set(key)
-        self._c_prefills.inc()
-        self._events.emit("prefill", slot=slot, prompt_len=len(prompt))
+        bucket = self.bucket_for(len(prompt))
+        plan = AdmitPlan(prompt=prompt, rng=rng, match=None, start=0,
+                         bucket=bucket)
+        return self.admit_batch([plan], point="serving.prefill")[0]
+
+    def admit_batch(self, plans: Sequence[AdmitPlan], *,
+                    point: str = "serving.prefill_batch"
+                    ) -> list[tuple[int, int]]:
+        """Admit a same-bucket group in ONE batched prefill call (plus one
+        prefix-fetch copy per cached member, before): returns ``[(slot,
+        first_token), ...]`` in plan order. Slot mirrors commit only after
+        the device calls succeed, so a raise BEFORE device execution (the
+        fault cut-points) leaves the engine intact — the scheduler then
+        errors only this group. A failure that consumed the donated cache
+        buffers re-raises as :class:`EngineStateError` (full restart).
+
+        After commit, each member's full prompt blocks are inserted into
+        the prefix trie (best effort — an insert failure never un-admits
+        a request; a store-corrupting one resets the prefix cache)."""
+        if not plans:
+            return []
+        if len(plans) > self.prefill_batch:
+            raise ValueError(
+                f"group of {len(plans)} exceeds prefill_batch="
+                f"{self.prefill_batch}"
+            )
+        if len(plans) > len(self.free_slots):
+            raise RuntimeError("no free slot (scheduler admitted too many)")
+        buckets = {p.bucket for p in plans}
+        if len(buckets) != 1:
+            raise ValueError(
+                f"admission group mixes buckets {sorted(buckets)} — one "
+                "compiled program per call"
+            )
+        bucket = plans[0].bucket
+        k = self.prefill_batch
+        if self._pending_inserts:
+            self.flush_inserts()   # before slots are picked: never insert
+        slots = sorted(self.free_slots)[:len(plans)]  # deterministic pick
+        n_cached = sum(p.match is not None for p in plans)
+        try:
+            try:
+                with self._watched("serving prefill"), \
+                        annotate("chainermn.serving_prefill"):
+                    if n_cached:
+                        inject("serving.prefix_copy", op="fetch",
+                               hits=n_cached, batch=len(plans))
+                    # fault cut-point INSIDE the watchdog window: an
+                    # injected hang here exercises exactly the wedge hang
+                    # detection exists for
+                    inject(point, batch=len(plans), bucket=bucket,
+                           slots=slots)
+                    tokens = np.zeros((k, bucket), np.int32)
+                    starts = np.zeros((k,), np.int32)
+                    last = np.zeros((k,), np.int32)
+                    active = np.zeros((k,), bool)
+                    slot_ids = np.zeros((k,), np.int32)
+                    keys = [jnp.zeros((2,), jnp.uint32)] * k
+                    extra = ()
+                    if self.prefix_cache is not None:
+                        fetch_ids = np.zeros((k, self._n_prog_blocks),
+                                             np.int32)
+                    for i, (plan, slot) in enumerate(zip(plans, slots)):
+                        suffix = plan.prompt[plan.start:]
+                        tokens[i, : len(suffix)] = suffix
+                        starts[i] = plan.start
+                        last[i] = len(suffix) - 1
+                        active[i] = True
+                        slot_ids[i] = slot
+                        keys[i] = plan.rng
+                        if plan.match is not None:
+                            fetch_ids[i, : len(plan.match.block_ids)] = \
+                                plan.match.block_ids
+                    if self.prefix_cache is not None:
+                        extra = (self._store, jnp.asarray(fetch_ids))
+                    self.caches, firsts, keys_out = self._prefill_fns[bucket](
+                        self.params, self.caches, jnp.asarray(tokens),
+                        jnp.asarray(slot_ids), jnp.asarray(starts),
+                        jnp.asarray(last), jnp.asarray(active),
+                        jnp.stack(keys), *extra)
+                    firsts = np.asarray(firsts)
+            except Exception as e:
+                if not self._state_ok():
+                    raise EngineStateError(
+                        f"admission failed mid-device-call "
+                        f"({type(e).__name__}: {e}); donated cache buffers "
+                        "are gone — restart required"
+                    ) from e
+                raise
+        finally:
+            for plan in plans:
+                self.cancel_plan(plan)   # pins served their purpose
+        out = []
+        for i, (plan, slot) in enumerate(zip(plans, slots)):
+            first = int(firsts[i])
+            self.free_slots.discard(slot)
+            self._token[slot] = first
+            self._pos[slot] = len(plan.prompt)
+            self._active[slot] = True
+            self._keys = self._keys.at[slot].set(keys_out[i])
+            self._c_prefills[bucket].inc()
+            self._events.emit("prefill", slot=slot,
+                              prompt_len=len(plan.prompt), bucket=bucket,
+                              cached=plan.start, batch=len(plans))
+            out.append((slot, first))
+            if self.prefix_cache is not None:
+                self._pending_inserts.append((plan.prompt, slot))
         self._guard.check()
-        return slot, first
+        return out
+
+    def flush_inserts(self) -> None:
+        """Run the deferred trie inserts (one compiled copy per prompt
+        with new full blocks). Deferral keeps the insert copies off the
+        TTFT-critical admission path; the scheduler flushes at the end of
+        every step and :meth:`admit_batch` flushes defensively before
+        picking slots, so a donor's rows are always copied out before its
+        slot can be reused by a later tenant."""
+        pending, self._pending_inserts = self._pending_inserts, []
+        for prompt, slot in pending:
+            self._insert_prefix(prompt, slot)
+
+    def _insert_prefix(self, prompt: np.ndarray, slot: int) -> None:
+        """Cache a freshly-prefilled prompt's full blocks (best effort:
+        never fails the admitted request; a store-corrupting failure
+        resets the prefix cache to a consistent empty state)."""
+        if self.prefix_cache.missing_blocks(prompt) < self._min_insert:
+            return
+        plan = self.prefix_cache.plan_insert(prompt)
+        if plan is None:
+            return
+        try:
+            inject("serving.prefix_copy", op="insert", slot=slot,
+                   blocks=len(plan.block_ids))
+            ids = np.zeros((self._n_prog_blocks,), np.int32)
+            ids[: len(plan.block_ids)] = plan.block_ids
+            rows = np.zeros((self._n_prog_blocks,), np.int32)
+            rows[: len(plan.row_starts)] = plan.row_starts
+            with self._watched("serving prefix insert"), \
+                    annotate("chainermn.serving_prefix_copy"):
+                self._store = self._insert_fn(
+                    self._store, self.caches, jnp.int32(slot),
+                    jnp.asarray(ids), jnp.asarray(rows),
+                    jnp.int32(len(plan.block_ids)))
+            self.prefix_cache.commit_insert(plan)
+            self._guard.check()
+        except Exception as e:  # noqa: BLE001 — insertion is best-effort
+            self.prefix_cache.abort_insert(plan)
+            if not self._state_ok():
+                self._reset_prefix()
+            self._events.emit("prefix_insert_error",
+                              error=type(e).__name__, detail=str(e)[:200])
+
+    def _state_ok(self) -> bool:
+        """True when the donated device buffers are still alive (an
+        exception fired BEFORE the device call consumed them) — the
+        scheduler's containment test: intact state means only the group
+        being admitted failed, everything decoding is untouched."""
+        try:
+            leaves = jax.tree_util.tree_leaves(self.caches)
+            if self.prefix_cache is not None:
+                leaves += jax.tree_util.tree_leaves(self._store)
+            return not any(leaf.is_deleted() for leaf in leaves)
+        except Exception:  # noqa: BLE001 — can't tell: assume the worst
+            return False
+
+    def _reset_prefix(self) -> None:
+        """Fresh (empty) prefix store + cleared trie, together — a trie
+        naming blocks of a dead store would hand out KV that no longer
+        exists (same shapes/shardings: nothing recompiles)."""
+        if self.prefix_cache is None:
+            return
+        if self.model.tensor_axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = self.model.tensor_axis
+            shard = NamedSharding(self._comm.mesh, P(None, None, axis))
+            self._store = jax.device_put(self._init_store(), shard)
+        else:
+            self._store = self._init_store()
+        self.prefix_cache.clear()
 
     def decode_step(self) -> dict[int, int]:
         """Advance every active slot one token (ONE compiled call for the
@@ -387,22 +897,31 @@ class ServingEngine:
         self.free_slots.add(slot)
 
     def restart(self) -> None:
-        """Warm restart after an engine-side failure: fresh KV caches and
-        cleared host slot mirrors, SAME compiled programs (the new arrays
-        have identical shapes/shardings, so nothing recompiles — pinned by
-        the restart test). Needed because a failed call may have consumed
-        the donated cache buffers; params are never donated and survive.
-        The scheduler drives this from its exception boundary; every
-        restart is a counted, event-logged recovery."""
+        """Warm restart after an engine-side failure: fresh KV caches,
+        cleared host slot mirrors, AND a fresh prefix store + emptied trie
+        — all rebuilt together, with the SAME compiled programs (the new
+        arrays have identical shapes/shardings, so nothing recompiles —
+        pinned by the restart tests). The prefix index must reset with the
+        store: a warm restart keeping a stale trie would "hit" on KV
+        blocks that no longer exist and serve a new request another
+        prompt's attention state. Needed because a failed call may have
+        consumed the donated cache buffers; params are never donated and
+        survive. The scheduler drives this from its exception boundary;
+        every restart is a counted, event-logged recovery."""
         if self.model.tensor_axis is not None:
             self._init_tp_caches(self._comm)
         else:
             self.caches = init_kv_caches(self.model, self.n_slots,
                                          self.cache_len)
+            if self.prefix_cache is not None:
+                self._store = self._init_store()
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+        self._pending_inserts = []
         self._token[:] = 0
         self._pos[:] = 0
         self._active[:] = False
-        self._keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
+        self._keys = self._fresh_keys()
         self.free_slots = set(range(self.n_slots))
         self._c_restarts.inc()
         self._events.emit("engine_restart")
@@ -412,13 +931,36 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
 
     def compile_counts(self) -> dict[str, int]:
-        """Executable counts of the two device programs — the
-        zero-recompile invariant is ``{'prefill': 1, 'decode': 1}`` after
-        warmup, asserted by tests and reported by the serving benchmark."""
+        """Executable counts of the prefill family (summed over buckets)
+        and the decode program — the zero-recompile invariant is
+        ``{'prefill': len(buckets), 'decode': 1}`` after warmup, asserted
+        by tests and reported by the serving benchmark."""
         return {
-            "prefill": int(self._prefill_fn._cache_size()),
+            "prefill": sum(int(fn._cache_size())
+                           for fn in self._prefill_fns.values()),
             "decode": int(self._decode_fn._cache_size()),
         }
 
+    def compile_counts_detailed(self) -> dict[str, int]:
+        """Per-program executable counts (every bucket + decode + the
+        prefix-copy pair) — each must be exactly 1 after :meth:`warmup`."""
+        out = {f"prefill_{b}": int(fn._cache_size())
+               for b, fn in self._prefill_fns.items()}
+        out["decode"] = int(self._decode_fn._cache_size())
+        if self.prefix_cache is not None:
+            out["prefix_insert"] = int(self._insert_fn._cache_size())
+        return out
 
-__all__ = ["ServingEngine"]
+    @property
+    def recompiles(self) -> dict[str, int]:
+        """Recompiles observed past each program's warmup compile (the
+        guard's live count; empty == the invariant holds)."""
+        return self._guard.recompiles
+
+    def prefix_stats(self) -> dict:
+        """The prefix cache's hit/eviction/occupancy numbers (empty dict
+        when disabled) — embedded in the serving bench record."""
+        return self.prefix_cache.stats() if self.prefix_cache else {}
+
+
+__all__ = ["AdmitPlan", "EngineStateError", "ServingEngine"]

@@ -83,6 +83,12 @@ class ServingMetrics:
         self._h_tpot = reg.histogram("serving_tpot_seconds", labels, unit="s")
         self._h_queue = reg.histogram("serving_queue_depth", labels)
         self._h_occ = reg.histogram("serving_slot_occupancy", labels)
+        # admission fast path (PR 5): how full each batched prefill call
+        # ran, and what fraction of each admitted prompt the prefix cache
+        # covered (0.0 on a miss — so the mean IS the amortized discount,
+        # and the >0 fraction is the hit rate)
+        self._h_batch = reg.histogram("prefill_batch_size", labels)
+        self._h_cached = reg.histogram("cached_prefix_frac", labels)
         self._g_queue = reg.gauge("serving_queue_depth_now", labels)
         self._g_active = reg.gauge("serving_active_slots", labels)
         self._t_first_token: Optional[float] = None
@@ -96,15 +102,26 @@ class ServingMetrics:
         self._c_submitted.inc()
 
     def record_first_token(self, t_submit: float, t_token: float,
-                           req_id: Optional[int] = None) -> None:
+                           req_id: Optional[int] = None,
+                           cached_frac: Optional[float] = None) -> None:
         ttft = t_token - t_submit
         self._h_ttft.observe(ttft)
         self._record_token_time(t_token)
         self._c_tokens.inc()
+        if cached_frac is not None:
+            self._h_cached.observe(cached_frac)
         # the flight-recorder hook: a TTFT outlier names its request, so
-        # it can be joined against the surrounding slot_admit events
+        # it can be joined against the surrounding slot_admit events (and
+        # its cached fraction says whether the prefix cache helped it)
         self._events.emit("first_token", req=req_id,
-                          ttft_s=round(ttft, 6))
+                          ttft_s=round(ttft, 6),
+                          **({} if cached_frac is None
+                             else {"cached_frac": round(cached_frac, 4)}))
+
+    def record_admission(self, batch_size: int) -> None:
+        """One admission device call admitted ``batch_size`` requests —
+        the batched-prefill occupancy series."""
+        self._h_batch.observe(batch_size)
 
     def record_token(self, t_prev_token: float, t_token: float) -> None:
         self._h_tpot.observe(t_token - t_prev_token)
@@ -198,6 +215,16 @@ class ServingMetrics:
         }
         out.update(latency_report(self._h_ttft.samples, "ttft"))
         out.update(latency_report(self._h_tpot.samples, "tpot"))
+        cached = self._h_cached.samples
+        if cached:
+            t = np.asarray(cached, np.float64)
+            out["cached_prefix_frac_mean"] = round(float(t.mean()), 4)
+            out["prefix_hit_rate"] = round(float((t > 0).mean()), 4)
+        batch = self._h_batch.samples
+        if batch:
+            t = np.asarray(batch, np.float64)
+            out["prefill_batch_size_mean"] = round(float(t.mean()), 3)
+            out["prefill_batch_size_max"] = int(t.max())
         for hist, prefix in ((self._h_queue, "queue_depth"),
                              (self._h_occ, "slot_occupancy")):
             samples = hist.samples

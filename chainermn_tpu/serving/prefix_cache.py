@@ -1,0 +1,325 @@
+"""Host-side ref-counted prefix index for KV reuse across requests.
+
+Continuous batching (PR 1) made *decode* cheap — one compiled call advances
+every slot — but admission still pays one full-length prefill per request,
+even when ten queued prompts share the same system-prompt prefix. This
+module is the host half of closing that gap (the vLLM/SGLang-style prefix
+cache): a trie over fixed-size token *blocks* whose nodes own block slots
+in a device-side KV store (the engine's ``[n_blocks, block_size, heads,
+d_head]`` buffers per layer). On admission the scheduler asks for the
+longest cached prefix; the engine copies the matched blocks slot-locally
+with a compiled-once gather program and prefills only the uncached suffix.
+
+Design points:
+
+- **Block granularity.** A node caches exactly ``block_size`` tokens, so
+  matches are multiples of ``block_size`` and the device copy programs have
+  static shapes (one executable each, ever). A prompt inserts only its
+  *full* blocks; the ragged tail is never cached.
+- **Ref-counting.** ``match`` pins the matched chain (tail refcount +1)
+  until the engine has copied the blocks into the request's slot
+  (``release``); ``plan_insert`` pins the attachment point until the copy
+  commits or aborts. Eviction only ever takes *leaf* nodes with refcount
+  zero, so a pinned tail protects its whole chain (ancestors have
+  children) and an in-flight copy can never read a reused block.
+- **LRU eviction.** When an insert needs more blocks than are free, the
+  least-recently-used ref-zero leaves are evicted (hits refresh the whole
+  matched path). Partial allocations are fine — caching a prompt's first
+  few blocks is still useful.
+- **Correctness rides on the engine's masking argument.** The copy
+  programs move whole padded block spans; rows past the real prefix are
+  garbage the causal position mask hides until the tenant's own
+  prefill/decode overwrites them (see ``engine.py``'s module docstring).
+  Token parity vs solo ``generate()`` is pinned in
+  ``tests/serving_tests/test_prefix_cache.py``.
+
+This module is **pure host state** (numpy + the monitor spine; no jax):
+the trie, the block free-list, and the hit/eviction telemetry. The device
+store and its copy programs live in :class:`~chainermn_tpu.serving.engine.
+ServingEngine`, which drives this index through ``match`` / ``release`` /
+``plan_insert`` / ``commit_insert`` / ``abort_insert`` from the single
+scheduler thread (this class is intentionally not thread-safe).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+
+
+class _Node:
+    """One cached block: ``block_size`` tokens -> one device store block."""
+
+    __slots__ = ("key", "block", "parent", "children", "refs", "last_use")
+
+    def __init__(self, key, block, parent):
+        self.key = key            # tuple of block_size token ints
+        self.block = block        # index into the device block store
+        self.parent = parent
+        self.children: dict = {}
+        self.refs = 0             # active matches/insert-plans pinning here
+        self.last_use = 0
+
+
+@dataclass
+class PrefixMatch:
+    """A pinned longest-cached-prefix result. ``length`` tokens
+    (= ``len(block_ids) * block_size``) of the prompt are covered by
+    ``block_ids`` in the device store; the holder must ``release()`` it
+    back to the index once the blocks have been copied slot-locally."""
+
+    nodes: list
+    length: int
+    block_ids: list
+    released: bool = False
+
+
+@dataclass
+class InsertPlan:
+    """Blocks allocated for a pending insert (device copy not yet done).
+    ``start_block`` is the first NEW block's index within the prompt —
+    blocks before it were already cached; ``row_starts`` are the matching
+    slot-cache row offsets the engine's insert program copies from.
+    ``commit`` links the nodes; ``abort`` returns the blocks to the free
+    list."""
+
+    parent: object
+    keys: list
+    block_ids: list
+    start_block: int
+    row_starts: list = field(default_factory=list)
+    closed: bool = False
+
+
+class PrefixCacheIndex:
+    """Ref-counted trie over token blocks mapping prefixes to device KV
+    block ids (module docstring). Drive from ONE thread (the scheduler's).
+
+    Parameters
+    ----------
+    n_blocks : total block slots in the device store (capacity).
+    block_size : tokens per block; matches/inserts are multiples of this.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int) -> None:
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self._root = _Node(None, -1, None)
+        self._free = list(range(self.n_blocks - 1, -1, -1))  # pop() -> 0, 1, ...
+        self._clock = itertools.count(1)
+        self._events = get_event_log()
+        reg = get_registry()
+        self._c_hits = reg.counter("prefix_cache_hits_total")
+        self._c_misses = reg.counter("prefix_cache_misses_total")
+        self._c_evictions = reg.counter("prefix_cache_evictions_total")
+        self._c_inserted = reg.counter("prefix_cache_inserted_blocks_total")
+        # per-instance stats (the registry counters are process-cumulative;
+        # tests and bench want THIS cache's numbers)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.inserted_blocks = 0
+
+    # ------------------------------------------------------------------ #
+    # lookup                                                              #
+    # ------------------------------------------------------------------ #
+
+    def _key(self, tokens: np.ndarray, i: int) -> tuple:
+        bs = self.block_size
+        return tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+
+    def match(self, tokens, max_blocks: Optional[int] = None
+              ) -> Optional[PrefixMatch]:
+        """Longest cached prefix of ``tokens``, pinned; ``None`` on miss.
+
+        The match never covers the whole prompt (at most
+        ``(len - 1) // block_size`` blocks): at least one real token must
+        remain for the suffix prefill to produce the first sampled token's
+        logits — the same trick vLLM uses. ``max_blocks`` caps further
+        (the engine shrinks matches that would not leave room for a
+        prefill bucket inside ``cache_len``)."""
+        tokens = np.asarray(tokens).reshape(-1)
+        cap = (len(tokens) - 1) // self.block_size
+        if max_blocks is not None:
+            cap = min(cap, max_blocks)
+        node, nodes = self._root, []
+        for i in range(cap):
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+        if not nodes:
+            self.misses += 1
+            self._c_misses.inc()
+            return None
+        nodes[-1].refs += 1
+        t = next(self._clock)
+        for nd in nodes:
+            nd.last_use = t
+        self.hits += 1
+        self._c_hits.inc()
+        return PrefixMatch(nodes=nodes,
+                           length=len(nodes) * self.block_size,
+                           block_ids=[nd.block for nd in nodes])
+
+    def missing_blocks(self, tokens) -> int:
+        """How many of ``tokens``' full blocks are NOT yet cached — the
+        engine's insert cost/benefit probe (no allocation, no pinning, no
+        LRU touch)."""
+        tokens = np.asarray(tokens).reshape(-1)
+        total = len(tokens) // self.block_size
+        node, i = self._root, 0
+        while i < total:
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            node, i = child, i + 1
+        return total - i
+
+    def release(self, match: PrefixMatch) -> None:
+        """Unpin a match (idempotent) — its blocks become evictable again
+        once no other holder pins them."""
+        if match is None or match.released:
+            return
+        match.released = True
+        match.nodes[-1].refs -= 1
+
+    # ------------------------------------------------------------------ #
+    # insertion                                                           #
+    # ------------------------------------------------------------------ #
+
+    def plan_insert(self, tokens) -> Optional[InsertPlan]:
+        """Allocate blocks for the not-yet-cached full blocks of
+        ``tokens`` (evicting LRU ref-zero leaves as needed) and pin the
+        attachment node. Returns ``None`` when nothing new would be cached
+        (already present, no full block, or zero blocks allocatable). The
+        caller copies KV device-side then ``commit_insert``s (or
+        ``abort_insert``s on failure)."""
+        tokens = np.asarray(tokens).reshape(-1)
+        bs = self.block_size
+        total = len(tokens) // bs
+        node, i = self._root, 0
+        t = next(self._clock)
+        while i < total:
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            child.last_use = t
+            node, i = child, i + 1
+        if i >= total:
+            return None
+        node.refs += 1                    # pin the attachment point
+        blocks = self._alloc(total - i)
+        if not blocks:
+            node.refs -= 1
+            return None
+        return InsertPlan(
+            parent=node,
+            keys=[self._key(tokens, i + j) for j in range(len(blocks))],
+            block_ids=blocks, start_block=i,
+            row_starts=[(i + j) * bs for j in range(len(blocks))],
+        )
+
+    def commit_insert(self, plan: InsertPlan) -> None:
+        if plan.closed:
+            return
+        plan.closed = True
+        node = plan.parent
+        node.refs -= 1
+        t = next(self._clock)
+        for key, block in zip(plan.keys, plan.block_ids):
+            child = _Node(key, block, node)
+            child.last_use = t
+            node.children[key] = child
+            node = child
+        n = len(plan.block_ids)
+        self.inserted_blocks += n
+        self._c_inserted.inc(n)
+        self._events.emit("prefix_insert", blocks=n,
+                          depth=plan.start_block + n,
+                          used=self.used_blocks)
+
+    def abort_insert(self, plan: InsertPlan) -> None:
+        if plan.closed:
+            return
+        plan.closed = True
+        plan.parent.refs -= 1
+        self._free.extend(plan.block_ids)
+
+    # ------------------------------------------------------------------ #
+    # eviction / capacity                                                 #
+    # ------------------------------------------------------------------ #
+
+    def _evictable(self):
+        """All ref-zero leaves (iterative walk; the store is small)."""
+        out, stack = [], [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node is not self._root and not node.children and not node.refs:
+                out.append(node)
+        return out
+
+    def _alloc(self, n: int) -> list:
+        out = []
+        while len(out) < n:
+            if self._free:
+                out.append(self._free.pop())
+                continue
+            victims = self._evictable()
+            if not victims:
+                break                      # partial allocation is fine
+            victim = min(victims, key=lambda nd: nd.last_use)
+            del victim.parent.children[victim.key]
+            self._free.append(victim.block)
+            self.evictions += 1
+            self._c_evictions.inc()
+            self._events.emit("prefix_evict", block=victim.block,
+                              age=victim.last_use)
+        return out
+
+    def clear(self) -> None:
+        """Drop every cached prefix and free every block — the engine
+        calls this from ``restart()`` together with rebuilding the device
+        store, because a trie naming blocks of a discarded store would
+        hand out KV that no longer exists."""
+        self._root = _Node(None, -1, None)
+        self._free = list(range(self.n_blocks - 1, -1, -1))
+
+    # ------------------------------------------------------------------ #
+    # stats                                                               #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def used_blocks(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hit_rate, 4),
+            "evictions": self.evictions,
+            "inserted_blocks": self.inserted_blocks,
+            "used_blocks": self.used_blocks,
+            "n_blocks": self.n_blocks,
+            "block_size": self.block_size,
+        }
+
+
+__all__ = ["InsertPlan", "PrefixCacheIndex", "PrefixMatch"]

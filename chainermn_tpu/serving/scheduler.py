@@ -7,14 +7,26 @@ first-come-first-served queue whose requests move through
     QUEUED -> PREFILL -> DECODE -> DONE      (or CANCELLED, or ERRORED)
 
 One :meth:`FCFSScheduler.step` is one engine round: shed expired QUEUED
-requests, fill every freed slot from the queue (one prefill each —
-prefill interleaves with decode at step granularity, the classic
-continuous-batching schedule), advance all active slots one token,
-deliver tokens to per-request streams, and retire slots whose request hit
-EOS or its token budget. Retirement frees the slot for the NEXT step's
-admissions, so the pool refills without ever waiting for the whole batch
-to finish — the property that separates this from the offline
-``generate()`` path.
+requests, fill freed slots from the queue (prefill interleaves with
+decode at step granularity, the classic continuous-batching schedule),
+advance all active slots one token, deliver tokens to per-request
+streams, and retire slots whose request hit EOS or its token budget.
+Retirement frees the slot for the NEXT step's admissions, so the pool
+refills without ever waiting for the whole batch to finish — the property
+that separates this from the offline ``generate()`` path.
+
+Cost-aware admission (the PR-5 fast path): when the engine has batched
+prefill, a bucket ladder, or the prefix cache enabled, admissions are
+built as **groups** — the head of the queue anchors a group, the queue is
+scanned for companions whose (prefix-discounted) padded suffix lands in
+the SAME bucket, companions sharing the head's cached prefix are
+preferred, and the whole group admits in ONE batched device call
+(:meth:`ServingEngine.admit_batch`: per-member prefix fetch + one bucket
+prefill). Decode stall is bounded: at most ``max_prefills_per_step``
+prefill calls interleave per decode step (default 1 in cost-aware mode;
+unbounded in the legacy single-request configuration, whose behavior —
+including the ``serving.prefill`` fault cut-point and per-request retry —
+is preserved exactly).
 
 Graceful degradation (the resilience layer):
 
@@ -63,6 +75,7 @@ import numpy as np
 from chainermn_tpu.monitor import annotate
 from chainermn_tpu.monitor._state import get_event_log
 from chainermn_tpu.resilience.retry import RetryPolicy
+from chainermn_tpu.serving.engine import EngineStateError
 from chainermn_tpu.serving.metrics import ServingMetrics
 
 
@@ -174,7 +187,8 @@ class FCFSScheduler:
                  default_deadline_s: Optional[float] = None,
                  retry: Optional[RetryPolicy] = None,
                  restart_on_error: bool = True,
-                 max_restarts: int = 8) -> None:
+                 max_restarts: int = 8,
+                 max_prefills_per_step: Optional[int] = None) -> None:
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.engine = engine
@@ -186,6 +200,16 @@ class FCFSScheduler:
         self._restart_on_error = restart_on_error
         self._max_restarts = int(max_restarts)
         self._restarts = 0
+        # cost-aware mode: batched admission groups + bounded prefill
+        # interleave. Auto-on when the engine has any of the fast-path
+        # features; the legacy single-request configuration keeps filling
+        # the whole pool per step (unbounded), exactly as before.
+        self._cost_aware = (engine.prefill_batch > 1
+                            or len(engine.prefill_buckets) > 1
+                            or engine.prefix_enabled)
+        if max_prefills_per_step is None:
+            max_prefills_per_step = 1 if self._cost_aware else None
+        self._max_prefills = max_prefills_per_step
         self._events = get_event_log()
         self._queue: deque[Request] = deque()
         self._by_slot: dict[int, Request] = {}
@@ -276,42 +300,18 @@ class FCFSScheduler:
         decode step, so a retirement's slot never sits idle for a step."""
         emitted = 0
         self._shed_expired()
-        # 1. admission: one prefill per free slot, FCFS
+        # 1. admission: one group (>= 1 same-bucket requests, one device
+        # call) per iteration, FCFS-anchored; bounded prefill interleave
+        # in cost-aware mode so a deep queue can't stall decode
         with annotate("chainermn.serving_admit"):
-            while self.engine.free_slots:
-                with self._lock:
-                    if not self._queue:
-                        break
-                    req = self._queue.popleft()
-                    req.state = RequestState.PREFILL
-                try:
-                    if self._retry is not None:
-                        slot, first = self._retry.call(
-                            self.engine.prefill, req.prompt, req.rng,
-                            op="serving.prefill")
-                    else:
-                        slot, first = self.engine.prefill(req.prompt, req.rng)
-                except Exception as e:  # noqa: BLE001 — degradation boundary
-                    if not self._engine_failure(e, admitting=req):
-                        raise
-                    continue  # engine restarted: keep admitting the queue
-                now = time.perf_counter()
-                with self._lock:
-                    if req.state is RequestState.CANCELLED:
-                        # cancelled while its prefill was in flight (it had
-                        # no slot yet, so cancel() left the release to us)
-                        self.engine.release(slot)
-                        continue
-                    req.slot = slot
-                    self._by_slot[slot] = req
-                    req.state = RequestState.DECODE
-                self._events.emit("slot_admit", req=req.id, slot=slot,
-                                  prompt_len=len(req.prompt),
-                                  queue_depth=self.queue_depth)
-                self.metrics.record_first_token(req.t_submit, now,
-                                                req_id=req.id)
-                self._deliver(req, first, now)
-                emitted += 1
+            calls = 0
+            while self.engine.free_slots and (
+                    self._max_prefills is None or calls < self._max_prefills):
+                group = self._next_group()
+                if not group:
+                    break
+                calls += 1
+                emitted += self._admit_group(group)
         # 2. decode: every active slot, one token, one compiled call
         try:
             decoded = self.engine.decode_step()
@@ -327,6 +327,10 @@ class FCFSScheduler:
             self.metrics.record_token(req.t_last_token, now)
             self._deliver(req, tok, now)
             emitted += 1
+        # deferred prefix-cache inserts run AFTER this step's tokens were
+        # delivered (off the TTFT path) and before the next step can
+        # reuse a donor slot
+        self.engine.flush_inserts()
         self.metrics.record_step(self.queue_depth, self.engine.active_slots)
         return emitted
 
@@ -342,6 +346,136 @@ class FCFSScheduler:
             if max_steps is not None and steps >= max_steps:
                 break
         return total
+
+    # ------------------------------------------------------------------ #
+    # admission internals                                                 #
+    # ------------------------------------------------------------------ #
+
+    def _next_group(self) -> list:
+        """Pop the next admission group: the queue head anchors it (FCFS —
+        no starvation), then companions whose (prefix-discounted) padded
+        suffix lands in the SAME bucket join, companions sharing the
+        head's cached prefix first, until the group hits the engine's
+        ``prefill_batch`` or the free-slot count. Returns ``[(req, plan),
+        ...]``; every selected request is moved to PREFILL, every
+        unselected candidate's plan is cancelled (match unpinned)."""
+        eng = self.engine
+        cap = min(eng.prefill_batch, len(eng.free_slots))
+        with self._lock:
+            if not self._queue:
+                return []
+            head = self._queue.popleft()
+            head.state = RequestState.PREFILL
+        plan = eng.plan_admission(head.prompt, head.rng)
+        group = [(head, plan)]
+        if cap <= 1:
+            return group
+        with self._lock:
+            candidates = list(self._queue)
+        scored = []
+        for idx, req in enumerate(candidates):
+            p = eng.plan_admission(req.prompt, req.rng)
+            if p.bucket != plan.bucket:
+                eng.cancel_plan(p)
+                continue
+            shares = (plan.match is not None and p.match is not None
+                      and p.match.nodes[0] is plan.match.nodes[0])
+            scored.append((0 if shares else 1, idx, req, p))
+        scored.sort(key=lambda t: (t[0], t[1]))
+        for rank, (_, _, req, p) in enumerate(scored):
+            if rank < cap - 1:
+                with self._lock:
+                    try:
+                        self._queue.remove(req)   # lost a cancel() race?
+                    except ValueError:
+                        eng.cancel_plan(p)
+                        continue
+                    req.state = RequestState.PREFILL
+                group.append((req, p))
+            else:
+                eng.cancel_plan(p)
+        return group
+
+    def _admit_group(self, group: list) -> int:
+        """Drive one group through the engine (legacy single-request path
+        when nothing batched/cached is in play — preserving the PR-1
+        ``serving.prefill`` cut-point and retry semantics exactly), then
+        commit each member. Returns first tokens emitted."""
+        reqs = [r for r, _ in group]
+        plans = [p for _, p in group]
+        legacy = (len(group) == 1 and plans[0].match is None
+                  and not self.engine.prefix_enabled)
+        try:
+            if legacy:
+                self.engine.cancel_plan(plans[0])
+                req = reqs[0]
+                if self._retry is not None:
+                    results = [self._retry.call(
+                        self.engine.prefill, req.prompt, req.rng,
+                        op="serving.prefill")]
+                else:
+                    results = [self.engine.prefill(req.prompt, req.rng)]
+            else:
+                if self._retry is not None:
+                    results = self._retry.call(
+                        self.engine.admit_batch, plans,
+                        op="serving.prefill_batch")
+                else:
+                    results = self.engine.admit_batch(plans)
+        except Exception as e:  # noqa: BLE001 — degradation boundary
+            if not legacy and not isinstance(e, EngineStateError):
+                # the device state is intact (admit_batch re-raises as
+                # EngineStateError when a failure consumed its donated
+                # buffers): only this group is lost — error its members,
+                # every decoding slot keeps decoding, no restart burned
+                self._fail_group(reqs, e)
+                return 0
+            if not self._engine_failure(e, admitting=reqs):
+                raise
+            return 0  # engine restarted: keep serving the queue
+        emitted = 0
+        self.metrics.record_admission(len(group))
+        for (req, plan), (slot, first) in zip(group, results):
+            now = time.perf_counter()
+            with self._lock:
+                if req.state is RequestState.CANCELLED:
+                    # cancelled while its prefill was in flight (it had
+                    # no slot yet, so cancel() left the release to us)
+                    self.engine.release(slot)
+                    continue
+                req.slot = slot
+                self._by_slot[slot] = req
+                req.state = RequestState.DECODE
+            self._events.emit("slot_admit", req=req.id, slot=slot,
+                              prompt_len=len(req.prompt),
+                              bucket=plan.bucket, cached=plan.start,
+                              queue_depth=self.queue_depth)
+            self.metrics.record_first_token(req.t_submit, now,
+                                            req_id=req.id,
+                                            cached_frac=plan.cached_frac)
+            self._deliver(req, first, now)
+            emitted += 1
+        return emitted
+
+    def _fail_group(self, reqs: list, e: BaseException) -> None:
+        """A batched admission failed with the engine intact: the group's
+        requests error terminally (``wait()`` re-raises — no stranded
+        waiters), every other slot keeps decoding, no restart burned."""
+        with self._lock:
+            for req in reqs:
+                if req.finished:
+                    continue
+                failure = EngineFailed(
+                    f"batched admission failed for request {req.id}: "
+                    f"{type(e).__name__}: {e}")
+                failure.__cause__ = e
+                req.error = failure
+                req.state = RequestState.ERRORED
+                self.metrics.record_errored()
+        self._events.emit("admission_error", error=type(e).__name__,
+                          detail=str(e)[:200], group=len(reqs))
+        for req in reqs:
+            req._done.set()
 
     # ------------------------------------------------------------------ #
     # degradation internals                                               #
@@ -375,17 +509,22 @@ class FCFSScheduler:
             req._done.set()
 
     def _engine_failure(self, e: BaseException,
-                        admitting: Optional[Request] = None) -> bool:
+                        admitting=None) -> bool:
         """The engine raised mid-round: fail every in-flight request
         loudly (their cache/slot state is unknown), dump the flight
         recorder once, and — within the restart budget — warm-restart the
-        engine so the queue keeps being served. Returns True when the
-        engine was restarted; False tells the caller to re-raise."""
+        engine (fresh caches, slot mirrors, AND prefix store/trie — one
+        consistent rebuild) so the queue keeps being served. Returns True
+        when the engine was restarted; False tells the caller to
+        re-raise. ``admitting`` is the request or group mid-admission."""
+        if admitting is None:
+            admitting = []
+        elif isinstance(admitting, Request):
+            admitting = [admitting]
         with self._lock:
             victims = list(self._by_slot.values())
             self._by_slot.clear()
-            if admitting is not None:
-                victims.append(admitting)
+            victims.extend(admitting)
             for req in victims:
                 if req.finished:
                     continue

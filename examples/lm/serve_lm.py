@@ -24,10 +24,24 @@ still queued past their deadline (``wait()`` raises
 ``DeadlineExceededError`` instead of blocking on work that will never
 start). See README "Fault tolerance".
 
+And the admission fast path (PR 5): ``--prefill-buckets`` pads prompts to
+a small ladder of bucketed lengths instead of one ``--prefill-len``,
+``--prefill-batch N`` admits up to N same-bucket requests per compiled
+prefill call, and ``--prefix-blocks N`` turns on ref-counted prefix KV
+reuse — with ``--shared-prefix M`` every burst prompt shares an M-token
+system prompt, so admissions prefill only their ragged tails (the prefix
+stats print at the end: hit rate, evictions, store occupancy).
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/lm/serve_lm.py --requests 16 --slots 4 --prometheus
+
+    # shared-system-prompt traffic through the prefix-cached fast path:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/lm/serve_lm.py --shared-prefix 12 \
+        --prefill-buckets 4,16 --prefill-batch 4 --prefix-blocks 32 \
+        --prefix-block-size 2
 
     # tensor-parallel decode through the same scheduler:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -63,6 +77,27 @@ def main() -> None:
     ap.add_argument("--requests", type=int, default=16)
     ap.add_argument("--prefill-len", type=int, default=16,
                     help="prompts are padded to this length (one compile)")
+    ap.add_argument("--prefill-buckets", default="",
+                    help="comma-separated padded-length ladder (e.g. "
+                         "'4,16'): each admission runs the smallest "
+                         "bucket covering its (suffix) length — less "
+                         "padding waste for one extra compile per bucket "
+                         "(empty: single prefill-len bucket)")
+    ap.add_argument("--prefill-batch", type=int, default=1,
+                    help="admit up to this many same-bucket requests per "
+                         "prefill device call (batched admission)")
+    ap.add_argument("--prefix-blocks", type=int, default=0,
+                    help="enable ref-counted prefix KV reuse with this "
+                         "many device store blocks: requests sharing a "
+                         "cached prompt prefix prefill only their suffix "
+                         "(0: off)")
+    ap.add_argument("--prefix-block-size", type=int, default=4,
+                    help="tokens per prefix-cache block (matches are "
+                         "multiples of this)")
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="give every burst prompt a shared system-prompt "
+                         "prefix of this many tokens — the workload "
+                         "prefix caching exists for (0: fully ragged)")
     ap.add_argument("--max-new", type=int, default=24)
     ap.add_argument("--vocab", type=int, default=128)
     ap.add_argument("--d-model", type=int, default=64)
@@ -112,11 +147,20 @@ def main() -> None:
     else:
         params = model.init(jax.random.PRNGKey(0), init_tok)
 
+    buckets = (tuple(int(b) for b in args.prefill_buckets.split(","))
+               if args.prefill_buckets else None)
     engine = ServingEngine(
         model, params, n_slots=args.slots, prefill_len=args.prefill_len,
+        prefill_buckets=buckets, prefill_batch=args.prefill_batch,
+        prefix_cache_blocks=args.prefix_blocks,
+        prefix_block_size=args.prefix_block_size,
         temperature=args.temperature, comm=comm,
         watchdog=args.watchdog or None,
     )
+    engine.warmup()   # every bucket + decode compile once, off the burst
+    shared = (rng.randint(2, args.vocab, args.shared_prefix)
+              .astype(np.int32) if args.shared_prefix else
+              np.zeros((0,), np.int32))
     eos = None if args.eos_id < 0 else args.eos_id
     t0 = time.time()
     rejected = shed_or_failed = 0
@@ -125,20 +169,26 @@ def main() -> None:
                           max_queue=args.max_queue or None,
                           default_deadline_s=args.deadline or None) as client:
         # one streaming request: tokens arrive as they are decoded
+        tail_max = max(1, args.prefill_len - len(shared))
         stream_toks: list[int] = []
         streamed = client.submit(
-            rng.randint(2, args.vocab, 5).astype(np.int32), args.max_new,
+            np.concatenate([shared,
+                            rng.randint(2, args.vocab, min(5, tail_max))
+                            .astype(np.int32)]),
+            args.max_new,
             rng=jax.random.PRNGKey(1), stream_cb=stream_toks.append)
-        # a burst of blocking requests with ragged prompt lengths; with
+        # a burst of blocking requests with ragged prompt (tail) lengths;
+        # with --shared-prefix they all share a system prompt, so a
+        # prefix-cached engine prefills only the ragged tails. With
         # --max-queue the bounded queue may bounce some (backpressure is
         # the submitter's signal — a real client would retry later)
         handles = []
         for i in range(args.requests - 1):
             try:
                 handles.append(client.submit(
-                    rng.randint(2, args.vocab,
-                                rng.randint(1, args.prefill_len + 1))
-                    .astype(np.int32),
+                    np.concatenate([shared, rng.randint(
+                        2, args.vocab, rng.randint(1, tail_max + 1))
+                        .astype(np.int32)]),
                     int(rng.randint(1, args.max_new + 1)),
                     rng=jax.random.PRNGKey(100 + i),
                 ))
@@ -162,7 +212,10 @@ def main() -> None:
           "shed/failed)")
     for k, v in sorted(report.items()):
         print(f"  {k}: {v}")
-    print(f"engine executables: {engine.compile_counts()} "
+    if engine.prefix_enabled:
+        print("prefix cache: " + ", ".join(
+            f"{k}={v}" for k, v in engine.prefix_stats().items()))
+    print(f"engine executables: {engine.compile_counts_detailed()} "
           "(zero recompiles after warmup)")
     if args.prometheus:
         print("\n# process metrics registry (Prometheus exposition)")

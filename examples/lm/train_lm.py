@@ -60,6 +60,55 @@ def _stream_data(args):
     return toks, tgts, n_seq
 
 
+def _serve_samples(args, comm, model, params, tokens_all):
+    """Training-to-serving in one script: push ``--serve-samples``
+    continuations of the trained model through the serving fast path
+    (bucketed batched prefill + ref-counted prefix KV reuse,
+    :mod:`chainermn_tpu.serving`). All prompts share the stream's opening
+    context, so after the first admission every later one hits the prefix
+    cache and prefills only its ragged tail — the shared-system-prompt
+    traffic shape the cache exists for. Skipped for sharded-model modes
+    (rebuild without sequence/tensor sharding to serve; see
+    ``serve_lm.py``)."""
+    if comm.rank != 0:
+        return
+    if args.seq_parallel or args.tensor_parallel:
+        print("serve-samples: skipped (sequence/tensor-sharded training "
+              "model; rebuild dense for inference — see serve_lm.py)")
+        return
+    from chainermn_tpu.serving import ServingClient, ServingEngine
+
+    infer = (model.clone(moe_impl="gshard") if model.moe_experts
+             else model)
+    params = jax.device_get(params)           # host copy: plain-jit serve
+    ctx_len = min(args.seq_len // 2, 24)
+    ctx = np.asarray(tokens_all[0][:ctx_len], np.int32)
+    tail_src = np.asarray(tokens_all[1], np.int32)
+    bucket_small = 8
+    prefill_len = ctx_len + bucket_small
+    engine = ServingEngine(
+        infer, params, n_slots=4,
+        prefill_buckets=(bucket_small, prefill_len), prefill_batch=4,
+        prefix_cache_blocks=32, prefix_block_size=4,
+        cache_len=prefill_len + 16)
+    engine.warmup()
+    n = args.serve_samples
+    print(f"serving {n} shared-context continuations "
+          f"(ctx={ctx_len} tokens, prefix-cached, bucketed prefill):")
+    with ServingClient(engine) as client:
+        reqs = [client.submit(
+            np.concatenate([ctx, tail_src[: 1 + i % bucket_small]]), 12,
+            rng=jax.random.PRNGKey(i)) for i in range(n)]
+        for i, req in enumerate(reqs):
+            req.wait(timeout=300)
+            print(f"  sample {i}: ...{[int(t) for t in req.output[-8:]]}")
+    stats = engine.prefix_stats()
+    print(f"prefix cache: hit_rate={stats['hit_rate']} "
+          f"hits={stats['hits']} inserted_blocks="
+          f"{stats['inserted_blocks']}; executables="
+          f"{engine.compile_counts_detailed()} (zero recompiles)")
+
+
 def _drop_suffix(acc) -> str:
     """Footer fragment for the aggregated MoE drop telemetry ('' when the
     run had no MoE steps) — shared by every mode's final log line."""
@@ -345,6 +394,12 @@ def main() -> None:
                              "(a seeded resilience.FaultInjector raise) "
                              "to demo the restore loop end to end "
                              "(0: off)")
+    parser.add_argument("--serve-samples", type=int, default=0,
+                        help="after training, serve this many shared-"
+                             "context continuations through the serving "
+                             "fast path (bucketed batched prefill + "
+                             "prefix KV reuse) — training-to-serving in "
+                             "one script (plain/MoE modes; 0: off)")
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--n-tokens", type=int, default=200_000)
     parser.add_argument("--max-len", type=int, default=None,
@@ -572,6 +627,8 @@ def main() -> None:
     if comm.rank == 0:
         print(f"done: {args.iterations} iterations, "
               f"loss {first:.3f} -> {last:.3f}{_drop_suffix(acc)}")
+    if args.serve_samples:
+        _serve_samples(args, comm, model, params, tokens_all)
 
 
 if __name__ == "__main__":

@@ -93,8 +93,12 @@ def test_serving_metrics_publish_into_registry(lm_and_params):
     key = sched.metrics._c_completed.key
     assert snap["counters"][key] == 3
     assert key.startswith("serving_requests_completed_total{instance=")
-    # engine-level counters moved too
-    assert snap["counters"]['serving_prefills_total{engine="serving"}'] >= 3
+    # engine-level counters moved too — since PR 5 prefill counts carry
+    # their padded-bucket label (one series per bucket)
+    prefills = {k: v for k, v in snap["counters"].items()
+                if k.startswith("serving_prefills_total{")}
+    assert sum(prefills.values()) >= 3
+    assert any('prefill_bucket="6"' in k for k in prefills), prefills
     # ...and the whole thing is scrapeable as Prometheus text
     text = monitor.exposition()
     assert "serving_ttft_seconds" in text and "# TYPE" in text

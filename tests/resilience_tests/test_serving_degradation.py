@@ -306,3 +306,92 @@ def test_degradation_is_observable(lm_and_params):
     for kind in ("reject", "shed", "engine_error", "engine_restart",
                  "fault_injected"):
         assert kind in kinds, kind
+
+
+# --------------------------------------------------------------------- #
+# batched admission + prefix copy: contained failure (PR 5)              #
+# --------------------------------------------------------------------- #
+
+
+def make_fast_path(lm, params, **kw):
+    """An engine with the PR-5 admission fast path on: bucket ladder,
+    batch-2 prefill, prefix cache."""
+    engine = ServingEngine(lm, params, n_slots=3,
+                           prefill_buckets=(4, 6), prefill_batch=2,
+                           prefix_cache_blocks=8, prefix_block_size=2,
+                           cache_len=32)
+    engine.warmup()
+    return engine, FCFSScheduler(engine, **kw)
+
+
+def test_prefill_batch_fault_errors_only_the_group(lm_and_params):
+    """Chaos-smoke (acceptance): a fault during BATCHED admission errors
+    only the admitting group — the slot already decoding keeps decoding
+    to a correct completion, no restart is burned, no waiter strands."""
+    lm, params = lm_and_params
+    engine, sched = make_fast_path(lm, params)
+    inflight = sched.submit(np.array([9, 10]), 8)
+    sched.step()                               # decoding before the fault
+    assert inflight.slot >= 0
+    inj = FaultInjector()
+    inj.arm("serving.prefill_batch", kind="raise", times=1)
+    with inj:
+        v1 = sched.submit(np.array([1, 2]), 4)
+        v2 = sched.submit(np.array([3, 4]), 4)
+        sched.run_until_idle()
+    # the group died terminally and loudly...
+    for v in (v1, v2):
+        assert v.state is RequestState.ERRORED
+        with pytest.raises(EngineFailed) as ei:
+            v.wait(timeout=1)
+        assert isinstance(ei.value.__cause__, InjectedFault)
+    # ...but the engine never restarted and the in-flight request is
+    # untouched: token-for-token a solo decode
+    assert sched.engine_restarts == 0
+    assert inflight.state is RequestState.DONE
+    ref = generate(lm, params, jnp.asarray([[9, 10]], jnp.int32), 8)
+    np.testing.assert_array_equal(inflight.output, np.asarray(ref[0]))
+    # and admission keeps working after the contained failure
+    r = sched.submit(np.array([5, 6]), 3)
+    sched.run_until_idle()
+    assert r.state is RequestState.DONE
+
+
+def test_prefix_copy_fault_is_contained_too(lm_and_params):
+    """A fault at the prefix-copy cut-point (the fetch before the batched
+    prefill) is contained the same way: only the group errors; a later
+    identical prompt still admits and matches solo decode."""
+    lm, params = lm_and_params
+    engine, sched = make_fast_path(lm, params)
+    donor = sched.submit(np.array([1, 2, 3, 4, 5]), 2)   # seeds the trie
+    sched.run_until_idle()
+    assert donor.state is RequestState.DONE
+    inj = FaultInjector()
+    inj.arm("serving.prefix_copy", kind="raise", times=1)
+    with inj:
+        victim = sched.submit(np.array([1, 2, 3, 4, 6]), 4)  # hits -> fetch
+        sched.run_until_idle()
+    assert victim.state is RequestState.ERRORED
+    assert sched.engine_restarts == 0
+    redo = sched.submit(np.array([1, 2, 3, 4, 6]), 4)
+    sched.run_until_idle()
+    ref = generate(lm, params, jnp.asarray([[1, 2, 3, 4, 6]], jnp.int32), 4)
+    np.testing.assert_array_equal(redo.output, np.asarray(ref[0]))
+
+
+def test_batch_retry_absorbs_transient_admission_fault(lm_and_params):
+    """RetryPolicy wraps the WHOLE batched admission (fetch + prefill are
+    idempotent until commit): one transient fault, zero errored
+    requests."""
+    lm, params = lm_and_params
+    engine, sched = make_fast_path(
+        lm, params, retry=RetryPolicy(3, base_delay_s=0.001, jitter=0))
+    inj = FaultInjector()
+    inj.arm("serving.prefill_batch", kind="raise", times=1)
+    with inj:
+        r1 = sched.submit(np.array([1, 2]), 3)
+        r2 = sched.submit(np.array([3, 4]), 3)
+        sched.run_until_idle()
+    assert r1.state is RequestState.DONE and r2.state is RequestState.DONE
+    assert sched.engine_restarts == 0
+    assert sched.metrics.report()["requests_errored"] == 0

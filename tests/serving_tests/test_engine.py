@@ -170,7 +170,10 @@ def test_engine_rejects_bad_configs(lm_and_params):
 def test_tp_serving_matches_solo_tp_generate():
     """Tensor-parallel serving (the _generate_tp_fn pattern through the
     scheduler): head-sharded slot caches inside comm.shard_map, both head
-    variants, token-for-token vs the solo TP decode."""
+    variants, token-for-token vs the solo TP decode. The vocab-parallel
+    variant runs the PR-5 fast path (bucket ladder + batched prefill +
+    prefix cache) so the head-sharded block store and the in-program
+    prefix splice get TP coverage too."""
     comm = chainermn_tpu.create_communicator("tpu")
     for vp in (False, True):
         lm = TransformerLM(vocab_size=32, d_model=16, n_heads=8, n_layers=2,
@@ -182,12 +185,26 @@ def test_tp_serving_matches_solo_tp_generate():
             in_specs=P(), out_specs=P(),
         ))(prompt)
         ref = generate(lm, params, prompt, 5, comm=comm)
+        fast = dict(prefill_buckets=(4, 8), prefill_batch=2,
+                    prefix_cache_blocks=8, prefix_block_size=2) if vp else {}
         engine = ServingEngine(lm, params, n_slots=2, prefill_len=8,
-                               cache_len=16, comm=comm)
+                               cache_len=16, comm=comm, **fast)
+        if vp:
+            engine.warmup()
         sched = FCFSScheduler(engine)
         r1 = sched.submit(np.array([1, 2, 3]), 5)
         r2 = sched.submit(np.array([4, 5, 6, 7]), 4)  # ragged companion
         sched.run_until_idle()
         np.testing.assert_array_equal(r1.output, np.asarray(ref[0]))
         assert len(r2.tokens) == 4
-        assert engine.compile_counts() == {"prefill": 1, "decode": 1}
+        if vp:
+            # a same-prefix follower hits the head-sharded block store
+            r3 = sched.submit(np.array([1, 2, 9]), 5)
+            sched.run_until_idle()
+            assert engine.prefix_cache.hits >= 1
+            ref3 = generate(lm, params, jnp.asarray([[1, 2, 9]], jnp.int32),
+                            5, comm=comm)
+            np.testing.assert_array_equal(r3.output, np.asarray(ref3[0]))
+            assert set(engine.compile_counts_detailed().values()) == {1}
+        else:
+            assert engine.compile_counts() == {"prefill": 1, "decode": 1}

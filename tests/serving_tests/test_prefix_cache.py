@@ -1,0 +1,276 @@
+"""Prefix KV reuse + bucketed batched prefill: the admission fast path.
+
+Two layers of pinning. The host trie (``PrefixCacheIndex``) is tested
+standalone — ref-counting, LRU eviction, block accounting — because it is
+pure host state. Then the load-bearing engine properties: requests whose
+prompts share a cached prefix are admitted in one bucketed batch with the
+prefix COPIED (not recomputed) and still produce token-for-token the same
+output as a solo :func:`chainermn_tpu.models.generate`; hits survive the
+donor request's retirement (the store, not the slot, owns the blocks);
+eviction falls back to a full prefill with identical tokens; warmup
+compiles every program exactly once and NOTHING recompiles after; and a
+warm ``restart()`` rebuilds the trie together with the store (a stale
+trie would hand new requests KV blocks that no longer exist)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.resilience import FaultInjector
+from chainermn_tpu.serving import (
+    FCFSScheduler,
+    PrefixCacheIndex,
+    ServingEngine,
+)
+
+# --------------------------------------------------------------------- #
+# host trie (no jax, sub-millisecond)                                    #
+# --------------------------------------------------------------------- #
+
+
+def test_trie_match_is_block_granular_and_never_whole_prompt():
+    idx = PrefixCacheIndex(n_blocks=8, block_size=2)
+    plan = idx.plan_insert(np.arange(1, 8))        # 7 tokens -> 3 blocks
+    assert [len(k) for k in plan.keys] == [2, 2, 2]
+    assert plan.row_starts == [0, 2, 4]
+    idx.commit_insert(plan)
+    m = idx.match(np.arange(1, 8))                 # same 7 tokens
+    assert m.length == 6 and len(m.block_ids) == 3
+    idx.release(m)
+    # a prompt that IS exactly the cached blocks must keep >= 1 suffix
+    # token: the match may cover at most (len-1)//bs blocks
+    m = idx.match(np.arange(1, 7))                 # 6 tokens, all cached
+    assert m.length == 4                           # 2 blocks, not 3
+    idx.release(m)
+    assert idx.match(np.array([9, 9, 9, 9])) is None
+    assert idx.stats()["used_blocks"] == 3
+
+
+def test_trie_refcount_blocks_eviction_until_release():
+    idx = PrefixCacheIndex(n_blocks=2, block_size=2)
+    idx.commit_insert(idx.plan_insert(np.array([1, 2, 3, 4])))
+    m = idx.match(np.array([1, 2, 3, 4, 5]))
+    assert m.length == 4
+    # store is full and the chain tail is pinned: nothing may be evicted,
+    # so a new insert gets NO blocks (partial alloc -> None)
+    assert idx.plan_insert(np.array([5, 6, 7, 8])) is None
+    idx.release(m)
+    plan = idx.plan_insert(np.array([5, 6, 7, 8]))  # now evicts the chain
+    assert plan is not None and len(plan.block_ids) == 2
+    idx.commit_insert(plan)
+    assert idx.evictions == 2
+    assert idx.match(np.array([1, 2, 3, 4, 5])) is None  # evicted
+    m = idx.match(np.array([5, 6, 7, 8, 9]))
+    assert m is not None and m.length == 4
+
+
+def test_trie_lru_evicts_coldest_leaf_first():
+    idx = PrefixCacheIndex(n_blocks=2, block_size=2)
+    idx.commit_insert(idx.plan_insert(np.array([1, 2])))    # A
+    idx.commit_insert(idx.plan_insert(np.array([3, 4])))    # B
+    idx.release(idx.match(np.array([1, 2, 9])))             # touch A
+    idx.commit_insert(idx.plan_insert(np.array([5, 6])))    # evicts B (LRU)
+    assert idx.match(np.array([1, 2, 9])) is not None       # A survived
+    assert idx.match(np.array([3, 4, 9])) is None
+
+
+def test_trie_abort_returns_blocks_and_unpins():
+    idx = PrefixCacheIndex(n_blocks=4, block_size=2)
+    plan = idx.plan_insert(np.array([1, 2, 3, 4]))
+    assert idx.used_blocks == 2                    # allocated, uncommitted
+    idx.abort_insert(plan)
+    assert idx.used_blocks == 0
+    assert idx.match(np.array([1, 2, 3])) is None  # nothing was linked
+    idx.clear()
+    assert idx.used_blocks == 0
+
+
+# --------------------------------------------------------------------- #
+# engine: parity, warmup, restart                                        #
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=2,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+@pytest.fixture(scope="module")
+def warm_engine(lm_and_params):
+    """One warmed fast-path engine shared by the parity tests: two
+    buckets, batch-2 prefill, blocks of 2 tokens."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=3,
+                           prefill_buckets=(4, 8), prefill_batch=2,
+                           prefix_cache_blocks=16, prefix_block_size=2,
+                           cache_len=32)
+    engine.warmup()
+    return engine
+
+
+def solo(lm, params, prompt, n, **kw):
+    out = generate(lm, params, jnp.asarray(prompt, jnp.int32)[None], n, **kw)
+    return np.asarray(out[0])
+
+
+PREFIX = [1, 2, 3, 4, 5, 6]
+
+
+def test_shared_prefix_batch_admission_matches_solo(lm_and_params,
+                                                    warm_engine):
+    """Acceptance criterion (a)+(b): a donor seeds the trie and RETIRES;
+    two followers sharing its prefix are admitted in the SAME bucket
+    batch, each prefilling only its suffix against COPIED prefix KV — and
+    each is token-for-token a solo generate()."""
+    lm, params = lm_and_params
+    engine = warm_engine
+    sched = FCFSScheduler(engine)
+    donor = sched.submit(np.array(PREFIX + [7]), 5)
+    sched.run_until_idle()
+    assert donor.finished                      # donor retired; trie seeded
+    h0 = engine.prefix_cache.hits
+    r1 = sched.submit(np.array(PREFIX + [8]), 6)
+    r2 = sched.submit(np.array(PREFIX + [9, 10]), 4)
+    sched.step()                               # ONE admission round
+    # both followers entered in one batched call (same bucket, shared
+    # prefix preferred) — not two singleton admissions
+    assert r1.slot >= 0 and r2.slot >= 0
+    sched.run_until_idle()
+    np.testing.assert_array_equal(donor.output, solo(lm, params,
+                                                     PREFIX + [7], 5))
+    np.testing.assert_array_equal(r1.output, solo(lm, params,
+                                                  PREFIX + [8], 6))
+    np.testing.assert_array_equal(r2.output, solo(lm, params,
+                                                  PREFIX + [9, 10], 4))
+    assert engine.prefix_cache.hits >= h0 + 2  # the reuse really happened
+    m = sched.metrics.report()
+    assert m["prefill_batch_size_max"] == 2
+    assert m["prefix_hit_rate"] > 0
+
+
+def test_zero_recompiles_across_buckets_after_warmup(lm_and_params,
+                                                     warm_engine):
+    """Acceptance criterion: warmup compiles each bucket program, the
+    decode step, and both prefix-copy programs exactly ONCE; a mixed
+    workload spanning every bucket, prefix hits, inserts, and slot reuse
+    adds zero executables."""
+    lm, params = lm_and_params
+    engine = warm_engine
+    before = engine.compile_counts_detailed()
+    assert set(before.values()) == {1}, before
+    sched = FCFSScheduler(engine)
+    for prompt, n in [(PREFIX + [11], 4),          # bucket 4 via prefix hit
+                      (list(range(1, 9)), 3),      # bucket 4 (hit) or 8
+                      ([12, 13, 14, 15, 16, 1, 2], 5),   # bucket 8, miss
+                      ([3], 6),                    # bucket 4, tiny
+                      (PREFIX + [9], 2)]:          # hit again
+        sched.submit(np.array(prompt), n)
+    sched.run_until_idle()
+    assert engine.compile_counts_detailed() == before
+    assert engine.recompiles == {}
+    assert engine.compile_counts() == {"prefill": 2, "decode": 1}
+
+
+def test_eviction_then_readmit_matches_solo(lm_and_params):
+    """Acceptance criterion (c): once a cached prefix is evicted (tiny
+    store), the same prompt admits as a miss — full prefill — with
+    identical tokens; a later readmit re-caches it."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2,
+                           prefill_buckets=(4, 8), prefill_batch=2,
+                           prefix_cache_blocks=3, prefix_block_size=2,
+                           cache_len=32)
+    engine.warmup()
+    sched = FCFSScheduler(engine)
+    a = np.array(PREFIX + [7])                 # 3 blocks — fills the store
+    b = np.array([9, 10, 11, 12, 13, 14, 15])  # 3 blocks — must evict A
+    ra1 = sched.submit(a, 4)
+    sched.run_until_idle()
+    rb = sched.submit(b, 4)
+    sched.run_until_idle()
+    assert engine.prefix_cache.evictions >= 1
+    ra2 = sched.submit(a, 4)                   # A evicted: admits as miss
+    sched.run_until_idle()
+    ref = solo(lm, params, a, 4)
+    np.testing.assert_array_equal(ra1.output, ref)
+    np.testing.assert_array_equal(ra2.output, ref)
+    np.testing.assert_array_equal(rb.output, solo(lm, params, b, 4))
+
+
+def test_restart_rebuilds_trie_with_store(lm_and_params):
+    """The PR-5 bugfix: a warm restart must clear the prefix trie
+    together with the slot mirrors/caches — a stale trie would 'hit' on
+    blocks of the discarded store. Pinned fault-injected: a decode fault
+    errors the in-flight work, the scheduler warm-restarts, and a
+    same-prefix readmit sees an EMPTY cache, misses, and still matches
+    solo decode (with the same executables — nothing recompiled)."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2,
+                           prefill_buckets=(4, 8), prefill_batch=2,
+                           prefix_cache_blocks=16, prefix_block_size=2,
+                           cache_len=32)
+    engine.warmup()
+    counts = engine.compile_counts_detailed()
+    sched = FCFSScheduler(engine)
+    seed = sched.submit(np.array(PREFIX + [7]), 4)
+    sched.run_until_idle()
+    assert seed.finished and engine.prefix_cache.used_blocks > 0
+    inj = FaultInjector(seed=0)
+    inj.arm("serving.decode", kind="raise", times=1)
+    with inj:
+        victim = sched.submit(np.array(PREFIX + [8]), 6)
+        sched.run_until_idle()
+    assert victim.state.value == "errored"
+    assert sched.engine_restarts == 1
+    # the restart rebuilt store AND trie together: nothing cached anymore
+    assert engine.prefix_cache.used_blocks == 0
+    assert engine.prefix_cache.match(np.array(PREFIX + [8])) is None
+    # and a fresh same-prefix request is correct from the clean slate
+    redo = sched.submit(np.array(PREFIX + [8]), 6)
+    sched.run_until_idle()
+    np.testing.assert_array_equal(redo.output,
+                                  solo(lm, params, PREFIX + [8], 6))
+    assert engine.compile_counts_detailed() == counts  # warm = no compile
+
+
+def test_cost_aware_grouping_is_bucket_homogeneous(lm_and_params,
+                                                   warm_engine):
+    """Admission groups never mix buckets (one compiled program per
+    call): a long head admits alone even with short companions queued;
+    the shorts then share the next round's batch."""
+    lm, params = lm_and_params
+    engine = warm_engine
+    sched = FCFSScheduler(engine)
+    long = sched.submit(np.array([7, 8, 9, 10, 11, 12, 13]), 3)  # bucket 8
+    s1 = sched.submit(np.array([14, 15]), 3)                     # bucket 4
+    s2 = sched.submit(np.array([16, 1]), 3)                      # bucket 4
+    sched.step()
+    assert long.slot >= 0 and s1.slot < 0 and s2.slot < 0
+    sched.step()
+    assert s1.slot >= 0 and s2.slot >= 0                         # one batch
+    sched.run_until_idle()
+    for req, (p, n) in [(long, ([7, 8, 9, 10, 11, 12, 13], 3)),
+                        (s1, ([14, 15], 3)), (s2, ([16, 1], 3))]:
+        np.testing.assert_array_equal(req.output, solo(lm, params, p, n))
+
+
+def test_single_bucket_engine_keeps_pr1_surface(lm_and_params):
+    """Back-compat: the default configuration (one bucket, batch 1, no
+    prefix cache) keeps the PR-1 compile-count contract and the direct
+    ``prefill()`` API."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=8,
+                           cache_len=32)
+    slot, first = engine.prefill(np.array([1, 2, 3]),
+                                 jax.random.PRNGKey(0))
+    assert slot == 0 and engine.active_slots == 1
+    engine.decode_step()
+    assert engine.compile_counts() == {"prefill": 1, "decode": 1}
+    ref = solo(lm, params, [1, 2, 3], 1)
+    assert first == ref[3]

@@ -59,18 +59,25 @@ def test_bench_serving_mode_smoke():
     """``bench.py --mode serving`` (acceptance criterion): one parseable
     JSON record with tokens/s, TTFT p50/p99, and slot occupancy on the
     emulated CPU mesh — the serving perf baseline's harness, pinned so a
-    bench-side regression is caught in CI, not on a chip window."""
+    bench-side regression is caught in CI, not on a chip window. Since
+    PR 5 the record also carries the prefix-heavy shared-system-prompt
+    workload: hit rate, TTFT vs the prefix-cache-off run of the SAME
+    workload, batched-prefill occupancy, zero recompiles after bucket
+    warmup, and token parity vs solo generate() — all asserted here."""
     env = dict(
         os.environ,
         CHAINERMN_TPU_BENCH_PLATFORM="cpu",
         CHAINERMN_TPU_SERVE_SLOTS="4",
-        CHAINERMN_TPU_SERVE_REQUESTS="10",
-        CHAINERMN_TPU_SERVE_PREFILL_LEN="8",
-        CHAINERMN_TPU_SERVE_MAX_NEW="8",
-        CHAINERMN_TPU_SERVE_VOCAB="64",
-        CHAINERMN_TPU_SERVE_DMODEL="32",
+        CHAINERMN_TPU_SERVE_REQUESTS="12",
+        CHAINERMN_TPU_SERVE_PREFILL_LEN="128",
+        CHAINERMN_TPU_SERVE_MAX_NEW="6",
+        CHAINERMN_TPU_SERVE_VOCAB="128",
+        CHAINERMN_TPU_SERVE_DMODEL="64",
         CHAINERMN_TPU_SERVE_LAYERS="2",
         CHAINERMN_TPU_SERVE_HEADS="4",
+        CHAINERMN_TPU_SERVE_BUCKETS="16,128",
+        CHAINERMN_TPU_SERVE_SHARED_PREFIX="112",
+        CHAINERMN_TPU_SERVE_PREFIX_BLOCK="16",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
     )
     proc = subprocess.run(
@@ -83,13 +90,25 @@ def test_bench_serving_mode_smoke():
     assert rec["unit"] == "tokens/sec"
     assert rec["value"] and rec["value"] > 0
     assert rec["n_chips"] == 8
-    assert rec["n_slots"] == 4 and rec["n_requests"] == 10
+    assert rec["n_slots"] == 4 and rec["n_requests"] == 12
     assert rec["ttft_p50_ms"] > 0 and rec["ttft_p99_ms"] >= rec["ttft_p50_ms"]
     assert rec["tpot_p50_ms"] > 0
     assert 0 < rec["slot_occupancy"] <= 1
     assert rec["tokens_generated"] > 0
     # the zero-recompile invariant travels with the perf record
     assert rec["recompiles"] == {"prefill": 1, "decode": 1}
+    # ---- the PR-5 admission fast path (ISSUE 5 acceptance) ---------- #
+    p = rec["prefix_serving"]
+    assert p["hit_rate"] > 0.5, p
+    assert p["parity_vs_solo_generate"] is True
+    assert p["recompiles_after_warmup"] == 0
+    # every program compiled exactly once at warmup (both buckets + the
+    # decode step + the prefix insert)
+    assert set(p["compile_counts"].values()) == {1}, p["compile_counts"]
+    # TTFT p50 strictly better than the prefix-cache-off run of the same
+    # workload (the CPU-mesh margin is ~3x — ample against timer noise)
+    assert p["ttft_p50_ms"] < p["ttft_p50_ms_off"], p
+    assert p["prefill_batch_occupancy"] > 1.0  # batching really batched
 
 
 def _run_monitor_mode(extra_env):
