@@ -281,6 +281,55 @@ def enable_compilation_cache(jax_mod) -> None:
         log(f"cache min-compile-time threshold not set: {e}")
 
 
+def _devices_or_fail_fast(jax_mod, *, mode: str = "train",
+                          metric: str = "resnet50_imagenet_train_throughput",
+                          unit: str = "images/sec/chip"):
+    """Backend init with a watchdog: TPU backend bring-up has HUNG (not
+    failed) in 3 of the last 5 rounds — ``jax.devices()`` through a
+    wedged tunnel blocks forever, so without a timeout the whole attempt
+    (and then the parent's retry ladder) burns on a backend that will
+    never come up. Probe ``jax.devices()`` on a daemon thread bounded by
+    ``CHAINERMN_TPU_BENCH_INIT_TIMEOUT`` (default 180 s — healthy init is
+    seconds). On timeout, fail FAST to the committed-evidence path: emit
+    one parseable record with ``backend_init_timeout`` set (plus the
+    newest persisted TPU measurement that ``_failure_record`` embeds —
+    the round still carries real evidence) and ``os._exit`` — the probe
+    thread is wedged inside a C call, so a normal interpreter teardown
+    could hang exactly like the init did. A backend that raised (rather
+    than hung) re-raises unchanged: those errors stay retryable."""
+    timeout = float(os.environ.get("CHAINERMN_TPU_BENCH_INIT_TIMEOUT",
+                                   "180"))
+    box: dict = {}
+
+    def probe():
+        try:
+            box["devs"] = jax_mod.devices()
+        except BaseException as exc:  # noqa: BLE001 — relayed below
+            box["err"] = exc
+
+    import threading
+
+    t = threading.Thread(target=probe, daemon=True,
+                         name="backend-init-probe")
+    t.start()
+    t.join(timeout)
+    if "devs" in box:
+        return box["devs"]
+    if "err" in box:
+        raise box["err"]
+    log(f"backend init watchdog: jax.devices() still hung after "
+        f"{timeout:.0f}s; failing fast with the committed evidence")
+    rec = _failure_record(
+        "backend_init_timeout",
+        f"jax.devices() did not return within {timeout:.0f}s "
+        "(tunnel wedged?)", 0)
+    rec.update({"metric": metric, "unit": unit, "mode": mode,
+                "backend_init_timeout": True})
+    print(json.dumps(rec), flush=True)
+    _scratch_write(rec)
+    os._exit(1)
+
+
 def child_main() -> None:
     # Python's default SIGTERM disposition is immediate kernel termination —
     # no stack unwind, no PJRT client teardown, so the parent's TERM-first
@@ -311,7 +360,7 @@ def child_main() -> None:
     import chainermn_tpu
     from chainermn_tpu.models import ResNet50
 
-    devs = jax.devices()
+    devs = _devices_or_fail_fast(jax)
     log(f"devices: {devs} (kind={devs[0].device_kind!r})")
     n_chips = len(devs)
 
@@ -567,7 +616,9 @@ def serving_main() -> None:
     n_layers = int(e("CHAINERMN_TPU_SERVE_LAYERS", "4"))
     n_heads = int(e("CHAINERMN_TPU_SERVE_HEADS", "8"))
 
-    devs = jax.devices()
+    devs = _devices_or_fail_fast(jax, mode="serving",
+                                 metric="serving_decode_throughput",
+                                 unit="tokens/sec")
     log(f"serving bench: devices={len(devs)} kind={devs[0].device_kind!r} "
         f"slots={n_slots} requests={n_requests}")
     try:
@@ -788,7 +839,9 @@ def monitor_main() -> None:
     n_layers = int(e("CHAINERMN_TPU_SERVE_LAYERS", "2"))
     n_heads = int(e("CHAINERMN_TPU_SERVE_HEADS", "4"))
 
-    devs = jax.devices()
+    devs = _devices_or_fail_fast(jax, mode="monitor",
+                                 metric="monitor_smoke",
+                                 unit="monitored_steps")
     log(f"monitor smoke: devices={len(devs)} kind={devs[0].device_kind!r} "
         f"steps={n_steps} requests={n_requests}")
     try:
@@ -850,6 +903,41 @@ def monitor_main() -> None:
         flight = sink.getvalue()
         flight_events = sum(
             1 for line in flight.splitlines() if line.startswith("{"))
+
+        # ---- tracing + SLO + HTTP scrape surface ----------------------- #
+        # The burst above ran through the default tracer (the scheduler
+        # opens a trace per request), so the ring already holds serving
+        # span trees; declare a generous TTFT SLO over the live registry,
+        # stand the stdlib endpoint up on an ephemeral port, and scrape
+        # all four routes the way a Prometheus/Perfetto consumer would.
+        from urllib.request import urlopen
+
+        from chainermn_tpu.monitor import http as monitor_http
+        from chainermn_tpu.monitor.slo import LatencyObjective, SLOEngine
+        from chainermn_tpu.monitor.trace import get_tracer
+
+        tracer = get_tracer()
+        serving_traces = tracer.finished(kind="serving")
+        slo = SLOEngine()
+        slo.add(LatencyObjective("ttft_p99", "serving_ttft_seconds",
+                                 threshold_s=30.0, windows=(60.0, 300.0)))
+        slo_report = slo.evaluate()
+        with monitor_http.serve(port=0, slo=slo) as srv:
+            http_block = {"port": srv.port}
+            metrics_txt = urlopen(srv.url + "/metrics",
+                                  timeout=10).read().decode()
+            http_block["metrics_ok"] = "serving_ttft_seconds" in metrics_txt
+            tr = json.loads(urlopen(srv.url + "/traces", timeout=10).read())
+            trace_events = tr.get("traceEvents", [])
+            http_block["trace_events"] = len(trace_events)
+            http_block["traces_ok"] = bool(trace_events) and all(
+                ev.get("ph") in ("X", "M") and "pid" in ev and "tid" in ev
+                for ev in trace_events)
+            slo_http = json.loads(urlopen(srv.url + "/slo",
+                                          timeout=10).read())
+            http_block["slo_ok"] = "ttft_p99" in slo_http
+            evs = json.loads(urlopen(srv.url + "/events", timeout=10).read())
+            http_block["events_ok"] = bool(evs.get("events"))
         snap = monitor.snapshot()
         steps_counted = sum(
             v for k, v in snap["counters"].items()
@@ -870,6 +958,15 @@ def monitor_main() -> None:
             "flight_has_memory": "device memory" in flight,
             "serving": sched.metrics.report(),
             "recompiles": engine.compile_counts(),
+            "trace": {
+                "serving_traces": len(serving_traces),
+                "spans_example": ([s.name for s in serving_traces[0].spans]
+                                  if serving_traces else []),
+            },
+            "http": http_block,
+            "slo": {k: {"max_burn_rate": v["max_burn_rate"],
+                        "compliant": v["compliant"]}
+                    for k, v in slo_report.items()},
             "monitor": snap,
         }
     except Exception as exc:  # one parseable line, never a bare traceback
@@ -946,7 +1043,8 @@ def resilience_main() -> None:
     n_heads = int(e("CHAINERMN_TPU_SERVE_HEADS", "4"))
     seq_len = 16
 
-    devs = jax.devices()
+    devs = _devices_or_fail_fast(jax, mode="resilience",
+                                 metric="resilience_mttr", unit="mttr_ms")
     log(f"resilience smoke: devices={len(devs)} "
         f"kind={devs[0].device_kind!r} steps={n_steps} "
         f"fault_step={fault_step}")
@@ -1159,7 +1257,9 @@ def pipeline_main() -> None:
     n_layers = int(e("CHAINERMN_TPU_SERVE_LAYERS", "2"))
     n_heads = int(e("CHAINERMN_TPU_SERVE_HEADS", "4"))
 
-    devs = jax.devices()
+    devs = _devices_or_fail_fast(jax, mode="pipeline",
+                                 metric="pipeline_overlap_step_time",
+                                 unit="ms/step")
     log(f"pipeline bench: devices={len(devs)} kind={devs[0].device_kind!r} "
         f"steps={n_steps} fetch_every={fetch_every} depth={depth}")
     try:

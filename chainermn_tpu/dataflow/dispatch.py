@@ -34,6 +34,7 @@ from time import perf_counter
 from typing import Callable, Optional
 
 from chainermn_tpu.monitor._state import get_registry
+from chainermn_tpu.monitor.trace import span as _trace_span
 
 
 def device_fetch(values):
@@ -79,13 +80,17 @@ class LossWindow:
         self._h_lag = reg.histogram("dispatch_lag_steps", labels)
         self._g_inflight = reg.gauge("dispatch_inflight", labels)
 
-    def push(self, step: int, loss) -> None:
+    def push(self, step: int, loss) -> bool:
         """Enqueue step ``step``'s on-device loss; fetches (blocking once
-        per ``window`` pushes) when the in-flight bound is reached."""
+        per ``window`` pushes) when the in-flight bound is reached.
+        Returns True when this push closed a fetch — the caller's signal
+        that the (rare) blocking host round trip happened here."""
         self._pending.append((step, loss))
         self._g_inflight.set(len(self._pending))
         if len(self._pending) >= self._window:
             self._fetch_pending()
+            return True
+        return False
 
     def _fetch_pending(self) -> None:
         if not self._pending:
@@ -95,7 +100,11 @@ class LossWindow:
         self._pending.clear()
         self._h_lag.observe(len(vals))
         t0 = perf_counter()
-        host = device_fetch(vals)  # ONE round trip closes `len(vals)` steps
+        # ONE round trip closes `len(vals)` steps; the ambient span puts
+        # the blocking fetch on the current train-step trace (no-op when
+        # no trace is ambient)
+        with _trace_span("loss_fetch", n=len(vals)):
+            host = device_fetch(vals)
         self._h_fetch.observe(perf_counter() - t0)
         self._c_fetches.inc()
         self._g_inflight.set(0)

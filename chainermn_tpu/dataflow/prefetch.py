@@ -40,6 +40,7 @@ import time
 from typing import Any, Callable, Optional
 
 from chainermn_tpu.monitor._state import get_registry
+from chainermn_tpu.monitor.trace import span as _trace_span
 
 _DONE = "done"
 _ERROR = "error"
@@ -175,10 +176,12 @@ class DevicePrefetcher:
         self._ensure_started()
         if self._q.empty():
             # the producer is behind: the input pipeline, not the step, is
-            # the bottleneck right now — count it and time the wait
+            # the bottleneck right now — count it, time the wait, and put
+            # the stall on the ambient train-step trace (if one is open)
             self._c_stall.inc()
             t0 = time.perf_counter()
-            item = self._q.get()
+            with _trace_span("prefetch_stall"):
+                item = self._q.get()
             self._h_stall.observe(time.perf_counter() - t0)
         else:
             item = self._q.get()

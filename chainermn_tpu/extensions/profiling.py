@@ -290,17 +290,21 @@ class Watchdog:
         self._lock = threading.Lock()
         self._gen = 0
         self._armed = False
+        self._ctx: dict = {}
 
     def _fire(self, where: str, gen: int) -> None:
         with self._lock:
             if gen != self._gen or not self._armed:
                 return  # the watched step finished; stale timer, stand down
+            ctx = dict(self._ctx)
         self._fired.set()
         import faulthandler
 
+        who = (" " + " ".join(f"{k}={v}" for k, v in ctx.items())
+               if ctx else "")
         print(
             f"chainermn_tpu.Watchdog: step exceeded {self._timeout}s "
-            f"({where}) — a peer likely died inside a collective. "
+            f"({where}{who}) — a peer likely died inside a collective. "
             "Thread stacks follow.",
             file=self._sink, flush=True,
         )
@@ -333,8 +337,11 @@ class Watchdog:
         try:
             from chainermn_tpu.monitor import emit, get_event_log
 
+            # ctx carries the caller's request/trace identity (the
+            # serving scheduler labels every watched device call), so the
+            # fire event joins against exported traces
             emit("watchdog_fire", where=where, timeout_s=self._timeout,
-                 mode=self._mode)
+                 mode=self._mode, **ctx)
             get_event_log().dump(file=self._sink, once="failure")
         except Exception:
             pass
@@ -359,15 +366,21 @@ class Watchdog:
         return self._fired.is_set()
 
     @contextlib.contextmanager
-    def step(self, label: str = "train step"):
+    def step(self, label: str = "train step", **context):
+        """Watch one step. ``context`` (request ids, trace ids — whatever
+        identifies the work) rides into the ``watchdog_arm``/
+        ``watchdog_fire`` events and the fire banner, so a hang dump
+        names the victims instead of just the call site."""
         with self._lock:
             self._gen += 1
             self._armed = True
+            self._ctx = context
             self._start_timer_locked(label)
         try:  # arm event: correlates hangs with the surrounding activity
             from chainermn_tpu.monitor import emit
 
-            emit("watchdog_arm", label=label, timeout_s=self._timeout)
+            emit("watchdog_arm", label=label, timeout_s=self._timeout,
+                 **context)
         except Exception:
             pass
         try:
@@ -376,5 +389,6 @@ class Watchdog:
             with self._lock:
                 self._gen += 1
                 self._armed = False
+                self._ctx = {}
                 if self._timer is not None:
                     self._timer.cancel()

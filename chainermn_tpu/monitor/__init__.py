@@ -25,6 +25,20 @@ four pillars:
   :func:`record_memory_gauges`): executable-cache growth as a counted,
   event-logged signal (the serving zero-recompile assertion, generalized),
   plus periodic device-memory gauges.
+- **Request-scoped tracing** (:class:`Tracer` / :func:`get_tracer`):
+  Dapper-style span trees with context propagation — a serving request's
+  queue -> admit -> prefill -> decode -> retire, a training step's
+  prefetch-wait -> dispatch -> loss fetch — head-sampled with forced
+  retention on error/deadline miss, exported as Chrome trace-event JSON
+  (Perfetto-loadable).
+- **SLO engine** (:class:`SLOEngine`): declarative latency / error-rate
+  objectives evaluated from registry histograms and counters with
+  multi-window burn rates; breaches emit flight-recorder events naming
+  the offending trace ids, and ``slo_burn_rate`` gauges pool fleet-wide
+  through :func:`aggregate`.
+- **Scrape endpoint** (:func:`chainermn_tpu.monitor.http.serve`):
+  stdlib-only background HTTP server exposing ``/metrics`` (Prometheus
+  text), ``/traces`` (Chrome JSON), ``/slo``, and ``/events``.
 
 The per-step hot-path cost is a few dict/deque operations (<2% step time
 even on millisecond CPU steps — asserted by ``bench.py --mode monitor``);
@@ -61,6 +75,14 @@ from chainermn_tpu.monitor.registry import (
     MetricsRegistry,
     merge_rank_payloads,
 )
+from chainermn_tpu.monitor.slo import (
+    ErrorRateObjective,
+    LatencyObjective,
+    SLOEngine,
+    get_slo_engine,
+)
+from chainermn_tpu.monitor.trace import Span, Trace, Tracer, get_tracer
+from chainermn_tpu.monitor import http  # noqa: F401 — monitor.http.serve
 
 
 def emit(kind: str, **fields) -> None:
@@ -90,12 +112,18 @@ def aggregate(comm) -> dict:
 
 __all__ = [
     "Counter",
+    "ErrorRateObjective",
     "EventLog",
     "Gauge",
     "Histogram",
+    "LatencyObjective",
     "MetricsRegistry",
     "MonitoredFunction",
     "RecompileGuard",
+    "SLOEngine",
+    "Span",
+    "Trace",
+    "Tracer",
     "aggregate",
     "annotate",
     "device_memory_lines",
@@ -103,6 +131,9 @@ __all__ = [
     "exposition",
     "get_event_log",
     "get_registry",
+    "get_slo_engine",
+    "get_tracer",
+    "http",
     "instrument",
     "merge_rank_payloads",
     "record_memory_gauges",

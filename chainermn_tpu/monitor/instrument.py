@@ -109,6 +109,11 @@ class RecompileGuard:
             self._registry.counter(
                 "recompiles_total", {"fn": name}).inc(delta)
             self._events.emit("recompile", fn=name, executables=cur)
+            # a step that recompiled is always worth its trace: flag the
+            # ambient trace (if any) for forced retention
+            from chainermn_tpu.monitor.trace import get_tracer
+
+            get_tracer().mark_current_error(f"recompile:{name}")
             msg = (f"chainermn_tpu.monitor.RecompileGuard: {name!r} "
                    f"recompiled ({cur} executables) — a shape/dtype/static-"
                    "arg changed on a hot path")

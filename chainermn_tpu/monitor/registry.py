@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from collections import deque
 from typing import Mapping, Optional
 
@@ -120,13 +121,17 @@ class Histogram(_Instrument):
         super().__init__(name, labels_key)
         self.unit = unit
         self._samples: deque = deque(maxlen=max_samples)
+        # observation times (time.monotonic), same maxlen so the two
+        # deques stay aligned — the SLO engine's windowed reads
+        self._times: deque = deque(maxlen=max_samples)
         self._count = 0
         self._sum = 0.0
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, t: Optional[float] = None) -> None:
         v = float(v)
         with self._lock:
             self._samples.append(v)
+            self._times.append(time.monotonic() if t is None else float(t))
             self._count += 1
             self._sum += v
 
@@ -142,6 +147,16 @@ class Histogram(_Instrument):
     def samples(self) -> list:
         """Newest retained raw samples (bounded; for percentile math)."""
         return list(self._samples)
+
+    def recent(self, window_s: float, now: Optional[float] = None) -> list:
+        """Retained samples observed within the last ``window_s`` seconds
+        (``now`` defaults to ``time.monotonic()``) — the SLO engine's
+        multi-window burn-rate input. Bounded by the reservoir: a window
+        wider than the reservoir's history returns what is retained."""
+        cutoff = (time.monotonic() if now is None else now) - float(window_s)
+        with self._lock:
+            return [v for v, t in zip(self._samples, self._times)
+                    if t >= cutoff]
 
     def stats(self) -> dict:
         out: dict = {"count": int(self._count), "sum": float(self._sum)}

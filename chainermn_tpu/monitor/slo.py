@@ -1,0 +1,333 @@
+"""Declarative SLOs with multi-window burn-rate evaluation.
+
+An SLO turns a registry series into a *decision signal*: "TTFT p99 must
+stay under X ms", "error rate must stay under Y". The classic SRE framing
+is the **error budget**: a ``q``-quantile latency objective allows a
+``1 - q`` fraction of requests over the threshold; the **burn rate** is
+how fast the live traffic is spending that allowance (``burn = observed
+bad fraction / allowed bad fraction`` — 1.0 means exactly on budget,
+10 means the budget burns 10x too fast). Evaluating it over MULTIPLE
+windows (a short one + a long one, both required to burn) keeps a single
+slow request from paging while still catching sustained regressions
+fast — the standard multi-window multi-burn-rate alert shape.
+
+Inputs come from the process registry: latency objectives read a
+histogram's timestamped reservoir (:meth:`~chainermn_tpu.monitor.
+registry.Histogram.recent`), error-rate objectives difference counters
+between :meth:`SLOEngine.evaluate` calls (the engine keeps its own
+bounded snapshot history, so counters don't need timestamps). Each
+evaluation publishes ``slo_burn_rate{slo=,window=}`` gauges and a
+``slo_compliant{slo=}`` gauge back into the registry — which makes fleet
+pooling free: ``monitor.aggregate(comm)`` already averages gauges across
+ranks, so rank 0 sees fleet-level burn rates (the admission signal the
+future multi-replica router reads).
+
+A breach (every window burning past ``burn_threshold``) emits one
+``slo_breach`` flight-recorder event **naming the offending trace ids**
+(the tracer's retained slow/errored/deadline-missed traces in the long
+window), so an alert joins directly against the causal span trees.
+
+This module must not import ``chainermn_tpu.extensions`` (or jax) at
+module level — pinned by ``tests/monitor_tests/test_import_hygiene.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+
+
+@dataclass
+class LatencyObjective:
+    """``quantile(metric) < threshold_s``, e.g. TTFT p99 < 200 ms.
+
+    ``metric`` names a seconds-valued registry histogram; every labelled
+    instance of that name pools into the objective (a scheduler restart
+    changes the ``instance`` label, the SLO shouldn't reset). The allowed
+    bad fraction is ``1 - target_quantile``; ``min_samples`` keeps an
+    empty window from reporting (burn 0, not NaN)."""
+
+    name: str
+    metric: str
+    threshold_s: float
+    target_quantile: float = 0.99
+    windows: tuple = (60.0, 300.0)
+    burn_threshold: float = 1.0
+    min_samples: int = 1
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.target_quantile < 1.0:
+            raise ValueError(
+                f"target_quantile must be in (0, 1), got "
+                f"{self.target_quantile}")
+        if self.threshold_s <= 0:
+            raise ValueError(f"threshold_s must be > 0, got "
+                             f"{self.threshold_s}")
+
+
+@dataclass
+class ErrorRateObjective:
+    """``bad / total < target_rate`` over each window, e.g. errored+shed
+    requests under 1% of submissions. ``bad`` / ``total`` name registry
+    counters (tuples pool several series; all label sets of a name sum).
+    Rates come from counter DELTAS between evaluations, so the engine
+    must be evaluated periodically (a scheduler step hook, the HTTP
+    scraper, or a test driving ``evaluate(now=...)`` explicitly)."""
+
+    name: str
+    bad: tuple
+    total: tuple
+    target_rate: float = 0.01
+    windows: tuple = (60.0, 300.0)
+    burn_threshold: float = 1.0
+    min_events: int = 1
+    _history: deque = field(default_factory=lambda: deque(maxlen=4096),
+                            repr=False)
+
+    def __post_init__(self) -> None:
+        if isinstance(self.bad, str):
+            self.bad = (self.bad,)
+        if isinstance(self.total, str):
+            self.total = (self.total,)
+        if not 0.0 < self.target_rate < 1.0:
+            raise ValueError(
+                f"target_rate must be in (0, 1), got {self.target_rate}")
+
+
+class SLOEngine:
+    """Evaluate declared objectives against the live registry.
+
+    One engine per process is the normal shape (the HTTP ``/slo``
+    endpoint and ``ServingMetrics`` report through the same instance);
+    private engines (tests) take their own registry/events/tracer.
+    """
+
+    def __init__(self, *, registry=None, events=None, tracer=None) -> None:
+        self._registry = registry if registry is not None else get_registry()
+        self._events = events if events is not None else get_event_log()
+        if tracer is None:
+            from chainermn_tpu.monitor.trace import get_tracer
+
+            tracer = get_tracer()
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._objectives: list = []
+        self._breached: dict[str, bool] = {}   # edge-triggered breach state
+        self._last: dict = {}
+
+    def add(self, objective) -> "SLOEngine":
+        if not isinstance(objective, (LatencyObjective, ErrorRateObjective)):
+            raise TypeError(
+                f"expected LatencyObjective or ErrorRateObjective, got "
+                f"{type(objective).__name__}")
+        with self._lock:
+            if any(o.name == objective.name for o in self._objectives):
+                raise ValueError(f"objective {objective.name!r} already "
+                                 "declared")
+            self._objectives.append(objective)
+        return self
+
+    @property
+    def objectives(self) -> list:
+        with self._lock:
+            return list(self._objectives)
+
+    # -- registry reads ---------------------------------------------------- #
+
+    def _histograms_named(self, name: str) -> list:
+        from chainermn_tpu.monitor.registry import Histogram
+
+        with self._registry._lock:
+            insts = list(self._registry._instruments.values())
+        return [i for i in insts
+                if isinstance(i, Histogram) and i.name == name]
+
+    def _counter_sum(self, names: tuple) -> int:
+        from chainermn_tpu.monitor.registry import Counter
+
+        with self._registry._lock:
+            insts = list(self._registry._instruments.values())
+        return sum(int(i.value) for i in insts
+                   if isinstance(i, Counter) and i.name in names)
+
+    # -- evaluation -------------------------------------------------------- #
+
+    def _eval_latency(self, obj: LatencyObjective, now: float) -> dict:
+        hists = self._histograms_named(obj.metric)
+        allowed = 1.0 - obj.target_quantile
+        per_window = {}
+        for w in obj.windows:
+            samples: list = []
+            for h in hists:
+                samples.extend(h.recent(w, now=now))
+            if len(samples) < obj.min_samples:
+                per_window[w] = {"samples": len(samples), "bad_frac": 0.0,
+                                 "burn_rate": 0.0}
+                continue
+            bad = sum(1 for s in samples if s > obj.threshold_s)
+            frac = bad / len(samples)
+            per_window[w] = {"samples": len(samples),
+                             "bad_frac": round(frac, 6),
+                             "burn_rate": round(frac / allowed, 4)}
+        return per_window
+
+    def _eval_error_rate(self, obj: ErrorRateObjective, now: float) -> dict:
+        bad = self._counter_sum(obj.bad)
+        total = self._counter_sum(obj.total)
+        obj._history.append((now, bad, total))
+        per_window = {}
+        for w in obj.windows:
+            cutoff = now - w
+            # the oldest snapshot still inside the window anchors the delta
+            anchor = None
+            for t, b, n in obj._history:
+                if t >= cutoff:
+                    anchor = (b, n)
+                    break
+            if anchor is None:
+                anchor = (bad, total)
+            d_bad = bad - anchor[0]
+            d_total = total - anchor[1]
+            if d_total < obj.min_events:
+                per_window[w] = {"events": d_total, "bad": d_bad,
+                                 "rate": 0.0, "burn_rate": 0.0}
+                continue
+            rate = d_bad / d_total
+            per_window[w] = {"events": d_total, "bad": d_bad,
+                             "rate": round(rate, 6),
+                             "burn_rate": round(rate / obj.target_rate, 4)}
+        return per_window
+
+    def _offending_traces(self, obj, window_s: float,
+                          limit: int = 16) -> list[str]:
+        """Trace ids the breach should name: retained traces that ended
+        inside the window and are slow past the objective's threshold,
+        errored, or deadline-missed — the join key into ``/traces``."""
+        since = time.perf_counter() - float(window_s)
+        ids = []
+        threshold = getattr(obj, "threshold_s", None)
+        for t in self._tracer.finished(since=since):
+            slow = threshold is not None and t.duration_s > threshold
+            if slow or t.error is not None or t.deadline_miss:
+                ids.append(t.trace_id)
+        return ids[-limit:]
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """One evaluation pass: per-objective per-window burn rates,
+        ``compliant`` verdicts, registry gauges updated, and an
+        edge-triggered ``slo_breach`` event (+ ``slo_breaches_total``)
+        when an objective newly exceeds its burn threshold in EVERY
+        window. ``now`` (``time.monotonic()`` scale) is injectable for
+        tests."""
+        now = time.monotonic() if now is None else float(now)
+        report: dict = {}
+        for obj in self.objectives:
+            if isinstance(obj, LatencyObjective):
+                per_window = self._eval_latency(obj, now)
+            else:
+                per_window = self._eval_error_rate(obj, now)
+            burns = [per_window[w]["burn_rate"] for w in obj.windows]
+            breached = bool(burns) and all(
+                b > obj.burn_threshold for b in burns)
+            for w in obj.windows:
+                self._registry.gauge(
+                    "slo_burn_rate",
+                    {"slo": obj.name, "window": f"{w:g}s"},
+                ).set(per_window[w]["burn_rate"])
+            self._registry.gauge(
+                "slo_compliant", {"slo": obj.name}).set(0.0 if breached
+                                                        else 1.0)
+            entry = {
+                "kind": ("latency" if isinstance(obj, LatencyObjective)
+                         else "error_rate"),
+                "windows": {f"{w:g}s": per_window[w] for w in obj.windows},
+                "max_burn_rate": round(max(burns, default=0.0), 4),
+                "burn_threshold": obj.burn_threshold,
+                "compliant": not breached,
+            }
+            if isinstance(obj, LatencyObjective):
+                entry["threshold_s"] = obj.threshold_s
+                entry["target_quantile"] = obj.target_quantile
+            else:
+                entry["target_rate"] = obj.target_rate
+            was = self._breached.get(obj.name, False)
+            if breached and not was:
+                traces = self._offending_traces(obj, max(obj.windows))
+                entry["offending_traces"] = traces
+                self._registry.counter(
+                    "slo_breaches_total", {"slo": obj.name}).inc()
+                self._events.emit(
+                    "slo_breach", slo=obj.name,
+                    max_burn_rate=entry["max_burn_rate"],
+                    windows={f"{w:g}s": per_window[w]["burn_rate"]
+                             for w in obj.windows},
+                    traces=traces)
+            elif breached:
+                entry["offending_traces"] = self._offending_traces(
+                    obj, max(obj.windows))
+            self._breached[obj.name] = breached
+            report[obj.name] = entry
+        with self._lock:
+            self._last = report
+        return report
+
+    @property
+    def last(self) -> dict:
+        """The most recent :meth:`evaluate` result (the ``/slo`` payload
+        when the endpoint prefers not to re-evaluate)."""
+        with self._lock:
+            return dict(self._last)
+
+    # -- fleet pooling ------------------------------------------------------ #
+
+    def aggregate(self, comm) -> dict:
+        """Pool burn rates across ranks over the communicator's object
+        transport: per objective/window the fleet MEAN (the pooled burn —
+        what a router budgets against) and MAX (the worst replica — what
+        it routes away from). Every rank returns the same dict."""
+        local = {
+            name: {w: ent["burn_rate"]
+                   for w, ent in entry["windows"].items()}
+            for name, entry in self.last.items()
+        }
+        gathered = comm.allgather_obj(local)
+        out: dict = {"ranks": len(gathered)}
+        names = {n for g in gathered for n in g}
+        for name in sorted(names):
+            windows: dict = {}
+            for g in gathered:
+                for w, b in g.get(name, {}).items():
+                    windows.setdefault(w, []).append(float(b))
+            out[name] = {
+                w: {"mean_burn_rate": round(sum(v) / len(v), 4),
+                    "max_burn_rate": round(max(v), 4)}
+                for w, v in windows.items()
+            }
+        return out
+
+
+_ENGINE: Optional[SLOEngine] = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def get_slo_engine() -> SLOEngine:
+    """The process-wide default :class:`SLOEngine` (lazily built; the
+    HTTP ``/slo`` endpoint and example flags share it)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None:
+            _ENGINE = SLOEngine()
+        return _ENGINE
+
+
+__all__ = [
+    "ErrorRateObjective",
+    "LatencyObjective",
+    "SLOEngine",
+    "get_slo_engine",
+]

@@ -36,6 +36,7 @@ import time
 from typing import Callable, Optional
 
 from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.monitor.trace import get_tracer
 from chainermn_tpu.resilience.faults import inject
 from chainermn_tpu.resilience.retry import RetryPolicy
 
@@ -110,14 +111,24 @@ class ResilientTrainer:
         self._c_restores = reg.counter("trainer_restores_total")
         self._h_mttr = reg.histogram("trainer_mttr_seconds", unit="s")
         self._events = get_event_log()
+        self._tracer = get_tracer()
+        # one trace per failure EPISODE (first failure -> first completed
+        # post-restore step): its root span IS the MTTR interval, its
+        # children attribute the recovery (flight dump, snapshot load,
+        # replay). Error-marked, so sampling never drops it.
+        self._episode = None
 
     # -- checkpoint plumbing --------------------------------------------- #
 
     def _save(self, state, iterator, iteration: int) -> None:
-        snap = {"state": state, "iterator": iterator.state_dict()}
-        save = (self.checkpointer.save_async if self.async_save
-                else self.checkpointer.save)
-        self.retry.call(save, snap, iteration, op="checkpoint.save")
+        # ambient span: with async_save the enqueue (device_get) is the
+        # only critical-path cost and the step trace shows exactly it
+        with self._tracer.span("checkpoint_enqueue", iteration=iteration,
+                               asynchronous=self.async_save):
+            snap = {"state": state, "iterator": iterator.state_dict()}
+            save = (self.checkpointer.save_async if self.async_save
+                    else self.checkpointer.save)
+            self.retry.call(save, snap, iteration, op="checkpoint.save")
         self._events.emit("trainer_snapshot", iteration=iteration,
                           asynchronous=self.async_save)
 
@@ -128,6 +139,16 @@ class ResilientTrainer:
     def _restore_state(self, state):
         return state if self.restore_hook is None else \
             self.restore_hook(state)
+
+    def _episode_label(self) -> dict:
+        if self._episode is not None and self._episode.enabled:
+            return {"trace": self._episode.trace_id}
+        return {}
+
+    def _finish_episode(self, **labels) -> None:
+        if self._episode is not None:
+            self._episode.finish(**labels)
+            self._episode = None
 
     # -- the loop -------------------------------------------------------- #
 
@@ -153,47 +174,78 @@ class ResilientTrainer:
         t_fail: Optional[float] = None
         i = start
         while i < n_steps:
-            try:
-                inject("trainer.step", step=i)
-                batch = next(iterator)
-                state = self.step_fn(state, batch)
-            except Exception as e:  # noqa: BLE001 — the recovery boundary
-                failures += 1
-                self._c_failures.inc()
-                self._events.emit("trainer_failure", step=i,
-                                  error=type(e).__name__, detail=str(e)[:200])
-                if t_fail is None:
-                    t_fail = time.perf_counter()
-                if self.dump_on_failure:
-                    get_event_log().dump(file=sys.stderr, once="failure")
-                if restores >= self.max_restores:
-                    self._events.emit("trainer_giving_up", step=i,
-                                      restores=restores)
-                    raise
-                loaded, it_r = self._load()
-                if loaded is None:
-                    raise  # no snapshot anywhere: nothing to restore
-                state = self._restore_state(loaded["state"])
-                iterator.load_state_dict(loaded["iterator"])
-                i = it_r
-                restores += 1
-                self._c_restores.inc()
-                self._events.emit("trainer_restore", iteration=it_r,
-                                  restores=restores)
-                get_event_log().reset_dump_guard()  # next failure dumps anew
-                continue
-            if t_fail is not None:
-                dt = time.perf_counter() - t_fail
-                mttr.append(dt)
-                self._h_mttr.observe(dt)
-                self._events.emit("trainer_recovered", step=i,
-                                  mttr_s=round(dt, 6))
-                t_fail = None
-            if on_step is not None:
-                on_step(i, state)
-            i += 1
-            if i % self.save_every == 0 or i == n_steps:
-                self._save(state, iterator, i)
+            # per-step span tree (ambient): prefetch-wait, dispatch, and
+            # — on saving steps — the checkpoint enqueue, same taxonomy
+            # as training.fit; a failed step's trace is error-marked so
+            # sampling keeps it
+            with self._tracer.trace("train_step", kind="train", step=i,
+                                    loop="resilient") as step_tr:
+                try:
+                    inject("trainer.step", step=i)
+                    with self._tracer.span("prefetch_wait"):
+                        batch = next(iterator)
+                    with self._tracer.span("dispatch"):
+                        state = self.step_fn(state, batch)
+                except Exception as e:  # noqa: BLE001 — recovery boundary
+                    step_tr.mark_error(type(e).__name__)
+                    failures += 1
+                    self._c_failures.inc()
+                    if t_fail is None:
+                        # first failure of the episode: open the MTTR
+                        # trace (root = failure -> first recovered step)
+                        t_fail = time.perf_counter()
+                        self._episode = self._tracer.trace(
+                            "failure_episode", kind="resilience", step=i,
+                            error=type(e).__name__)
+                        self._episode.mark_error(type(e).__name__)
+                    ep = self._episode
+                    self._events.emit("trainer_failure", step=i,
+                                      error=type(e).__name__,
+                                      detail=str(e)[:200],
+                                      **self._episode_label())
+                    if self.dump_on_failure:
+                        with ep.span("flight_dump"):
+                            get_event_log().dump(file=sys.stderr,
+                                                 once="failure")
+                    if restores >= self.max_restores:
+                        self._events.emit("trainer_giving_up", step=i,
+                                          restores=restores,
+                                          **self._episode_label())
+                        self._finish_episode(gave_up=True)
+                        raise
+                    with ep.span("restore", attempt=restores + 1):
+                        loaded, it_r = self._load()
+                        if loaded is None:
+                            # no snapshot anywhere: nothing to restore
+                            self._finish_episode(gave_up=True)
+                            raise
+                        state = self._restore_state(loaded["state"])
+                        iterator.load_state_dict(loaded["iterator"])
+                    i = it_r
+                    restores += 1
+                    self._c_restores.inc()
+                    self._events.emit("trainer_restore", iteration=it_r,
+                                      restores=restores,
+                                      **self._episode_label())
+                    get_event_log().reset_dump_guard()  # next dump is new
+                    continue
+                if t_fail is not None:
+                    dt = time.perf_counter() - t_fail
+                    mttr.append(dt)
+                    self._h_mttr.observe(dt)
+                    self._events.emit("trainer_recovered", step=i,
+                                      mttr_s=round(dt, 6),
+                                      **self._episode_label())
+                    # the episode's root span closes HERE: its duration
+                    # IS the MTTR (failure -> first completed step)
+                    self._finish_episode(mttr_s=round(dt, 6),
+                                         recovered_step=i)
+                    t_fail = None
+                if on_step is not None:
+                    on_step(i, state)
+                i += 1
+                if i % self.save_every == 0 or i == n_steps:
+                    self._save(state, iterator, i)
         if self.async_save:
             # end-of-run barrier: the final snapshot must be durable (and
             # any writer failure loud) before the run reports success

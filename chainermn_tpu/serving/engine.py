@@ -329,12 +329,14 @@ class ServingEngine:
                 keys, NamedSharding(self._comm.mesh, P()))
         return keys
 
-    def _watched(self, label: str):
+    def _watched(self, label: str, **ctx):
         """Watchdog context for one device-program call (no-op when hang
-        detection is off)."""
+        detection is off). ``ctx`` carries request/trace identity from
+        the scheduler, so a fire names WHOSE work wedged — the
+        flight-recorder dump then joins against exported traces."""
         if self.watchdog is None:
             return contextlib.nullcontext()
-        return self.watchdog.step(label)
+        return self.watchdog.step(label, **ctx)
 
     @property
     def prefix_enabled(self) -> bool:
@@ -663,7 +665,8 @@ class ServingEngine:
                           prefill_batch=k,
                           prefix=self.prefix_cache is not None)
 
-    def prefill(self, prompt: np.ndarray, rng) -> tuple[int, int]:
+    def prefill(self, prompt: np.ndarray, rng,
+                ctx: Optional[dict] = None) -> tuple[int, int]:
         """Admit one prompt into a free slot (no prefix reuse — the PR-1
         surface): runs the smallest covering bucket's compiled prefill,
         returns ``(slot, first_token)``. ``rng`` is the request's own PRNG
@@ -675,10 +678,12 @@ class ServingEngine:
         bucket = self.bucket_for(len(prompt))
         plan = AdmitPlan(prompt=prompt, rng=rng, match=None, start=0,
                          bucket=bucket)
-        return self.admit_batch([plan], point="serving.prefill")[0]
+        return self.admit_batch([plan], point="serving.prefill",
+                                ctx=ctx)[0]
 
     def admit_batch(self, plans: Sequence[AdmitPlan], *,
-                    point: str = "serving.prefill_batch"
+                    point: str = "serving.prefill_batch",
+                    ctx: Optional[dict] = None
                     ) -> list[tuple[int, int]]:
         """Admit a same-bucket group in ONE batched prefill call (plus one
         prefix-fetch copy per cached member, before): returns ``[(slot,
@@ -714,7 +719,7 @@ class ServingEngine:
         n_cached = sum(p.match is not None for p in plans)
         try:
             try:
-                with self._watched("serving prefill"), \
+                with self._watched("serving prefill", **(ctx or {})), \
                         annotate("chainermn.serving_prefill"):
                     if n_cached:
                         inject("serving.prefix_copy", op="fetch",
@@ -853,16 +858,17 @@ class ServingEngine:
             self._store = self._init_store()
         self.prefix_cache.clear()
 
-    def decode_step(self) -> dict[int, int]:
+    def decode_step(self, ctx: Optional[dict] = None) -> dict[int, int]:
         """Advance every active slot one token (ONE compiled call for the
         whole pool); returns ``{slot: token}`` for the active slots. No-op
-        ({}) when nothing is active."""
+        ({}) when nothing is active. ``ctx`` (request/trace ids from the
+        scheduler) labels the watchdog window."""
         if not self._active.any():
             return {}
         # the fetch (np.asarray) is inside the watchdog window on purpose:
         # a wedged collective hangs exactly there, and that is the hang
         # the serving watchdog exists to turn into a loud abort
-        with self._watched("serving decode_step"), \
+        with self._watched("serving decode_step", **(ctx or {})), \
                 annotate("chainermn.serving_decode"):
             inject("serving.decode", active=int(self._active.sum()))
             self.caches, nxt, self._keys = self._decode_fn(

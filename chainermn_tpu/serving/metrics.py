@@ -93,6 +93,11 @@ class ServingMetrics:
         self._g_active = reg.gauge("serving_active_slots", labels)
         self._t_first_token: Optional[float] = None
         self._t_last_token: Optional[float] = None
+        # per-trace critical path (the tracing layer): phase-attributed
+        # time per retired request, plus the single worst request's full
+        # breakdown — the "where did the p99 go" exhibit in report()
+        self._labels = labels
+        self._worst_trace: Optional[dict] = None
 
     # ------------------------------------------------------------------ #
     # recording (scheduler-driven)                                        #
@@ -142,6 +147,23 @@ class ServingMetrics:
 
     def record_restart(self) -> None:
         self._c_restarts.inc()
+
+    def record_trace(self, req_id: int, breakdown: dict) -> None:
+        """One retired request's span-tree breakdown (built by
+        :meth:`~chainermn_tpu.monitor.trace.Trace.breakdown`): each phase
+        feeds a ``trace_phase_seconds{phase=}`` histogram (so queue wait
+        vs prefill vs decode distributions are scrapeable), and the
+        slowest request so far is kept whole as the critical-path
+        exemplar."""
+        phases = breakdown.get("phases_s", {})
+        for phase, dur in phases.items():
+            self._registry.histogram(
+                "trace_phase_seconds", dict(self._labels, phase=phase),
+                unit="s").observe(dur)
+        total = breakdown.get("total_s", 0.0)
+        if (self._worst_trace is None
+                or total > self._worst_trace.get("total_s", 0.0)):
+            self._worst_trace = dict(breakdown, req=req_id)
 
     def record_step(self, queue_depth: int, active_slots: int) -> None:
         self._h_queue.observe(queue_depth)
@@ -234,6 +256,10 @@ class ServingMetrics:
             out[f"{prefix}_mean"] = round(float(t.mean()), 3)
             out[f"{prefix}_p50"] = round(float(np.percentile(t, 50)), 3)
             out[f"{prefix}_p99"] = round(float(np.percentile(t, 99)), 3)
+        if self._worst_trace is not None:
+            # the slowest traced request's full phase attribution — the
+            # compact "where the p99 TTFT went" answer, per trace
+            out["critical_path"] = self._worst_trace
         return out
 
 

@@ -50,7 +50,17 @@ Graceful degradation (the resilience layer):
 
 Every transition is observable: ``reject`` / ``shed`` / ``engine_error``
 / ``engine_restart`` events in the flight recorder and matching
-``ServingMetrics`` registry counters.
+``ServingMetrics`` registry counters. Every submission also opens a
+request-scoped :class:`~chainermn_tpu.monitor.trace.Trace` that rides
+the request end to end — ``queue`` (submit -> popped), ``admit`` (host
+planning), ``prefill`` (the batched device call, attributed to every
+group member with bucket/batch/cached labels), one ``decode_step`` span
+per decode call it participates in, closed at retire/shed/error with the
+reason. Shed and errored requests are retained regardless of the
+tracer's sampling, lifecycle events carry ``trace=`` ids, the watchdog
+window around every device call is labelled with the in-flight
+request/trace ids, and each retired trace's critical-path breakdown
+feeds ``ServingMetrics.report()["critical_path"]``.
 
 Thread model: ``submit``/``cancel`` are safe from any thread (they only
 touch the locked queue and request state); ``step`` must be driven from
@@ -74,6 +84,7 @@ import numpy as np
 
 from chainermn_tpu.monitor import annotate
 from chainermn_tpu.monitor._state import get_event_log
+from chainermn_tpu.monitor.trace import NULL_TRACE, get_tracer
 from chainermn_tpu.resilience.retry import RetryPolicy
 from chainermn_tpu.serving.engine import EngineStateError
 from chainermn_tpu.serving.metrics import ServingMetrics
@@ -120,6 +131,11 @@ class Request:
     t_submit: float = 0.0
     t_deadline: Optional[float] = None
     t_last_token: float = 0.0
+    # request-scoped trace context: rides the request through queue ->
+    # admit -> prefill -> decode -> retire (NULL_TRACE when tracing off)
+    trace: object = NULL_TRACE
+    _span_queue: object = None
+    _span_admit: object = None
     _done: threading.Event = field(default_factory=threading.Event)
 
     @property
@@ -188,7 +204,8 @@ class FCFSScheduler:
                  retry: Optional[RetryPolicy] = None,
                  restart_on_error: bool = True,
                  max_restarts: int = 8,
-                 max_prefills_per_step: Optional[int] = None) -> None:
+                 max_prefills_per_step: Optional[int] = None,
+                 tracer=None) -> None:
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.engine = engine
@@ -211,6 +228,11 @@ class FCFSScheduler:
             max_prefills_per_step = 1 if self._cost_aware else None
         self._max_prefills = max_prefills_per_step
         self._events = get_event_log()
+        # request-scoped tracing: every submission opens a Trace that
+        # rides the request through its whole lifecycle; the tracer's
+        # sampling (and forced retention on shed/error) decides what the
+        # ring keeps. NULL_TRACE when tracing is disabled.
+        self._tracer = tracer if tracer is not None else get_tracer()
         self._queue: deque[Request] = deque()
         self._by_slot: dict[int, Request] = {}
         self._lock = threading.Lock()
@@ -248,9 +270,24 @@ class FCFSScheduler:
             req.id = next(self._ids)
             self._queue.append(req)
             self.metrics.record_submit()
+        # the trace opens HERE (admitted to the queue): root span =
+        # submit -> retire; first child = queue wait, closed when the
+        # request is popped for admission
+        req.trace = self._tracer.trace(
+            "request", kind="serving", req=req.id, prompt_len=len(prompt),
+            max_new=int(max_new_tokens))
+        req._span_queue = req.trace.start_span("queue")
         self._events.emit("submit", req=req.id, prompt_len=len(prompt),
-                          max_new=int(max_new_tokens))
+                          max_new=int(max_new_tokens),
+                          **self._trace_label(req))
         return req
+
+    @staticmethod
+    def _trace_label(req: Request) -> dict:
+        """``{"trace": id}`` when the request is traced, else ``{}`` —
+        the join key flight-recorder events carry so dumps line up
+        against exported span trees."""
+        return {"trace": req.trace.trace_id} if req.trace.enabled else {}
 
     def cancel(self, req: Request) -> bool:
         """Cancel a request: dequeued if still QUEUED, slot freed if
@@ -271,7 +308,8 @@ class FCFSScheduler:
             req.state = RequestState.CANCELLED
             self.metrics.record_done(cancelled=True)
         self._events.emit("slot_retire", req=req.id, slot=req.slot,
-                          reason="cancelled")
+                          reason="cancelled", **self._trace_label(req))
+        req.trace.finish(reason="cancelled")
         req._done.set()
         return True
 
@@ -313,18 +351,25 @@ class FCFSScheduler:
                 calls += 1
                 emitted += self._admit_group(group)
         # 2. decode: every active slot, one token, one compiled call
+        t_dec0 = time.perf_counter()
         try:
-            decoded = self.engine.decode_step()
+            decoded = self.engine.decode_step(ctx=self._flight_ctx())
         except Exception as e:  # noqa: BLE001 — degradation boundary
             if not self._engine_failure(e):
                 raise
             decoded = {}
+        t_dec1 = time.perf_counter()
         for slot, tok in decoded.items():
             req = self._by_slot.get(slot)
             if req is None:            # released mid-flight (cancelled)
                 continue
             now = time.perf_counter()
             self.metrics.record_token(req.t_last_token, now)
+            # the shared decode call, attributed to every participant:
+            # one decode_step span per request per step (token index in
+            # the labels), bounded by the trace's span cap
+            req.trace.add_span("decode_step", t_dec0, t_dec1,
+                               token=len(req.tokens))
             self._deliver(req, tok, now)
             emitted += 1
         # deferred prefix-cache inserts run AFTER this step's tokens were
@@ -366,6 +411,7 @@ class FCFSScheduler:
                 return []
             head = self._queue.popleft()
             head.state = RequestState.PREFILL
+        self._span_to_admit(head)
         plan = eng.plan_admission(head.prompt, head.rng)
         group = [(head, plan)]
         if cap <= 1:
@@ -391,10 +437,33 @@ class FCFSScheduler:
                         eng.cancel_plan(p)
                         continue
                     req.state = RequestState.PREFILL
+                self._span_to_admit(req)
                 group.append((req, p))
             else:
                 eng.cancel_plan(p)
         return group
+
+    def _span_to_admit(self, req: Request) -> None:
+        """Queue wait is over: close the request's ``queue`` span and open
+        ``admit`` (host-side planning + group assembly, closed when the
+        prefill device call starts)."""
+        if req._span_queue is not None:
+            req.trace.end_span(req._span_queue)
+            req._span_queue = None
+        req._span_admit = req.trace.start_span("admit")
+
+    def _flight_ctx(self) -> dict:
+        """Request/trace identity of the in-flight slots — the labels the
+        engine threads into its watchdog window so a hang dump names WHO
+        was decoding, not just that decode wedged."""
+        reqs = list(self._by_slot.values())
+        if not reqs:
+            return {}
+        ctx = {"reqs": [r.id for r in reqs]}
+        traces = [r.trace.trace_id for r in reqs if r.trace.enabled]
+        if traces:
+            ctx["traces"] = traces
+        return ctx
 
     def _admit_group(self, group: list) -> int:
         """Drive one group through the engine (legacy single-request path
@@ -405,6 +474,15 @@ class FCFSScheduler:
         plans = [p for _, p in group]
         legacy = (len(group) == 1 and plans[0].match is None
                   and not self.engine.prefix_enabled)
+        ctx = {"reqs": [r.id for r in reqs]}
+        traces = [r.trace.trace_id for r in reqs if r.trace.enabled]
+        if traces:
+            ctx["traces"] = traces
+        t_pre0 = time.perf_counter()
+        for req in reqs:               # planning done; the device call next
+            if req._span_admit is not None:
+                req.trace.end_span(req._span_admit)
+                req._span_admit = None
         try:
             if legacy:
                 self.engine.cancel_plan(plans[0])
@@ -412,16 +490,17 @@ class FCFSScheduler:
                 if self._retry is not None:
                     results = [self._retry.call(
                         self.engine.prefill, req.prompt, req.rng,
-                        op="serving.prefill")]
+                        op="serving.prefill", ctx=ctx)]
                 else:
-                    results = [self.engine.prefill(req.prompt, req.rng)]
+                    results = [self.engine.prefill(req.prompt, req.rng,
+                                                   ctx=ctx)]
             else:
                 if self._retry is not None:
                     results = self._retry.call(
                         self.engine.admit_batch, plans,
-                        op="serving.prefill_batch")
+                        op="serving.prefill_batch", ctx=ctx)
                 else:
-                    results = self.engine.admit_batch(plans)
+                    results = self.engine.admit_batch(plans, ctx=ctx)
         except Exception as e:  # noqa: BLE001 — degradation boundary
             if not legacy and not isinstance(e, EngineStateError):
                 # the device state is intact (admit_batch re-raises as
@@ -433,10 +512,15 @@ class FCFSScheduler:
             if not self._engine_failure(e, admitting=reqs):
                 raise
             return 0  # engine restarted: keep serving the queue
+        t_pre1 = time.perf_counter()
         emitted = 0
         self.metrics.record_admission(len(group))
         for (req, plan), (slot, first) in zip(group, results):
             now = time.perf_counter()
+            # the shared batched device call, attributed to every member
+            req.trace.add_span("prefill", t_pre0, t_pre1,
+                               bucket=plan.bucket, batch=len(group),
+                               cached=plan.start, slot=slot)
             with self._lock:
                 if req.state is RequestState.CANCELLED:
                     # cancelled while its prefill was in flight (it had
@@ -449,7 +533,8 @@ class FCFSScheduler:
             self._events.emit("slot_admit", req=req.id, slot=slot,
                               prompt_len=len(req.prompt),
                               bucket=plan.bucket, cached=plan.start,
-                              queue_depth=self.queue_depth)
+                              queue_depth=self.queue_depth,
+                              **self._trace_label(req))
             self.metrics.record_first_token(req.t_submit, now,
                                             req_id=req.id,
                                             cached_frac=plan.cached_frac)
@@ -473,8 +558,12 @@ class FCFSScheduler:
                 req.state = RequestState.ERRORED
                 self.metrics.record_errored()
         self._events.emit("admission_error", error=type(e).__name__,
-                          detail=str(e)[:200], group=len(reqs))
+                          detail=str(e)[:200], group=len(reqs),
+                          traces=[r.trace.trace_id for r in reqs
+                                  if r.trace.enabled])
         for req in reqs:
+            req.trace.mark_error(type(e).__name__)
+            req.trace.finish(reason="admission_error")
             req._done.set()
 
     # ------------------------------------------------------------------ #
@@ -504,8 +593,14 @@ class FCFSScheduler:
                     keep.append(req)
             self._queue = keep
         for req in expired:
+            # deadline-missed traces are retained regardless of sampling
+            # (always-sample-on-deadline-miss): exactly the requests an
+            # SLO breach will want to name
+            req.trace.mark_deadline_miss()
+            req.trace.finish(reason="shed")
             self._events.emit("shed", req=req.id,
-                              waited_s=round(now - req.t_submit, 6))
+                              waited_s=round(now - req.t_submit, 6),
+                              **self._trace_label(req))
             req._done.set()
 
     def _engine_failure(self, e: BaseException,
@@ -537,9 +632,13 @@ class FCFSScheduler:
                 req.state = RequestState.ERRORED
                 self.metrics.record_errored()
         self._events.emit("engine_error", error=type(e).__name__,
-                          detail=str(e)[:200], in_flight=len(victims))
+                          detail=str(e)[:200], in_flight=len(victims),
+                          traces=[r.trace.trace_id for r in victims
+                                  if r.trace.enabled])
         get_event_log().dump(file=sys.stderr, last=32, once="failure")
         for req in victims:
+            req.trace.mark_error(type(e).__name__)
+            req.trace.finish(reason="engine_error")
             req._done.set()
         if not self._restart_on_error or self._restarts >= self._max_restarts:
             return False
@@ -575,7 +674,13 @@ class FCFSScheduler:
             req.state = RequestState.DONE
             self.metrics.record_done()
         self._events.emit("slot_retire", req=req.id, slot=req.slot,
-                          reason=reason, tokens=len(req.tokens))
+                          reason=reason, tokens=len(req.tokens),
+                          **self._trace_label(req))
+        req.trace.finish(reason=reason, tokens=len(req.tokens))
+        if req.trace.enabled:
+            # per-trace critical path into the metrics surface: where the
+            # slowest request actually spent its time
+            self.metrics.record_trace(req.id, req.trace.breakdown())
         req._done.set()
 
 

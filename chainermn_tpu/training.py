@@ -519,8 +519,18 @@ def fit(
     as floats, in step order; the trailing drain doubles as the loop's
     completion barrier, so on return all ``n_steps`` steps have finished
     on device.
+
+    Every step runs inside a ``train_step`` trace (the monitor's tracing
+    layer): child spans attribute the wall time to ``prefetch_wait``
+    (drawing the batch — a stall here means the input pipeline is the
+    bottleneck), ``dispatch`` (enqueueing the device step — async, so
+    normally microseconds), and ``loss_fetch`` (the batched host round
+    trip the loss window pays once per ``fetch_every`` steps). Sampled
+    per the default tracer's config; disabled tracing costs one no-op
+    call per step.
     """
     from chainermn_tpu.dataflow import DevicePrefetcher, LossWindow
+    from chainermn_tpu.monitor.trace import get_tracer
 
     prefetcher = None
     if prefetch_depth:
@@ -529,12 +539,18 @@ def fit(
             transform=transform, name=name)
     it = data if hasattr(data, "__next__") else iter(data)
     window = LossWindow(fetch_every, name=name, on_fetch=on_loss)
+    tracer = get_tracer()
     try:
         for i in range(n_steps):
-            x, y = next(it)
-            out = step(variables, opt_state, x, y)
-            variables, opt_state = out[0], out[1]
-            window.push(i, out[2])
+            with tracer.trace("train_step", kind="train", step=i,
+                              loop=name):
+                with tracer.span("prefetch_wait"):
+                    x, y = next(it)
+                with tracer.span("dispatch"):
+                    out = step(variables, opt_state, x, y)
+                variables, opt_state = out[0], out[1]
+                # a fetch inside push lands as a loss_fetch child span
+                window.push(i, out[2])
         losses = window.drain()
     finally:
         if prefetcher is not None:

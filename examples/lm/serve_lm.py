@@ -126,6 +126,23 @@ def main() -> None:
     ap.add_argument("--prometheus", action="store_true",
                     help="print the Prometheus text exposition of the "
                          "process metrics registry at the end")
+    ap.add_argument("--trace", type=int, default=1, metavar="N",
+                    help="trace every Nth request (span tree: queue -> "
+                         "admit -> prefill -> decode -> retire; "
+                         "shed/errored requests are always kept). "
+                         "0 disables tracing")
+    ap.add_argument("--trace-out", default="",
+                    help="write retained traces as Chrome trace-event "
+                         "JSON to this path — load it in "
+                         "chrome://tracing or ui.perfetto.dev")
+    ap.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                    help="declare a TTFT p99 SLO at this many ms and "
+                         "print the multi-window burn-rate evaluation "
+                         "at the end (0: no SLO)")
+    ap.add_argument("--http-port", type=int, default=-1,
+                    help="serve the monitor scrape endpoints (/metrics "
+                         "/traces /slo /events) on this port for the "
+                         "duration of the burst (0: ephemeral; -1: off)")
     args = ap.parse_args()
 
     comm = chainermn_tpu.create_communicator("tpu") if args.tensor_parallel \
@@ -158,6 +175,19 @@ def main() -> None:
         watchdog=args.watchdog or None,
     )
     engine.warmup()   # every bucket + decode compile once, off the burst
+
+    monitor.get_tracer().configure(sample=args.trace)
+    slo_engine = None
+    if args.slo_ttft_ms:
+        slo_engine = monitor.SLOEngine()
+        slo_engine.add(monitor.LatencyObjective(
+            "ttft_p99", "serving_ttft_seconds",
+            threshold_s=args.slo_ttft_ms / 1e3, windows=(30.0, 120.0)))
+    server = None
+    if args.http_port >= 0:
+        server = monitor.http.serve(port=args.http_port, slo=slo_engine)
+        print(f"monitor endpoints at {server.url} "
+              "(/metrics /traces /slo /events)")
     shared = (rng.randint(2, args.vocab, args.shared_prefix)
               .astype(np.int32) if args.shared_prefix else
               np.zeros((0,), np.int32))
@@ -217,6 +247,22 @@ def main() -> None:
             f"{k}={v}" for k, v in engine.prefix_stats().items()))
     print(f"engine executables: {engine.compile_counts_detailed()} "
           "(zero recompiles after warmup)")
+    if slo_engine is not None:
+        import json
+
+        ev = slo_engine.evaluate()
+        for name, entry in ev.items():
+            print(f"SLO {name}: compliant={entry['compliant']} "
+                  f"max_burn_rate={entry['max_burn_rate']} "
+                  f"windows={json.dumps(entry['windows'])}")
+    if args.trace_out:
+        tracer = monitor.get_tracer()
+        n = len(tracer.finished())
+        tracer.export_chrome(args.trace_out)
+        print(f"wrote {n} trace(s) to {args.trace_out} "
+              "(load in chrome://tracing or ui.perfetto.dev)")
+    if server is not None:
+        server.close()
     if args.prometheus:
         print("\n# process metrics registry (Prometheus exposition)")
         print(monitor.exposition(), end="")

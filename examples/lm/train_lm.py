@@ -33,8 +33,22 @@ import chainermn_tpu
 from chainermn_tpu.utils import apply_env_platform
 
 apply_env_platform()
+from chainermn_tpu import monitor  # noqa: E402
 from chainermn_tpu.models import TransformerLM  # noqa: E402
 from chainermn_tpu.training import jit_lm_train_step  # noqa: E402
+
+
+def _dump_traces(args) -> None:
+    """``--trace-out``: export whatever span trees the run retained
+    (training.fit and the resilient trainer trace every step through the
+    default tracer) as a Perfetto-loadable Chrome trace file."""
+    if not getattr(args, "trace_out", ""):
+        return
+    tracer = monitor.get_tracer()
+    n = len(tracer.finished())
+    tracer.export_chrome(args.trace_out)
+    print(f"wrote {n} trace(s) to {args.trace_out} "
+          "(load in chrome://tracing or ui.perfetto.dev)")
 
 
 def markov_stream(n_tokens: int, vocab: int, order: int = 2, seed: int = 0):
@@ -400,6 +414,12 @@ def main() -> None:
                              "fast path (bucketed batched prefill + "
                              "prefix KV reuse) — training-to-serving in "
                              "one script (plain/MoE modes; 0: off)")
+    parser.add_argument("--trace-out", default="",
+                        help="write the run's train-step span trees "
+                             "(prefetch-wait / dispatch / loss-fetch / "
+                             "checkpoint-enqueue) as Chrome trace-event "
+                             "JSON to this path — load in "
+                             "chrome://tracing or ui.perfetto.dev")
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--n-tokens", type=int, default=200_000)
     parser.add_argument("--max-len", type=int, default=None,
@@ -562,8 +582,10 @@ def main() -> None:
               f"tensor_parallel={args.tensor_parallel} devices={comm.size}")
 
     if args.resume:
-        return run_resilient(args, comm, step, params, opt_state,
-                             tokens_all, targets_all, n_seq, batch)
+        out = run_resilient(args, comm, step, params, opt_state,
+                            tokens_all, targets_all, n_seq, batch)
+        _dump_traces(args)
+        return out
 
     if args.prefetch_depth or args.fetch_every > 1:
         # the async hot loop: batches device_put by a producer thread,
@@ -595,6 +617,7 @@ def main() -> None:
                   f"{args.prefetch_depth}, fetch_every={args.fetch_every}),"
                   f" loss {losses[0]:.3f} -> {losses[-1]:.3f}  "
                   f"{tok_s:.0f} tok/s incl. compile")
+        _dump_traces(args)
         return
 
     from chainermn_tpu.parallel import MoeStatsAccumulator
@@ -603,11 +626,17 @@ def main() -> None:
     t0, toks = time.time(), 0
     first = last = None
     acc = MoeStatsAccumulator()
+    tracer = monitor.get_tracer()
     for it in range(1, args.iterations + 1):
-        tok, tgt = next(gen)
-        # uniform step arity: stats is {} for dense models
-        params, opt_state, loss, stats = step(
-            params, opt_state, jnp.asarray(tok), jnp.asarray(tgt))
+        # per-step span tree (same taxonomy as training.fit) so
+        # --trace-out has causal data even from the synchronous loop
+        with tracer.trace("train_step", kind="train", step=it):
+            with tracer.span("prefetch_wait"):
+                tok, tgt = next(gen)
+            # uniform step arity: stats is {} for dense models
+            with tracer.span("dispatch"):
+                params, opt_state, loss, stats = step(
+                    params, opt_state, jnp.asarray(tok), jnp.asarray(tgt))
         acc.update(stats)
         if it == 1:
             jax.block_until_ready(loss)
@@ -629,6 +658,7 @@ def main() -> None:
               f"loss {first:.3f} -> {last:.3f}{_drop_suffix(acc)}")
     if args.serve_samples:
         _serve_samples(args, comm, model, params, tokens_all)
+    _dump_traces(args)
 
 
 if __name__ == "__main__":

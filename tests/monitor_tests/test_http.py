@@ -1,0 +1,90 @@
+"""The HTTP scrape surface: all four endpoints answer over a real socket
+(ephemeral port, stdlib client), with private registry/events/tracer/slo
+instances so the tests are hermetic."""
+
+import json
+from urllib.error import HTTPError
+from urllib.request import urlopen
+
+import pytest
+
+from chainermn_tpu.monitor import http as monitor_http
+from chainermn_tpu.monitor.events import EventLog
+from chainermn_tpu.monitor.registry import MetricsRegistry
+from chainermn_tpu.monitor.slo import LatencyObjective, SLOEngine
+from chainermn_tpu.monitor.trace import Tracer
+
+
+@pytest.fixture()
+def stack():
+    reg = MetricsRegistry()
+    ev = EventLog()
+    tracer = Tracer(sample=1, ring=16)
+    slo = SLOEngine(registry=reg, events=ev, tracer=tracer)
+    srv = monitor_http.serve(port=0, registry=reg, events=ev,
+                             tracer=tracer, slo=slo)
+    try:
+        yield srv, reg, ev, tracer, slo
+    finally:
+        srv.close()
+
+
+def _get(srv, route):
+    return urlopen(srv.url + route, timeout=5).read()
+
+
+def test_metrics_endpoint_serves_prometheus_text(stack):
+    srv, reg, *_ = stack
+    reg.counter("served_total", {"inst": "0"}).inc(3)
+    body = _get(srv, "/metrics").decode()
+    assert "# TYPE served_total counter" in body
+    assert 'served_total{inst="0"} 3' in body
+
+
+def test_traces_endpoint_serves_chrome_json(stack):
+    srv, _, _, tracer, _ = stack
+    t = tracer.trace("request", kind="serving", req=1)
+    with t.span("queue"):
+        pass
+    t.finish()
+    tracer.trace("train_step", kind="train").finish()
+    out = json.loads(_get(srv, "/traces"))
+    assert {e["name"] for e in out["traceEvents"]} >= {"request", "queue"}
+    # kind filter narrows to one trace's rows
+    only = json.loads(_get(srv, "/traces?kind=train"))
+    names = {e["name"] for e in only["traceEvents"] if e["ph"] == "X"}
+    assert names == {"train_step"}
+
+
+def test_slo_endpoint_evaluates_on_scrape(stack):
+    srv, reg, _, _, slo = stack
+    slo.add(LatencyObjective("ttft", "ttft_seconds", threshold_s=0.1,
+                             windows=(60.0,)))
+    reg.histogram("ttft_seconds", unit="s").observe(0.5)
+    out = json.loads(_get(srv, "/slo"))
+    assert not out["ttft"]["compliant"]
+    # the scrape drove a real evaluation: the burn gauge is now set
+    assert reg.snapshot()["gauges"][
+        'slo_burn_rate{slo="ttft",window="60s"}'] > 1.0
+
+
+def test_events_endpoint_tails_flight_recorder(stack):
+    srv, _, ev, _, _ = stack
+    for i in range(5):
+        ev.emit("step_start", n=i)
+    out = json.loads(_get(srv, "/events?last=3"))
+    assert [e["n"] for e in out["events"]] == [2, 3, 4]
+
+
+def test_index_and_404(stack):
+    srv, *_ = stack
+    assert b"/metrics" in _get(srv, "/")
+    with pytest.raises(HTTPError) as ei:
+        _get(srv, "/nope")
+    assert ei.value.code == 404
+
+
+def test_close_is_idempotent(stack):
+    srv, *_ = stack
+    srv.close()
+    srv.close()

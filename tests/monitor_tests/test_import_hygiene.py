@@ -1,0 +1,72 @@
+"""Import-cycle guard: no ``chainermn_tpu.monitor`` module may import
+``chainermn_tpu.extensions`` at module level.
+
+``extensions/__init__`` imports ``checkpoint``, which imports the monitor
+package (registry counters + flight-recorder events on checkpoint I/O); a
+module-level import the other way closes the cycle and breaks whichever
+side loads second (PR 3 hit exactly this — ``registry.py`` now imports
+``latency_report`` lazily inside functions, and every monitor module
+added since must obey the same rule).
+
+Mechanism: a fresh subprocess stubs the ``chainermn_tpu`` parent package
+(so the top-level facade — which legitimately imports extensions — never
+runs), imports every monitor module, then asserts
+``chainermn_tpu.extensions`` is absent from ``sys.modules``. One
+subprocess covers all modules; it pins the property for future additions
+by globbing the package directory rather than hard-coding the list.
+"""
+
+import os
+import subprocess
+import sys
+
+import chainermn_tpu.monitor as monitor_pkg
+
+_SCRIPT = r"""
+import glob
+import importlib
+import os
+import sys
+import types
+
+pkg_dir = sys.argv[1]
+
+# Stub the parent package: submodule imports resolve against the real
+# directory, but the real chainermn_tpu/__init__.py (which imports
+# extensions by design) never executes — isolating exactly the property
+# under test: what the MONITOR modules themselves import.
+stub = types.ModuleType("chainermn_tpu")
+stub.__path__ = [os.path.dirname(pkg_dir)]
+sys.modules["chainermn_tpu"] = stub
+
+modules = sorted(
+    os.path.splitext(os.path.basename(p))[0]
+    for p in glob.glob(os.path.join(pkg_dir, "*.py"))
+)
+assert "trace" in modules and "slo" in modules and "http" in modules, \
+    f"glob missed the new modules: {modules}"
+for name in modules:
+    mod = "chainermn_tpu.monitor" if name == "__init__" else \
+        f"chainermn_tpu.monitor.{name}"
+    importlib.import_module(mod)
+    offenders = [m for m in sys.modules
+                 if m.startswith("chainermn_tpu.extensions")]
+    assert not offenders, (
+        f"importing {mod} pulled in {offenders} at module level — "
+        "chainermn_tpu.monitor must import extensions lazily (inside "
+        "functions) to avoid the extensions<->monitor cycle"
+    )
+print("clean:", len(modules), "modules")
+"""
+
+
+def test_monitor_modules_never_import_extensions_at_module_level():
+    pkg_dir = os.path.dirname(monitor_pkg.__file__)
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT, pkg_dir],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "clean:" in proc.stdout

@@ -1,0 +1,190 @@
+"""SLO engine: burn-rate math from registry histograms/counters,
+multi-window breach logic, breach events naming trace ids, gauges, and
+fleet aggregation."""
+
+import json
+
+import pytest
+
+from chainermn_tpu.monitor.events import EventLog
+from chainermn_tpu.monitor.registry import MetricsRegistry
+from chainermn_tpu.monitor.slo import (
+    ErrorRateObjective,
+    LatencyObjective,
+    SLOEngine,
+)
+from chainermn_tpu.monitor.trace import Tracer
+
+
+def make_engine():
+    reg, ev, tr = MetricsRegistry(), EventLog(), Tracer(sample=1, ring=32)
+    return SLOEngine(registry=reg, events=ev, tracer=tr), reg, ev, tr
+
+
+# --------------------------------------------------------------------- #
+# latency objectives                                                     #
+# --------------------------------------------------------------------- #
+
+def test_latency_burn_rate_and_breach():
+    eng, reg, ev, _ = make_engine()
+    eng.add(LatencyObjective("ttft", "serving_ttft_seconds",
+                             threshold_s=0.1, target_quantile=0.99,
+                             windows=(60.0, 300.0)))
+    h = reg.histogram("serving_ttft_seconds", {"instance": "0"}, unit="s")
+    for v in [0.01] * 8 + [0.5] * 2:   # 20% of requests over threshold
+        h.observe(v)
+    rep = eng.evaluate()
+    ent = rep["ttft"]
+    # bad_frac 0.2 / allowed 0.01 = burn 20 in BOTH windows -> breach
+    assert ent["windows"]["60s"]["burn_rate"] == pytest.approx(20.0)
+    assert ent["windows"]["300s"]["burn_rate"] == pytest.approx(20.0)
+    assert not ent["compliant"]
+    # gauges + breach counter published into the registry
+    snap = reg.snapshot()
+    assert snap["gauges"]['slo_burn_rate{slo="ttft",window="60s"}'] == \
+        pytest.approx(20.0)
+    assert snap["gauges"]['slo_compliant{slo="ttft"}'] == 0.0
+    assert snap["counters"]['slo_breaches_total{slo="ttft"}'] == 1
+    # edge-triggered: a second evaluation while still breached does not
+    # double-count the breach
+    eng.evaluate()
+    assert reg.snapshot()["counters"]['slo_breaches_total{slo="ttft"}'] == 1
+    breaches = [e for e in ev.tail() if e["kind"] == "slo_breach"]
+    assert len(breaches) == 1 and breaches[0]["slo"] == "ttft"
+
+
+def test_latency_compliant_when_under_budget():
+    eng, reg, ev, _ = make_engine()
+    eng.add(LatencyObjective("ttft", "serving_ttft_seconds",
+                             threshold_s=10.0))
+    h = reg.histogram("serving_ttft_seconds", unit="s")
+    for _ in range(20):
+        h.observe(0.01)
+    rep = eng.evaluate()
+    assert rep["ttft"]["compliant"]
+    assert rep["ttft"]["max_burn_rate"] == 0.0
+    assert not [e for e in ev.tail() if e["kind"] == "slo_breach"]
+
+
+def test_latency_pools_all_label_sets_of_the_metric():
+    eng, reg, _, _ = make_engine()
+    eng.add(LatencyObjective("ttft", "serving_ttft_seconds",
+                             threshold_s=0.1, windows=(60.0,)))
+    reg.histogram("serving_ttft_seconds", {"instance": "0"},
+                  unit="s").observe(0.5)
+    reg.histogram("serving_ttft_seconds", {"instance": "1"},
+                  unit="s").observe(0.5)
+    rep = eng.evaluate()
+    assert rep["ttft"]["windows"]["60s"]["samples"] == 2
+
+
+def test_empty_window_reports_zero_burn():
+    eng, _, _, _ = make_engine()
+    eng.add(LatencyObjective("ttft", "serving_ttft_seconds",
+                             threshold_s=0.1))
+    rep = eng.evaluate()
+    assert rep["ttft"]["compliant"]
+    assert rep["ttft"]["max_burn_rate"] == 0.0
+
+
+def test_breach_names_offending_traces():
+    eng, reg, ev, tracer = make_engine()
+    eng.add(LatencyObjective("ttft", "serving_ttft_seconds",
+                             threshold_s=0.05, windows=(60.0,)))
+    # two traces the breach should name: one errored, one deadline-missed
+    bad1 = tracer.trace("request", kind="serving", req=1)
+    bad1.mark_error("EngineFailed")
+    bad1.finish()
+    bad2 = tracer.trace("request", kind="serving", req=2)
+    bad2.mark_deadline_miss()
+    bad2.finish()
+    reg.histogram("serving_ttft_seconds", unit="s").observe(0.5)
+    rep = eng.evaluate()
+    named = rep["ttft"]["offending_traces"]
+    assert bad1.trace_id in named and bad2.trace_id in named
+    [breach] = [e for e in ev.tail() if e["kind"] == "slo_breach"]
+    assert breach["traces"] == named
+
+
+# --------------------------------------------------------------------- #
+# error-rate objectives                                                  #
+# --------------------------------------------------------------------- #
+
+def test_error_rate_from_counter_deltas():
+    eng, reg, ev, _ = make_engine()
+    eng.add(ErrorRateObjective(
+        "errors", bad=("serving_requests_errored_total",),
+        total=("serving_requests_submitted_total",),
+        target_rate=0.05, windows=(10.0,)))
+    bad = reg.counter("serving_requests_errored_total", {"instance": "0"})
+    tot = reg.counter("serving_requests_submitted_total", {"instance": "0"})
+    tot.inc(100)
+    eng.evaluate(now=1000.0)          # anchor snapshot, all healthy
+    assert eng.last["errors"]["compliant"]
+    bad.inc(10)
+    tot.inc(10)                       # 10 bad / 10 new = way over 5%
+    rep = eng.evaluate(now=1005.0)
+    w = rep["errors"]["windows"]["10s"]
+    assert w["bad"] == 10 and w["events"] == 10
+    assert w["burn_rate"] == pytest.approx((10 / 10) / 0.05)
+    assert not rep["errors"]["compliant"]
+    assert [e for e in ev.tail() if e["kind"] == "slo_breach"]
+
+
+def test_error_rate_string_counter_names_accepted():
+    obj = ErrorRateObjective("e", bad="bad_total", total="all_total")
+    assert obj.bad == ("bad_total",) and obj.total == ("all_total",)
+
+
+def test_objective_validation():
+    eng, _, _, _ = make_engine()
+    with pytest.raises(ValueError):
+        LatencyObjective("x", "m", threshold_s=0.0)
+    with pytest.raises(ValueError):
+        LatencyObjective("x", "m", threshold_s=1.0, target_quantile=1.5)
+    with pytest.raises(ValueError):
+        ErrorRateObjective("x", bad=("b",), total=("t",), target_rate=2.0)
+    with pytest.raises(TypeError):
+        eng.add(object())
+    eng.add(LatencyObjective("dup", "m", threshold_s=1.0))
+    with pytest.raises(ValueError):
+        eng.add(LatencyObjective("dup", "m", threshold_s=1.0))
+
+
+# --------------------------------------------------------------------- #
+# fleet aggregation                                                      #
+# --------------------------------------------------------------------- #
+
+class _FakeComm:
+    def __init__(self, payloads):
+        self._payloads = payloads
+
+    def allgather_obj(self, obj):
+        return self._payloads
+
+
+def test_aggregate_pools_burn_rates_across_ranks():
+    engines = []
+    for rank, slow in enumerate((0.0, 0.5)):   # rank 1 burns, rank 0 not
+        eng, reg, _, _ = make_engine()
+        eng.add(LatencyObjective("ttft", "serving_ttft_seconds",
+                                 threshold_s=0.1, windows=(60.0,)))
+        h = reg.histogram("serving_ttft_seconds", unit="s")
+        for _ in range(10):
+            h.observe(0.01)
+        if slow:
+            for _ in range(10):
+                h.observe(slow)
+        eng.evaluate()
+        engines.append(eng)
+    payloads = [
+        {n: {w: e["burn_rate"] for w, e in ent["windows"].items()}
+         for n, ent in eng.last.items()}
+        for eng in engines
+    ]
+    fleet = engines[0].aggregate(_FakeComm(payloads))
+    assert fleet["ranks"] == 2
+    ent = fleet["ttft"]["60s"]
+    assert ent["max_burn_rate"] == pytest.approx(50.0)   # rank 1: 0.5/0.01
+    assert ent["mean_burn_rate"] == pytest.approx(25.0)
+    json.dumps(fleet)

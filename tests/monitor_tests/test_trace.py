@@ -1,0 +1,198 @@
+"""The tracing layer: span trees, sampling + forced retention, ambient
+context, Chrome trace-event export, and critical-path breakdowns."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from chainermn_tpu.monitor.trace import NULL_TRACE, Tracer, get_tracer, span
+
+
+# --------------------------------------------------------------------- #
+# span trees                                                             #
+# --------------------------------------------------------------------- #
+
+def test_span_tree_structure_and_parents():
+    tr = Tracer(sample=1, ring=8)
+    t = tr.trace("request", kind="serving", req=7)
+    with t.span("queue"):
+        pass
+    with t.span("prefill", bucket=16) as p:
+        p.label(batch=2)
+    t.add_span("decode_step", 1.0, 1.25, token=0)
+    t.finish(reason="eos")
+    [kept] = tr.finished()
+    names = [s.name for s in kept.spans]
+    assert names == ["request", "queue", "prefill", "decode_step"]
+    root = kept.spans[0]
+    assert root.span_id == 0 and root.parent_id is None
+    assert all(s.parent_id == 0 for s in kept.spans[1:])
+    assert kept.spans[2].labels == {"bucket": 16, "batch": 2}
+    assert kept.spans[3].duration_s == pytest.approx(0.25)
+    assert root.labels["req"] == 7 and root.labels["reason"] == "eos"
+    # every span shares the trace id
+    assert {s.trace_id for s in kept.spans} == {kept.trace_id}
+
+
+def test_sampling_keeps_every_nth_and_forces_errors():
+    tr = Tracer(sample=4, ring=64)
+    for i in range(8):
+        t = tr.trace("request", i=i)
+        t.finish()
+    kept = [t.root.labels["i"] for t in tr.finished()]
+    assert kept == [0, 4]   # every 4th started trace
+    # errored / deadline-missed / forced traces survive regardless
+    for flag in ("error", "deadline", "forced"):
+        t = tr.trace("request", flag=flag)
+        if flag == "error":
+            t.mark_error("Boom")
+        elif flag == "deadline":
+            t.mark_deadline_miss()
+        else:
+            t.force()
+        t.finish()
+    flags = [t.root.labels.get("flag") for t in tr.finished()]
+    assert flags[-3:] == ["error", "deadline", "forced"]
+    assert tr.finished()[-3].error == "Boom"
+    assert tr.finished()[-2].deadline_miss
+
+
+def test_sample_zero_disables_tracing_entirely():
+    tr = Tracer(sample=0)
+    t = tr.trace("request")
+    assert t is NULL_TRACE and not t.enabled
+    # every operation is a no-op, including the context forms
+    with t.span("anything"):
+        t.add_span("x", 0.0, 1.0)
+    t.mark_error("e")
+    t.finish()
+    assert tr.finished() == []
+    assert t.breakdown() == {}
+
+
+def test_ring_is_bounded():
+    tr = Tracer(sample=1, ring=4)
+    for i in range(10):
+        tr.trace("t", i=i).finish()
+    kept = [t.root.labels["i"] for t in tr.finished()]
+    assert kept == [6, 7, 8, 9]
+
+
+def test_max_spans_cap_counts_drops():
+    tr = Tracer(sample=1, ring=4, max_spans=3)
+    t = tr.trace("request")
+    for i in range(5):
+        t.add_span("decode_step", 0.0, 0.1, token=i)
+    t.finish()
+    assert len(t.spans) == 3          # root + 2 children
+    assert t.dropped_spans == 3
+
+
+def test_cross_thread_span_attachment():
+    tr = Tracer(sample=1, ring=4)
+    t = tr.trace("request")
+
+    def worker():
+        with t.span("prefill"):
+            pass
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    t.finish()
+    assert [s.name for s in t.spans] == ["request", "prefill"]
+
+
+# --------------------------------------------------------------------- #
+# ambient context                                                        #
+# --------------------------------------------------------------------- #
+
+def test_ambient_nesting_and_module_helper():
+    tr = Tracer(sample=1, ring=4)
+    with tr.trace("train_step", kind="train", step=3):
+        with tr.span("dispatch"):
+            with tr.span("inner"):
+                pass
+    [t] = tr.finished()
+    assert [s.name for s in t.spans] == ["train_step", "dispatch", "inner"]
+    # inner nests under dispatch, not under the root
+    assert t.spans[2].parent_id == t.spans[1].span_id
+    # outside any ambient trace the helper is a no-op
+    assert tr.current() is None
+    with tr.span("orphan"):
+        pass
+    assert len(tr.finished()) == 1
+
+
+def test_module_level_span_helper_is_noop_without_trace():
+    # never raises, never records, regardless of default-tracer state
+    with span("anything", k=1):
+        pass
+
+
+def test_ambient_exception_marks_error():
+    tr = Tracer(sample=100, ring=4)   # sampling alone would drop seq 1
+    tr.trace("warmup").finish()       # burn seq 0 (always sampled)
+    with pytest.raises(ValueError):
+        with tr.trace("train_step", step=1):
+            raise ValueError("boom")
+    [t] = [x for x in tr.finished() if x.root.name == "train_step"]
+    assert t.error == "ValueError"    # retained despite sample=100
+
+
+# --------------------------------------------------------------------- #
+# export + breakdown                                                     #
+# --------------------------------------------------------------------- #
+
+def test_chrome_export_schema():
+    tr = Tracer(sample=1, ring=8)
+    t = tr.trace("request", kind="serving", req=1)
+    with t.span("queue"):
+        time.sleep(0.001)
+    t.finish()
+    out = tr.export_chrome()
+    json.dumps(out)                       # JSON-able as-is
+    events = out["traceEvents"]
+    assert events
+    meta = [e for e in events if e["ph"] == "M"]
+    complete = [e for e in events if e["ph"] == "X"]
+    assert meta and complete
+    assert len(meta) + len(complete) == len(events)
+    for e in complete:
+        assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid",
+                          "args"}
+        assert e["dur"] >= 0 and "trace_id" in e["args"]
+    # root + queue rows share the trace's tid
+    assert len({e["tid"] for e in complete}) == 1
+
+
+def test_export_to_file(tmp_path):
+    tr = Tracer(sample=1, ring=8)
+    tr.trace("request").finish()
+    path = tmp_path / "trace.json"
+    tr.export_chrome(str(path))
+    assert "traceEvents" in json.loads(path.read_text())
+
+
+def test_breakdown_attributes_phases():
+    tr = Tracer(sample=1, ring=8)
+    t = tr.trace("request")
+    t.add_span("queue", 0.0, 0.5)
+    t.add_span("prefill", 0.5, 0.8)
+    t.add_span("decode_step", 0.8, 0.9)
+    t.add_span("decode_step", 0.9, 1.0)
+    t.finish()
+    bd = t.breakdown()
+    assert bd["phases_s"]["queue"] == pytest.approx(0.5)
+    assert bd["phases_s"]["prefill"] == pytest.approx(0.3)
+    assert bd["phases_s"]["decode_step"] == pytest.approx(0.2)
+    assert bd["phase_counts"]["decode_step"] == 2
+    assert bd["total_s"] >= 0.0 and "untracked_s" in bd
+    json.dumps(bd)
+
+
+def test_default_tracer_is_process_wide():
+    assert get_tracer() is get_tracer()
+    assert get_tracer().enabled   # tracing on by default (ring-bounded)
