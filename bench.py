@@ -768,6 +768,88 @@ def serving_main() -> None:
             f"ttft_p50 {record['prefix_serving']['ttft_p50_ms']}ms (on) vs "
             f"{record['prefix_serving']['ttft_p50_ms_off']}ms (off), "
             f"parity={parity}")
+
+        # ---- paged KV decode: ON vs OFF at the SAME device KV budget - #
+        # The PR-7 acceptance: a dense engine reserves cache_len rows per
+        # slot regardless of what requests actually use, so concurrency =
+        # n_slots. The paged engine spends the SAME row budget as a block
+        # pool and admits by blocks actually needed — short requests pack
+        # 4x+ more concurrent decodes into identical memory (worst-case
+        # block-budget admission, so zero preemptions in the clean run).
+        pg_prefill = int(e("CHAINERMN_TPU_SERVE_PAGED_PREFILL", "16"))
+        pg_cache = int(e("CHAINERMN_TPU_SERVE_PAGED_CACHE", "64"))
+        pg_bs = int(e("CHAINERMN_TPU_SERVE_KV_BLOCK", "8"))
+        pg_batch = int(e("CHAINERMN_TPU_SERVE_PAGED_BATCH", "4"))
+        pg_max_new = int(e("CHAINERMN_TPU_SERVE_PAGED_MAX_NEW", "6"))
+        pg_quant = e("CHAINERMN_TPU_SERVE_KV_QUANT", "none")
+        dense_slots = int(e("CHAINERMN_TPU_SERVE_DENSE_SLOTS", "2"))
+        paged_slots = int(e("CHAINERMN_TPU_SERVE_PAGED_SLOTS", "12"))
+        budget_rows = dense_slots * pg_cache       # dense-resident KV rows
+        pg_blocks = budget_rows // pg_bs + 1       # same rows (+ scratch)
+        pg_jobs = [
+            (rng.randint(1, vocab,
+                         2 + i % (pg_prefill // 2 - 1)).astype(np.int32),
+             pg_max_new)
+            for i in range(int(e("CHAINERMN_TPU_SERVE_PAGED_REQUESTS",
+                                 "16")))
+        ]
+
+        def run_paged_workload(paged_on):
+            kw = (dict(paged=True, kv_blocks=pg_blocks, kv_block_size=pg_bs,
+                       kv_quant=pg_quant, n_slots=paged_slots)
+                  if paged_on else dict(n_slots=dense_slots))
+            eng = ServingEngine(model, params, prefill_buckets=(pg_prefill,),
+                                prefill_batch=pg_batch, cache_len=pg_cache,
+                                **kw)
+            eng.warmup()
+            counts = eng.compile_counts_detailed()
+            s = FCFSScheduler(eng)
+            t0 = time.time()
+            reqs = [s.submit(p, n) for p, n in pg_jobs]
+            s.run_until_idle()
+            wall = time.time() - t0
+            assert eng.compile_counts_detailed() == counts, "recompiled!"
+            return eng, s.metrics.report(), reqs, wall
+
+        eng_pg, m_pg, reqs_pg, wall_pg = run_paged_workload(True)
+        eng_dn, m_dn, reqs_dn, wall_dn = run_paged_workload(False)
+        pg_parity = True
+        for i in (0, 1):
+            prompt, n = pg_jobs[i]
+            ref = np.asarray(generate(model, params,
+                                      jnp.asarray(prompt)[None], n)[0])
+            pg_parity = (pg_parity
+                         and bool(np.array_equal(reqs_pg[i].output, ref))
+                         and bool(np.array_equal(reqs_dn[i].output, ref)))
+        record["paged_serving"] = {
+            "kv_blocks": pg_blocks,
+            "kv_block_size": pg_bs,
+            "kv_quant": pg_quant,
+            "kv_budget_rows": budget_rows,
+            "dense_slots": dense_slots,
+            "paged_slots": paged_slots,
+            "max_concurrent_paged": eng_pg.peak_active,
+            "max_concurrent_dense": eng_dn.peak_active,
+            "concurrency_gain": round(
+                eng_pg.peak_active / max(eng_dn.peak_active, 1), 3),
+            "tokens_per_sec": m_pg["tokens_per_sec"],
+            "tokens_per_sec_dense": m_dn["tokens_per_sec"],
+            "wall_s": round(wall_pg, 3),
+            "wall_s_dense": round(wall_dn, 3),
+            "preemptions": m_pg.get("kv_preemptions", 0),
+            "kv_blocks_per_request_mean":
+                m_pg.get("kv_blocks_per_request_mean", 0.0),
+            "kv_stats": eng_pg.kv_stats(),
+            "parity_vs_solo_generate": pg_parity,
+            "recompiles_after_warmup":
+                sum(eng_pg.recompiles.values())
+                + sum(eng_dn.recompiles.values()),
+        }
+        p = record["paged_serving"]
+        log(f"paged serving: {p['max_concurrent_paged']} vs "
+            f"{p['max_concurrent_dense']} concurrent "
+            f"({p['concurrency_gain']}x) at {budget_rows} KV rows, "
+            f"preemptions={p['preemptions']}, parity={pg_parity}")
         from chainermn_tpu.monitor import snapshot as monitor_snapshot
 
         record["monitor"] = monitor_snapshot()

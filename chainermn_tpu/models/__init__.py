@@ -12,6 +12,8 @@ from chainermn_tpu.models.transformer import (
     TransformerBlock,
     TransformerLM,
     generate,
+    init_kv_caches,
+    init_paged_kv_caches,
 )
 from chainermn_tpu.models.vision import GoogLeNet, InceptionBlock, VGG16
 
@@ -30,4 +32,6 @@ __all__ = [
     "TransformerBlock",
     "TransformerLM",
     "generate",
+    "init_kv_caches",
+    "init_paged_kv_caches",
 ]

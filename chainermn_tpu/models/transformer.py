@@ -324,6 +324,37 @@ def init_kv_caches(model: TransformerLM, batch: int, cache_len: int,
     return [{"k": z(), "v": z()} for _ in range(model.n_layers)]
 
 
+def init_paged_kv_caches(model: TransformerLM, n_blocks: int,
+                         block_size: int, *,
+                         local_heads: Optional[int] = None,
+                         quant: str = "none"):
+    """Zeroed per-layer **paged** KV block stores: a list of ``{'k','v'}``
+    dicts shaped ``[n_blocks, block_size, heads, d_head]`` — one pool of
+    fixed-size token blocks shared by every sequence, addressed through a
+    ``[B, max_blocks]`` block table the caller threads into each layer
+    dict as its ``'table'`` entry (see
+    :func:`~chainermn_tpu.parallel.sequence.paged_update_cache_and_attend`).
+    ``quant='int8'`` stores int8 rows plus per-row-per-head f32
+    ``'k_scale'``/``'v_scale'`` arrays (``x ≈ x_q * scale`` — ~2x less KV
+    memory per resident token; dequantized inside the attention gather).
+    Tensor-parallel decode passes ``local_heads=n_heads // tp_size``."""
+    if quant not in ("none", "int8"):
+        raise ValueError(f"quant must be 'none' or 'int8', got {quant!r}")
+    h = local_heads or model.n_heads
+    dh = model.d_model // model.n_heads
+    dt = jnp.int8 if quant == "int8" else model.compute_dtype
+
+    def layer():
+        d = {"k": jnp.zeros((n_blocks, block_size, h, dh), dt),
+             "v": jnp.zeros((n_blocks, block_size, h, dh), dt)}
+        if quant == "int8":
+            d["k_scale"] = jnp.zeros((n_blocks, block_size, h), jnp.float32)
+            d["v_scale"] = jnp.zeros((n_blocks, block_size, h), jnp.float32)
+        return d
+
+    return [layer() for _ in range(model.n_layers)]
+
+
 def generate(
     model: TransformerLM,
     params,

@@ -804,6 +804,82 @@ def cached_attention(q, kbuf, vbuf, pos_offset, *, scale: Optional[float] = None
     return out.astype(q.dtype)
 
 
+def paged_update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
+                                  scale: Optional[float] = None):
+    """The paged twin of :func:`update_cache_and_attend`: K/V live in a
+    shared **block store** instead of dense per-sequence regions, and each
+    batch row reaches its own sequence through a **block table**.
+
+    ``kv_cache`` is a dict with:
+
+    - ``'k'``/``'v'``: the store, ``[n_blocks, block_size, H, D]`` — one
+      pool of fixed-size token blocks shared by every sequence (and, in
+      the serving engine, by the prefix cache: a cached prefix is just a
+      table entry, not a copy);
+    - ``'table'``: ``[B, max_blocks]`` int32 — row ``b``'s ``j``-th entry
+      is the store block holding positions ``[j*bs, (j+1)*bs)`` of
+      sequence ``b``. Entries for not-yet-written spans may be junk (by
+      convention a reserved scratch block): the position mask hides every
+      row at positions beyond the query, exactly like the dense path's
+      stale-rows argument;
+    - optional ``'k_scale'``/``'v_scale'``: ``[n_blocks, block_size, H]``
+      f32 — present iff the store is int8-quantized. Each resident row
+      carries one symmetric scale per head (``x ≈ x_q * scale``); writes
+      quantize, the attention gather dequantizes in-program.
+
+    Writes scatter the ``S`` new rows through the table
+    (``store[table[b, p//bs], p%bs] = kv[b, p]``); the attention gathers
+    each row's full table span back into a ``[B, max_blocks*bs]``
+    per-sequence view and runs the same position-masked
+    :func:`cached_attention`. Static shapes throughout — table contents
+    change, programs never recompile. Returns ``(out, new_cache)`` where
+    ``new_cache`` carries the updated store (and scales) WITHOUT the
+    table: the table is host-managed state threaded in per call."""
+    store_k, store_v = kv_cache["k"], kv_cache["v"]
+    table = kv_cache["table"]
+    quant = "k_scale" in kv_cache
+    bs = store_k.shape[1]
+    b, s = q.shape[0], q.shape[1]
+    if jnp.ndim(pos_offset) == 0:
+        pos_offset = jnp.full((b,), pos_offset, jnp.int32)
+    pos = pos_offset[:, None] + jnp.arange(s)[None, :]        # [B, S]
+    blk = jnp.take_along_axis(table, pos // bs, axis=1).reshape(-1)
+    off = (pos % bs).reshape(-1)
+
+    def write(store, scales, rows):
+        rows = rows.reshape((b * s,) + rows.shape[2:])        # [B*S, H, D]
+        if not quant:
+            return store.at[blk, off].set(rows.astype(store.dtype)), None
+        r32 = rows.astype(jnp.float32)
+        # symmetric per-row-per-head scale; the epsilon keeps all-zero
+        # rows (warmup, padding) from dividing by zero
+        sc = jnp.maximum(jnp.max(jnp.abs(r32), axis=-1) / 127.0, 1e-8)
+        q8 = jnp.clip(jnp.round(r32 / sc[..., None]), -127, 127)
+        return (store.at[blk, off].set(q8.astype(jnp.int8)),
+                scales.at[blk, off].set(sc))
+
+    new_k, new_ks = write(store_k, kv_cache.get("k_scale"), k)
+    new_v, new_vs = write(store_v, kv_cache.get("v_scale"), v)
+
+    flat = table.reshape(-1)                                  # [B*M]
+
+    def gather(store, scales):
+        rows = jnp.take(store, flat, axis=0)       # [B*M, bs, H, D]
+        if quant:
+            sc = jnp.take(scales, flat, axis=0)    # [B*M, bs, H]
+            rows = rows.astype(jnp.float32) * sc[..., None]
+        rows = rows.reshape((b, -1) + rows.shape[2:])
+        return rows.astype(q.dtype)
+
+    out = cached_attention(q, gather(new_k, new_ks), gather(new_v, new_vs),
+                           pos_offset, scale=scale)
+    new_cache = {"k": new_k, "v": new_v}
+    if quant:
+        new_cache["k_scale"] = new_ks
+        new_cache["v_scale"] = new_vs
+    return out, new_cache
+
+
 def update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
                             scale: Optional[float] = None):
     """Write ``S`` new K/V rows into the cache at ``pos_offset`` and attend
@@ -814,7 +890,14 @@ def update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
 
     A ``[B]`` ``pos_offset`` writes each batch row's K/V at that row's own
     position (vmapped per-row update) — the slot-pool decode step, where
-    every slot sits at a different depth in its sequence."""
+    every slot sits at a different depth in its sequence.
+
+    A ``kv_cache`` carrying a ``'table'`` entry takes the **paged** path
+    (:func:`paged_update_cache_and_attend`): the buffers are then a shared
+    block store indexed per row through the block table."""
+    if "table" in kv_cache:
+        return paged_update_cache_and_attend(kv_cache, q, k, v, pos_offset,
+                                             scale=scale)
     if jnp.ndim(pos_offset) == 0:
         kbuf = lax.dynamic_update_slice(
             kv_cache["k"], k.astype(kv_cache["k"].dtype),

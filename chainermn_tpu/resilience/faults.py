@@ -41,7 +41,14 @@ point                       where it fires
                             batched bucket-prefill device call, so a
                             raise is contained to the admitting group
 ``serving.prefix_copy``     prefix-cache block copies (``op='fetch'`` on
-                            a hit, ``op='insert'`` after admission)
+                            a hit, ``op='insert'`` after admission;
+                            ``op='share'`` in paged mode, where a hit is
+                            a table reference instead of a copy)
+``serving.kv_append``       ``ServingEngine.append_block`` — the paged
+                            engine's lazy block allocation when a slot
+                            crosses a block boundary mid-decode; a raise
+                            is contained by preempting+requeueing ONLY
+                            that slot's request (no restart)
 ``serving.decode``          ``ServingEngine.decode_step``, same window
 ``trainer.step``            each ``resilient_fit`` iteration, inside its
                             exception boundary

@@ -17,7 +17,11 @@ This package is the traffic-facing counterpart — the ROADMAP's
   KV reuse: a host-side ref-counted trie over token blocks backed by a
   device block store; on admission the longest cached prefix is copied
   slot-locally and only the uncached suffix prefills (LRU eviction on
-  ref-zero leaves);
+  ref-zero leaves). With ``ServingEngine(paged=True)`` the SAME store
+  becomes the single KV substrate (:class:`~chainermn_tpu.serving.
+  prefix_cache.BlockPool`): decode slots address it through block
+  tables, hits are zero-copy shared entries, and admission is budgeted
+  in blocks instead of worst-case slot regions;
 - :class:`~chainermn_tpu.serving.scheduler.FCFSScheduler` — policy: FCFS
   admission into freed slots between decode steps (cost-aware grouping:
   same-bucket batches preferring shared cached prefixes, bounded prefill
@@ -42,7 +46,11 @@ from chainermn_tpu.serving.engine import (
     ServingEngine,
 )
 from chainermn_tpu.serving.metrics import ServingMetrics
-from chainermn_tpu.serving.prefix_cache import PrefixCacheIndex, PrefixMatch
+from chainermn_tpu.serving.prefix_cache import (
+    BlockPool,
+    PrefixCacheIndex,
+    PrefixMatch,
+)
 from chainermn_tpu.serving.scheduler import (
     DeadlineExceededError,
     EngineFailed,
@@ -54,6 +62,7 @@ from chainermn_tpu.serving.scheduler import (
 
 __all__ = [
     "AdmitPlan",
+    "BlockPool",
     "DeadlineExceededError",
     "EngineFailed",
     "EngineStateError",

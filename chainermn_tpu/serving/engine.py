@@ -57,6 +57,27 @@ store's) head axis sharded over the mesh (``P(None, None, axis)`` at
 rest), and a vocab-parallel head's local logits are ``all_gather``-ed
 before sampling — the scheduler drives TP decode through the identical
 slot API.
+
+**Paged mode** (``paged=True``) replaces the dense per-slot cache regions
+with ONE shared block store — the same store the prefix cache runs on —
+and per-slot **block tables** (host mirror + a ``[n_slots, max_blocks]``
+int32 operand per decode call). Concurrency is then bound by *tokens
+actually resident*, not ``n_slots x cache_len`` worst case: a slot
+allocates blocks lazily as its sequence crosses block boundaries
+(``append_block``, scheduler-driven), prefix hits become plain
+ref-counted table entries (the PR-5 splice-copy collapses into sharing —
+a hit costs zero copies, and caching a freshly prefilled prompt is pure
+bookkeeping via ``insert_shared``), retirement decrefs the slot's blocks
+back to the pool, and ``kv_quant='int8'`` halves resident bytes again
+(per-row-per-head scales, dequantized inside the attention gather).
+Shared blocks are never written: a match covers only *full* prompt
+blocks, and every write position ``>= match.length`` lands in a block
+the slot owns exclusively — copy-on-write reduces to "the first partial
+block is always private". Still exactly TWO program families (bucketed
+prefill + decode), compiled once at warmup: table *contents* change
+per call, shapes never do, so the zero-recompile invariant carries over
+unchanged. The legacy dense path is preserved byte-for-byte behind
+``paged=False`` (the default).
 """
 
 from __future__ import annotations
@@ -74,11 +95,16 @@ from chainermn_tpu.extensions.profiling import Watchdog
 from chainermn_tpu.models.transformer import (
     _sampler,
     init_kv_caches,
+    init_paged_kv_caches,
 )
 from chainermn_tpu.monitor import RecompileGuard, annotate
 from chainermn_tpu.monitor._state import get_event_log, get_registry
 from chainermn_tpu.resilience.faults import inject
-from chainermn_tpu.serving.prefix_cache import PrefixCacheIndex, PrefixMatch
+from chainermn_tpu.serving.prefix_cache import (
+    BlockPool,
+    PrefixCacheIndex,
+    PrefixMatch,
+)
 
 
 @dataclass
@@ -94,6 +120,7 @@ class AdmitPlan:
     match: Optional[PrefixMatch]
     start: int          # cached tokens reused (0 on miss)
     bucket: int         # padded suffix length (one compiled program per)
+    max_new: int = 1    # token budget (paged mode reserves growth blocks)
 
     @property
     def cached_frac(self) -> float:
@@ -147,6 +174,30 @@ class ServingEngine:
         Cost/benefit gate on inserts: skip caching prompts contributing
         fewer than this many new full blocks (an insert is a device copy;
         a unique ragged tail is never re-hit). Default 1 (cache all).
+    paged : bool
+        Unify decode KV onto ONE shared block store with per-slot block
+        tables (module docstring): concurrency bound by resident tokens
+        instead of ``n_slots x cache_len``, prefix reuse by sharing
+        instead of copying. The prefix trie always runs on the shared
+        pool in this mode — ``prefix_cache_blocks`` must stay 0 (its
+        legacy store would duplicate the unified one). Default False:
+        the dense PR-1..5 path, byte-for-byte.
+    kv_blocks : int, optional
+        Paged mode: total store blocks, INCLUDING the reserved scratch
+        block (id 0 — the write target for inactive rows and
+        unallocated table entries). Default ``n_slots *
+        ceil(cache_len/kv_block_size) + 1``, the dense-equivalent
+        capacity; set smaller to oversubscribe slots against the real
+        (short-request) working set — block-budget admission plus
+        preemption keep it safe.
+    kv_block_size : int
+        Paged mode: tokens per block. Smaller blocks waste fewer rows on
+        ragged tails but widen the tables. Default 16.
+    kv_quant : {'none', 'int8'}
+        Paged mode: quantize resident blocks to int8 with per-row
+        per-head scales (~2x less KV memory; dequantized inside the
+        attention gather — a small, tested perturbation of logits, NOT
+        bit-parity with the f32/bf16 path). Default 'none'.
     cache_len : int, optional
         Per-slot KV capacity (prompt + generated); defaults to
         ``model.max_len``. A request needs ``len(prompt) + max_new <=
@@ -176,6 +227,10 @@ class ServingEngine:
                  prefix_cache_blocks: int = 0,
                  prefix_block_size: int = 16,
                  prefix_min_insert_blocks: int = 1,
+                 paged: bool = False,
+                 kv_blocks: Optional[int] = None,
+                 kv_block_size: int = 16,
+                 kv_quant: str = "none",
                  cache_len: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0, comm=None,
                  watchdog: Optional[Union[Watchdog, float]] = None):
@@ -260,10 +315,51 @@ class ServingEngine:
                                            labels)
         self._c_restarts = reg.counter("serving_engine_restarts_total",
                                        labels)
+        self._c_appends = reg.counter("kv_block_appends_total", labels)
 
-        # prefix cache: host trie + device block store (built with caches)
+        # paged mode: ONE shared block store (pool + trie on it), per-slot
+        # block tables; the dense caches/prefix store are never built
+        self.paged = bool(paged)
+        self.kv_quant = str(kv_quant)
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'none' or 'int8', got {kv_quant!r}")
+        if not self.paged and self.kv_quant != "none":
+            raise ValueError("kv_quant needs paged=True (the dense cache "
+                             "regions are not quantized)")
+        self.peak_active = 0
         self.prefix_cache: Optional[PrefixCacheIndex] = None
-        if prefix_cache_blocks:
+        if self.paged:
+            if prefix_cache_blocks:
+                raise ValueError(
+                    "paged mode unifies decode KV and the prefix cache on "
+                    "one shared block store — drop prefix_cache_blocks and "
+                    "size the store with kv_blocks/kv_block_size"
+                )
+            if kv_block_size < 1:
+                raise ValueError(
+                    f"kv_block_size must be >= 1, got {kv_block_size}")
+            self.kv_block_size = int(kv_block_size)
+            # table width: blocks covering a full-length slot (the last
+            # block may straddle cache_len — its tail rows stay masked)
+            self._n_max = -(-self.cache_len // self.kv_block_size)
+            if kv_blocks is None:
+                kv_blocks = self.n_slots * self._n_max + 1
+            self.kv_blocks = int(kv_blocks)
+            self._pool = BlockPool(self.kv_blocks, reserve_scratch=True)
+            self.prefix_cache = PrefixCacheIndex(
+                self.kv_blocks, self.kv_block_size, pool=self._pool)
+            self._min_insert = max(1, int(prefix_min_insert_blocks))
+            self._n_prog_blocks = self._n_max   # match cap for planning
+            self._tables = np.zeros((self.n_slots, self._n_max), np.int32)
+            self._slot_blocks: list[list[int]] = [
+                [] for _ in range(self.n_slots)]
+            # worst-case growth blocks each active slot may still append
+            # (admission reserves them; append_block draws them down) —
+            # what makes block-budget admission preemption-free in the
+            # no-fault case
+            self._slot_reserved = np.zeros((self.n_slots,), np.int64)
+        elif prefix_cache_blocks:
             if not 0 < prefix_block_size <= self.prefill_len:
                 raise ValueError(
                     f"prefix_block_size must be in (0, prefill_len="
@@ -283,6 +379,10 @@ class ServingEngine:
         if model.tensor_axis is not None:
             self._init_tp_caches(comm)
             self._build_tp_fns(comm)
+        elif self.paged:
+            self.caches = None          # the block store IS the cache
+            self._store = self._init_paged_store()
+            self._build_fns()
         else:
             self.caches = init_kv_caches(model, self.n_slots, self.cache_len)
             if self.prefix_cache is not None:
@@ -311,7 +411,7 @@ class ServingEngine:
         for b, fn in self._prefill_fns.items():
             self._guard.watch(f"serving_prefill_{b}", fn)
         self._guard.watch("serving_decode", self._decode_fn)
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and not self.paged:
             self._guard.watch("serving_prefix_insert", self._insert_fn)
 
     def _fresh_keys(self):
@@ -446,6 +546,72 @@ class ServingEngine:
 
         return body
 
+    def _paged_prefill_body(self, bucket: int, vocab_gather=None):
+        """Paged suffix-prefill trace for one bucket: each group row
+        writes its padded suffix THROUGH its block-table row into the
+        shared store (scatter), attends its gathered table span, and
+        samples its first token from its last REAL position — all inside
+        the model's ``[B, T]`` position path via
+        ``paged_update_cache_and_attend``. No slot gather/scatter and no
+        prefix splice: a cached prefix is just table entries, and
+        inactive rows carry all-scratch tables so their writes land in
+        the scratch block instead of anyone's KV."""
+        model, sample = self.model, self._sample
+
+        def slot_sample(lg, key):
+            nxt, key = sample(lg[None], key)
+            return nxt[0], key
+
+        def body(params, store, table, tokens, starts, last_idx, active,
+                 keys):
+            with annotate("chainermn.prefill"):
+                caches = [dict(layer, table=table) for layer in store]
+                pos = starts[:, None] + jnp.arange(bucket)[None, :]
+                logits, new_store = model.apply(params, tokens, pos,
+                                                kv_caches=caches)
+                lg = jax.vmap(
+                    lambda row, i: lax.dynamic_slice_in_dim(row, i, 1, 0)[0]
+                )(logits, last_idx)
+                if vocab_gather is not None:
+                    lg = vocab_gather(lg)
+                nxt, keys = jax.vmap(slot_sample)(lg, keys)
+                nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+                return new_store, nxt, keys
+
+        return body
+
+    def _paged_decode_body(self, vocab_gather=None):
+        """Paged decode trace: one token for EVERY slot through the
+        ``[n_slots, max_blocks]`` table — per-slot positions and sampler
+        keys exactly like the dense body; free/retired slots carry
+        all-scratch table rows, so their masked ride-along writes land in
+        the scratch block."""
+        model, sample = self.model, self._sample
+
+        def slot_sample(lg, key):
+            nxt, key = sample(lg[None], key)
+            return nxt[0], key
+
+        def body(params, store, table, tokens, pos, active, keys):
+            with annotate("chainermn.decode"):
+                caches = [dict(layer, table=table) for layer in store]
+                lg, new_store = model.apply(params, tokens[:, None],
+                                            pos[:, None], kv_caches=caches)
+                lg = lg[:, 0]
+                if vocab_gather is not None:
+                    lg = vocab_gather(lg)
+                nxt, keys = jax.vmap(slot_sample)(lg, keys)
+                nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+                return new_store, nxt, keys
+
+        return body
+
+    def _init_paged_store(self, local_heads: Optional[int] = None):
+        return init_paged_kv_caches(self.model, self.kv_blocks,
+                                    self.kv_block_size,
+                                    local_heads=local_heads,
+                                    quant=self.kv_quant)
+
     def _insert_body(self):
         """Prefix insert: copy each NEW full block's rows out of the donor
         slot into its allocated store block. Sequential per-block updates;
@@ -486,6 +652,14 @@ class ServingEngine:
         return [{"k": z(), "v": z()} for _ in range(self.model.n_layers)]
 
     def _build_fns(self):
+        if self.paged:
+            self._prefill_fns = {
+                b: jax.jit(self._paged_prefill_body(b), donate_argnums=(1,))
+                for b in self.prefill_buckets
+            }
+            self._decode_fn = jax.jit(self._paged_decode_body(),
+                                      donate_argnums=(1,))
+            return
         self._prefill_fns = {
             b: jax.jit(self._prefill_body(b), donate_argnums=(1,))
             for b in self.prefill_buckets
@@ -506,6 +680,13 @@ class ServingEngine:
                 f"tensor-axis size {n_tp}"
             )
         shard = NamedSharding(comm.mesh, P(None, None, axis))
+        if self.paged:
+            # the store's head axis (2) shards like the dense caches';
+            # quant scale arrays are [N, bs, H] so the same spec splits
+            # their heads too, and the tiny tables stay replicated
+            self.caches = None
+            self._store = jax.device_put(self._init_paged_store(), shard)
+            return
         self.caches = jax.device_put(
             init_kv_caches(self.model, self.n_slots, self.cache_len), shard)
         if self.prefix_cache is not None:
@@ -521,6 +702,31 @@ class ServingEngine:
         if self.model.vocab_parallel_head:
             def gather(lg):
                 return lax.all_gather(lg, axis, axis=-1, tiled=True)
+
+        if self.paged:
+            layer_spec = {"k": P(None, None, axis), "v": P(None, None, axis)}
+            if self.kv_quant == "int8":
+                layer_spec.update(k_scale=P(None, None, axis),
+                                  v_scale=P(None, None, axis))
+            store_spec = [dict(layer_spec)
+                          for _ in range(self.model.n_layers)]
+            self._prefill_fns = {
+                b: jax.jit(comm.shard_map(
+                    self._paged_prefill_body(b, gather),
+                    in_specs=(P(), store_spec, P(), P(), P(), P(), P(),
+                              P()),
+                    out_specs=(store_spec, P(), P()),
+                    check_vma=False,
+                ), donate_argnums=(1,))
+                for b in self.prefill_buckets
+            }
+            self._decode_fn = jax.jit(comm.shard_map(
+                self._paged_decode_body(gather),
+                in_specs=(P(), store_spec, P(), P(), P(), P(), P()),
+                out_specs=(store_spec, P(), P()),
+                check_vma=False,
+            ), donate_argnums=(1,))
+            return
 
         cache_spec = [{"k": P(None, None, axis), "v": P(None, None, axis)}
                       for _ in range(self.model.n_layers)]
@@ -563,14 +769,16 @@ class ServingEngine:
                 return b
         return None
 
-    def plan_admission(self, prompt, rng=None) -> AdmitPlan:
+    def plan_admission(self, prompt, rng=None,
+                       max_new: int = 1) -> AdmitPlan:
         """Decide how a prompt admits: match (and pin) the longest cached
         prefix that still leaves a bucket fitting inside the slot, and
         pick that bucket. Pure host work — no device call. The caller owns
         the plan: feed it to :meth:`admit_batch` or return the pin with
-        :meth:`cancel_plan`."""
+        :meth:`cancel_plan`. ``max_new`` is the request's token budget —
+        paged admission reserves its worst-case growth blocks from it."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        self.validate_request(len(prompt), 1)
+        self.validate_request(len(prompt), max_new)
         match = None
         if self.prefix_cache is not None:
             max_blocks = self._n_prog_blocks
@@ -592,7 +800,7 @@ class ServingEngine:
         if rng is None:
             rng = jax.random.PRNGKey(0)
         return AdmitPlan(prompt=prompt, rng=rng, match=match, start=start,
-                         bucket=bucket)
+                         bucket=bucket, max_new=int(max_new))
 
     def cancel_plan(self, plan: AdmitPlan) -> None:
         """Discard an unused plan, unpinning its prefix match."""
@@ -622,6 +830,14 @@ class ServingEngine:
                 f"{prompt_len} prompt + {max_new_tokens} new tokens exceed "
                 f"cache_len={self.cache_len}"
             )
+        if self.paged:
+            need = self.blocks_needed(prompt_len, max_new_tokens)
+            if need > self._pool.capacity:
+                raise ValueError(
+                    f"request needs {need} KV blocks worst-case but the "
+                    f"pool holds {self._pool.capacity} — raise kv_blocks "
+                    "or shrink the request"
+                )
 
     def warmup(self) -> None:
         """Compile every device program once, on dummy no-op inputs (all
@@ -636,33 +852,50 @@ class ServingEngine:
             raise RuntimeError("warmup needs an idle engine")
         k = self.prefill_batch
         zeros_i = jnp.zeros((k,), jnp.int32)
-        extra = ()
-        if self.prefix_cache is not None:
-            extra = (self._store,
-                     jnp.zeros((k, self._n_prog_blocks), jnp.int32))
-        for b in self.prefill_buckets:
-            with self._watched(f"serving warmup prefill[{b}]"):
-                self.caches, _, _ = self._prefill_fns[b](
-                    self.params, self.caches,
-                    jnp.zeros((k, b), jnp.int32), zeros_i, zeros_i,
-                    zeros_i, jnp.zeros((k,), bool),
-                    jnp.zeros((k, 2), jnp.uint32), *extra)
-        with self._watched("serving warmup decode"):
-            self.caches, _, _ = self._decode_fn(
-                self.params, self.caches, jnp.asarray(self._token),
-                jnp.asarray(self._pos), jnp.asarray(self._active),
-                self._keys)
-        if self.prefix_cache is not None:
-            ids = jnp.zeros((self._n_prog_blocks,), jnp.int32)
-            with self._watched("serving warmup prefix"):
-                self._store = self._insert_fn(self._store, self.caches,
-                                              jnp.int32(0), ids, ids,
-                                              jnp.int32(0))
+        if self.paged:
+            # all-scratch tables: every warmup write lands in the scratch
+            # block, no allocation and no real KV touched
+            tab = jnp.zeros((k, self._n_max), jnp.int32)
+            for b in self.prefill_buckets:
+                with self._watched(f"serving warmup prefill[{b}]"):
+                    self._store, _, _ = self._prefill_fns[b](
+                        self.params, self._store, tab,
+                        jnp.zeros((k, b), jnp.int32), zeros_i, zeros_i,
+                        jnp.zeros((k,), bool),
+                        jnp.zeros((k, 2), jnp.uint32))
+            with self._watched("serving warmup decode"):
+                self._store, _, _ = self._decode_fn(
+                    self.params, self._store, jnp.asarray(self._tables),
+                    jnp.asarray(self._token), jnp.asarray(self._pos),
+                    jnp.asarray(self._active), self._keys)
+        else:
+            extra = ()
+            if self.prefix_cache is not None:
+                extra = (self._store,
+                         jnp.zeros((k, self._n_prog_blocks), jnp.int32))
+            for b in self.prefill_buckets:
+                with self._watched(f"serving warmup prefill[{b}]"):
+                    self.caches, _, _ = self._prefill_fns[b](
+                        self.params, self.caches,
+                        jnp.zeros((k, b), jnp.int32), zeros_i, zeros_i,
+                        zeros_i, jnp.zeros((k,), bool),
+                        jnp.zeros((k, 2), jnp.uint32), *extra)
+            with self._watched("serving warmup decode"):
+                self.caches, _, _ = self._decode_fn(
+                    self.params, self.caches, jnp.asarray(self._token),
+                    jnp.asarray(self._pos), jnp.asarray(self._active),
+                    self._keys)
+            if self.prefix_cache is not None:
+                ids = jnp.zeros((self._n_prog_blocks,), jnp.int32)
+                with self._watched("serving warmup prefix"):
+                    self._store = self._insert_fn(self._store, self.caches,
+                                                  jnp.int32(0), ids, ids,
+                                                  jnp.int32(0))
         self._warm = True
         self._guard.check()
         self._events.emit("serving_warmup",
                           buckets=list(self.prefill_buckets),
-                          prefill_batch=k,
+                          prefill_batch=k, paged=self.paged,
                           prefix=self.prefix_cache is not None)
 
     def prefill(self, prompt: np.ndarray, rng,
@@ -711,6 +944,8 @@ class ServingEngine:
                 f"admission group mixes buckets {sorted(buckets)} — one "
                 "compiled program per call"
             )
+        if self.paged:
+            return self._paged_admit(plans, point=point, ctx=ctx)
         bucket = plans[0].bucket
         k = self.prefill_batch
         if self._pending_inserts:
@@ -784,8 +1019,201 @@ class ServingEngine:
             out.append((slot, first))
             if self.prefix_cache is not None:
                 self._pending_inserts.append((plan.prompt, slot))
+        self.peak_active = max(self.peak_active, self.active_slots)
         self._guard.check()
         return out
+
+    # ------------------------------------------------------------------ #
+    # paged admission + block management                                   #
+    # ------------------------------------------------------------------ #
+
+    def _paged_alloc_slot(self, plan: AdmitPlan, slot: int) -> list:
+        """Allocate the blocks a plan's prefill writes into ([start,
+        len(prompt)) — shared prefix blocks are referenced, not copied),
+        write the slot's table mirror, and reserve the worst-case decode
+        growth. Raises ``RuntimeError`` when the pool (plus trie
+        eviction) cannot cover it — the scheduler's block-budget gate
+        makes that unreachable in the no-fault case."""
+        bs = self.kv_block_size
+        plen = len(plan.prompt)
+        shared = list(plan.match.block_ids) if plan.match is not None else []
+        need_now = -(-plen // bs) - len(shared)
+        new = self.prefix_cache.alloc_blocks(need_now)
+        if len(new) < need_now:
+            for block in new:
+                self._pool.decref(block)
+            raise RuntimeError(
+                f"kv block pool exhausted: slot {slot} needs {need_now} "
+                f"blocks, {len(new)} allocatable (free="
+                f"{self._pool.free_blocks})"
+            )
+        for block in shared:
+            self._pool.incref(block)    # the slot co-owns its prefix
+        ids = shared + new
+        self._tables[slot, :] = 0
+        self._tables[slot, : len(ids)] = ids
+        self._slot_reserved[slot] = (
+            -(-(plen + plan.max_new) // bs) - (-(-plen // bs)))
+        return ids
+
+    def _paged_admit(self, plans: Sequence[AdmitPlan], *, point: str,
+                     ctx: Optional[dict] = None) -> list[tuple[int, int]]:
+        """Paged twin of the dense ``admit_batch`` body: allocate block
+        tables (prefix hits = shared entries, zero copies), run the ONE
+        bucketed prefill program through them, then commit mirrors and
+        adopt each prompt's full blocks into the trie (``insert_shared``
+        — pure bookkeeping, nothing device-side). A failure before the
+        device call rolls the allocations back and errors only this
+        group; one that consumed the donated store re-raises as
+        :class:`EngineStateError`."""
+        bucket = plans[0].bucket
+        k = self.prefill_batch
+        slots = sorted(self.free_slots)[:len(plans)]  # deterministic pick
+        n_cached = sum(p.match is not None for p in plans)
+        alloc_records: list[tuple[int, list]] = []
+        try:
+            try:
+                with self._watched("serving prefill", **(ctx or {})), \
+                        annotate("chainermn.serving_prefill"):
+                    if n_cached:
+                        inject("serving.prefix_copy", op="share",
+                               hits=n_cached, batch=len(plans))
+                    inject(point, batch=len(plans), bucket=bucket,
+                           slots=slots)
+                    tokens = np.zeros((k, bucket), np.int32)
+                    starts = np.zeros((k,), np.int32)
+                    last = np.zeros((k,), np.int32)
+                    active = np.zeros((k,), bool)
+                    table = np.zeros((k, self._n_max), np.int32)
+                    keys = [jnp.zeros((2,), jnp.uint32)] * k
+                    for i, (plan, slot) in enumerate(zip(plans, slots)):
+                        ids = self._paged_alloc_slot(plan, slot)
+                        alloc_records.append((slot, ids))
+                        table[i, : len(ids)] = ids
+                        suffix = plan.prompt[plan.start:]
+                        tokens[i, : len(suffix)] = suffix
+                        starts[i] = plan.start
+                        last[i] = len(suffix) - 1
+                        active[i] = True
+                        keys[i] = plan.rng
+                    self._store, firsts, keys_out = self._prefill_fns[bucket](
+                        self.params, self._store, jnp.asarray(table),
+                        jnp.asarray(tokens), jnp.asarray(starts),
+                        jnp.asarray(last), jnp.asarray(active),
+                        jnp.stack(keys))
+                    firsts = np.asarray(firsts)
+            except Exception as e:
+                for slot, ids in alloc_records:   # undo: nothing admitted
+                    for block in ids:
+                        self._pool.decref(block)
+                    self._slot_reserved[slot] = 0
+                    self._tables[slot, :] = 0
+                if not self._state_ok():
+                    raise EngineStateError(
+                        f"admission failed mid-device-call "
+                        f"({type(e).__name__}: {e}); donated store buffers "
+                        "are gone — restart required"
+                    ) from e
+                raise
+        finally:
+            for plan in plans:
+                self.cancel_plan(plan)   # pins served their purpose
+        out = []
+        for (plan, slot), (_, ids) in zip(zip(plans, slots), alloc_records):
+            first = int(firsts[len(out)])
+            self.free_slots.discard(slot)
+            self._token[slot] = first
+            self._pos[slot] = len(plan.prompt)
+            self._active[slot] = True
+            self._keys = self._keys.at[slot].set(keys_out[len(out)])
+            self._slot_blocks[slot] = list(ids)
+            self._c_prefills[bucket].inc()
+            self._events.emit("prefill", slot=slot,
+                              prompt_len=len(plan.prompt), bucket=bucket,
+                              cached=plan.start, batch=len(plans),
+                              blocks=len(ids))
+            out.append((slot, first))
+            # zero-copy trie insert: the slot's blocks already hold the
+            # prompt's KV — adopting them IS the cache insert
+            if (self.prefix_cache.missing_blocks(plan.prompt)
+                    >= self._min_insert):
+                self.prefix_cache.insert_shared(plan.prompt, ids)
+        self.peak_active = max(self.peak_active, self.active_slots)
+        self._guard.check()
+        return out
+
+    def blocks_needed(self, prompt_len: int, max_new: int,
+                      start: int = 0) -> int:
+        """Worst-case NEW blocks a request admits with: blocks covering
+        ``[start, prompt_len + max_new)`` (``start`` = cached-prefix
+        tokens, whose blocks are shared, not allocated). The scheduler's
+        block-budget admission compares this against
+        :meth:`kv_blocks_admittable`."""
+        bs = self.kv_block_size
+        return -(-(prompt_len + max_new) // bs) - start // bs
+
+    def kv_blocks_admittable(self) -> int:
+        """Blocks an admission may claim without ever starving a decode:
+        free pool blocks, plus trie blocks eviction could reclaim, minus
+        the growth already reserved by active slots."""
+        return (self._pool.free_blocks
+                + self.prefix_cache.evictable_blocks()
+                - int(self._slot_reserved.sum()))
+
+    def slot_needs_block(self, slot: int) -> bool:
+        """True when the slot's NEXT decode write crosses into a block it
+        has not allocated yet (its table entry still points at scratch)."""
+        if not self.paged or not self._active[slot]:
+            return False
+        return self._tables[slot,
+                            int(self._pos[slot]) // self.kv_block_size] == 0
+
+    def append_block(self, slot: int) -> bool:
+        """Lazily allocate the slot's next block (evicting idle trie
+        prefixes if the free list is dry). Returns False when the pool is
+        truly exhausted — the scheduler then preempts the lowest-priority
+        request and retries. Carries the ``serving.kv_append`` fault
+        cut-point: an injected failure here is contained by preempting
+        ONLY this slot (no engine restart)."""
+        inject("serving.kv_append", slot=slot, pos=int(self._pos[slot]))
+        got = self.prefix_cache.alloc_blocks(1)
+        if not got:
+            return False
+        block = got[0]
+        self._tables[slot, int(self._pos[slot]) // self.kv_block_size] = \
+            block
+        self._slot_blocks[slot].append(block)
+        if self._slot_reserved[slot] > 0:
+            self._slot_reserved[slot] -= 1
+        self._c_appends.inc()
+        self._events.emit("kv_append", slot=slot, block=block,
+                          pos=int(self._pos[slot]))
+        return True
+
+    def slot_block_count(self, slot: int) -> int:
+        """Blocks the slot's table currently references (0 in dense
+        mode) — the per-request block-count series at retirement."""
+        return len(self._slot_blocks[slot]) if self.paged else 0
+
+    def kv_pool_stats(self) -> tuple[int, int]:
+        """(blocks in use, blocks free) — the scheduler samples these
+        into the ``kv_blocks_in_use``/``kv_blocks_free`` gauges."""
+        return self._pool.used_blocks, self._pool.free_blocks
+
+    def kv_stats(self) -> dict:
+        """Paged-store occupancy/config block for bench records (empty
+        dict in dense mode)."""
+        if not self.paged:
+            return {}
+        return {
+            "kv_blocks": self.kv_blocks,
+            "kv_block_size": self.kv_block_size,
+            "kv_quant": self.kv_quant,
+            "blocks_in_use": self._pool.used_blocks,
+            "blocks_free": self._pool.free_blocks,
+            "blocks_reserved": int(self._slot_reserved.sum()),
+            "peak_active": self.peak_active,
+        }
 
     def flush_inserts(self) -> None:
         """Run the deferred trie inserts (one compiled copy per prompt
@@ -794,6 +1222,8 @@ class ServingEngine:
         every step and :meth:`admit_batch` flushes defensively before
         picking slots, so a donor's rows are always copied out before its
         slot can be reused by a later tenant."""
+        if self.paged:
+            return   # paged inserts are zero-copy, done at admission
         pending, self._pending_inserts = self._pending_inserts, []
         for prompt, slot in pending:
             self._insert_prefix(prompt, slot)
@@ -835,8 +1265,9 @@ class ServingEngine:
         scheduler's containment test: intact state means only the group
         being admitted failed, everything decoding is untouched."""
         try:
-            leaves = jax.tree_util.tree_leaves(self.caches)
-            if self.prefix_cache is not None:
+            leaves = jax.tree_util.tree_leaves(
+                self._store if self.paged else self.caches)
+            if self.prefix_cache is not None and not self.paged:
                 leaves += jax.tree_util.tree_leaves(self._store)
             return not any(leaf.is_deleted() for leaf in leaves)
         except Exception:  # noqa: BLE001 — can't tell: assume the worst
@@ -871,10 +1302,16 @@ class ServingEngine:
         with self._watched("serving decode_step", **(ctx or {})), \
                 annotate("chainermn.serving_decode"):
             inject("serving.decode", active=int(self._active.sum()))
-            self.caches, nxt, self._keys = self._decode_fn(
-                self.params, self.caches, jnp.asarray(self._token),
-                jnp.asarray(self._pos), jnp.asarray(self._active),
-                self._keys)
+            if self.paged:
+                self._store, nxt, self._keys = self._decode_fn(
+                    self.params, self._store, jnp.asarray(self._tables),
+                    jnp.asarray(self._token), jnp.asarray(self._pos),
+                    jnp.asarray(self._active), self._keys)
+            else:
+                self.caches, nxt, self._keys = self._decode_fn(
+                    self.params, self.caches, jnp.asarray(self._token),
+                    jnp.asarray(self._pos), jnp.asarray(self._active),
+                    self._keys)
             nxt = np.asarray(nxt)
         self._c_decode_steps.inc()
         self._events.emit("decode_step", active=int(self._active.sum()))
@@ -899,6 +1336,15 @@ class ServingEngine:
         parity test)."""
         if slot in self.free_slots:
             return
+        if self.paged:
+            # give the slot's block references back: exclusively-owned
+            # blocks free immediately, trie-shared ones stay resident for
+            # the next hit (the store, not the slot, owns cached prefixes)
+            for block in self._slot_blocks[slot]:
+                self._pool.decref(block)
+            self._slot_blocks[slot] = []
+            self._slot_reserved[slot] = 0
+            self._tables[slot, :] = 0
         self._active[slot] = False
         self.free_slots.add(slot)
 
@@ -916,6 +1362,8 @@ class ServingEngine:
         every restart is a counted, event-logged recovery."""
         if self.model.tensor_axis is not None:
             self._init_tp_caches(self._comm)
+        elif self.paged:
+            self._store = self._init_paged_store()
         else:
             self.caches = init_kv_caches(self.model, self.n_slots,
                                          self.cache_len)
@@ -923,6 +1371,15 @@ class ServingEngine:
                 self._store = self._init_store()
         if self.prefix_cache is not None:
             self.prefix_cache.clear()
+        if self.paged:
+            # trie dropped above; now drop the slot tables' references and
+            # reset the pool wholesale — a stale table pinning blocks of a
+            # dead store would leak capacity forever (and a stale ENTRY
+            # would read KV that no longer exists)
+            self._pool.reset()
+            self._tables[:] = 0
+            self._slot_blocks = [[] for _ in range(self.n_slots)]
+            self._slot_reserved[:] = 0
         self._pending_inserts = []
         self._token[:] = 0
         self._pos[:] = 0
@@ -953,7 +1410,7 @@ class ServingEngine:
         out = {f"prefill_{b}": int(fn._cache_size())
                for b, fn in self._prefill_fns.items()}
         out["decode"] = int(self._decode_fn._cache_size())
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and not self.paged:
             out["prefix_insert"] = int(self._insert_fn._cache_size())
         return out
 

@@ -91,6 +91,14 @@ class ServingMetrics:
         self._h_cached = reg.histogram("cached_prefix_frac", labels)
         self._g_queue = reg.gauge("serving_queue_depth_now", labels)
         self._g_active = reg.gauge("serving_active_slots", labels)
+        # paged-KV series (PR 7): store occupancy gauges sampled per step,
+        # preemptions (pool ran dry / injected append fault -> requeue),
+        # and how many blocks each retired request's whole life took —
+        # the "memory per request" distribution dense slots can't see
+        self._g_kv_used = reg.gauge("kv_blocks_in_use", labels)
+        self._g_kv_free = reg.gauge("kv_blocks_free", labels)
+        self._c_preempt = reg.counter("kv_preemptions_total", labels)
+        self._h_req_blocks = reg.histogram("kv_blocks_per_request", labels)
         self._t_first_token: Optional[float] = None
         self._t_last_token: Optional[float] = None
         # per-trace critical path (the tracing layer): phase-attributed
@@ -147,6 +155,20 @@ class ServingMetrics:
 
     def record_restart(self) -> None:
         self._c_restarts.inc()
+
+    def record_kv_pool(self, in_use: int, free: int) -> None:
+        """Paged-store occupancy, sampled once per scheduler step."""
+        self._g_kv_used.set(in_use)
+        self._g_kv_free.set(free)
+
+    def record_preemption(self) -> None:
+        """A decoding request was evicted back to the queue (block pool
+        dry, or an injected ``serving.kv_append`` fault contained)."""
+        self._c_preempt.inc()
+
+    def record_request_blocks(self, n_blocks: int) -> None:
+        """Store blocks a retiring request's table referenced."""
+        self._h_req_blocks.observe(n_blocks)
 
     def record_trace(self, req_id: int, breakdown: dict) -> None:
         """One retired request's span-tree breakdown (built by
@@ -256,6 +278,14 @@ class ServingMetrics:
             out[f"{prefix}_mean"] = round(float(t.mean()), 3)
             out[f"{prefix}_p50"] = round(float(np.percentile(t, 50)), 3)
             out[f"{prefix}_p99"] = round(float(np.percentile(t, 99)), 3)
+        req_blocks = self._h_req_blocks.samples
+        if req_blocks:   # paged engines only — dense reports stay as-is
+            t = np.asarray(req_blocks, np.float64)
+            out["kv_blocks_per_request_mean"] = round(float(t.mean()), 3)
+            out["kv_blocks_per_request_max"] = int(t.max())
+            out["kv_preemptions"] = int(self._c_preempt.value)
+            out["kv_blocks_in_use"] = int(self._g_kv_used.value)
+            out["kv_blocks_free"] = int(self._g_kv_free.value)
         if self._worst_trace is not None:
             # the slowest traced request's full phase attribution — the
             # compact "where the p99 TTFT went" answer, per trace

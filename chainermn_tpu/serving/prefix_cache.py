@@ -7,8 +7,10 @@ module is the host half of closing that gap (the vLLM/SGLang-style prefix
 cache): a trie over fixed-size token *blocks* whose nodes own block slots
 in a device-side KV store (the engine's ``[n_blocks, block_size, heads,
 d_head]`` buffers per layer). On admission the scheduler asks for the
-longest cached prefix; the engine copies the matched blocks slot-locally
-with a compiled-once gather program and prefills only the uncached suffix.
+longest cached prefix; the engine either copies the matched blocks
+slot-locally (the legacy dense path's compiled gather) or — in **paged**
+mode — simply references them from the request's block table (sharing,
+no copy), and prefills only the uncached suffix.
 
 Design points:
 
@@ -16,29 +18,39 @@ Design points:
   matches are multiples of ``block_size`` and the device copy programs have
   static shapes (one executable each, ever). A prompt inserts only its
   *full* blocks; the ragged tail is never cached.
-- **Ref-counting.** ``match`` pins the matched chain (tail refcount +1)
-  until the engine has copied the blocks into the request's slot
-  (``release``); ``plan_insert`` pins the attachment point until the copy
-  commits or aborts. Eviction only ever takes *leaf* nodes with refcount
-  zero, so a pinned tail protects its whole chain (ancestors have
-  children) and an in-flight copy can never read a reused block.
+- **Ref-counting, two levels.** Trie-level pins (``_Node.refs``): ``match``
+  pins the matched chain (tail refcount +1) until the holder is done with
+  it (``release``); ``plan_insert`` pins the attachment point until the
+  copy commits or aborts. Eviction only ever takes *leaf* nodes with
+  refcount zero, so a pinned tail protects its whole chain. Pool-level
+  refcounts (:class:`BlockPool`): each holder of a block — the trie node,
+  and in paged mode every decode slot whose table references it — holds
+  one reference; a block returns to the free list only at refcount zero,
+  so evicting a trie node while a slot still reads its block merely
+  *defers* the free until that slot retires.
 - **LRU eviction.** When an insert needs more blocks than are free, the
   least-recently-used ref-zero leaves are evicted (hits refresh the whole
   matched path). Partial allocations are fine — caching a prompt's first
   few blocks is still useful.
-- **Correctness rides on the engine's masking argument.** The copy
-  programs move whole padded block spans; rows past the real prefix are
-  garbage the causal position mask hides until the tenant's own
-  prefill/decode overwrites them (see ``engine.py``'s module docstring).
-  Token parity vs solo ``generate()`` is pinned in
-  ``tests/serving_tests/test_prefix_cache.py``.
+- **Shared-pool (paged) mode.** Pass ``pool=`` to make the trie allocate
+  from the same :class:`BlockPool` the engine's decode slots draw from:
+  inserts then *adopt* a slot's already-resident blocks
+  (:meth:`insert_shared` — zero device copies), and
+  :meth:`evictable_blocks` tells the scheduler how many blocks an
+  admission could reclaim on top of the free list.
+- **Correctness rides on the engine's masking argument.** Copied or
+  shared block spans may carry garbage rows past the real prefix; the
+  causal position mask hides them until the tenant's own prefill/decode
+  overwrites them (see ``engine.py``'s module docstring). Token parity vs
+  solo ``generate()`` is pinned in ``tests/serving_tests``.
 
 This module is **pure host state** (numpy + the monitor spine; no jax):
-the trie, the block free-list, and the hit/eviction telemetry. The device
-store and its copy programs live in :class:`~chainermn_tpu.serving.engine.
+the trie, the block pool, and the hit/eviction telemetry. The device
+store and its programs live in :class:`~chainermn_tpu.serving.engine.
 ServingEngine`, which drives this index through ``match`` / ``release`` /
-``plan_insert`` / ``commit_insert`` / ``abort_insert`` from the single
-scheduler thread (this class is intentionally not thread-safe).
+``plan_insert`` / ``commit_insert`` / ``abort_insert`` /
+``insert_shared`` from the single scheduler thread (this class is
+intentionally not thread-safe).
 """
 
 from __future__ import annotations
@@ -50,6 +62,75 @@ from typing import Optional
 import numpy as np
 
 from chainermn_tpu.monitor._state import get_event_log, get_registry
+
+
+class BlockPool:
+    """Ref-counted allocator over the device block store's slots (host
+    bookkeeping only — the arrays live in the engine).
+
+    ``reserve_scratch=True`` pins block 0 as the **scratch block**: never
+    allocated, the well-known target for writes that must land nowhere
+    (inactive batch rows, positions beyond a slot's allocated span). The
+    paged engine points every unused block-table entry at it.
+
+    A block is *allocated* with refcount 1 (:meth:`alloc`); additional
+    holders :meth:`incref`, and :meth:`decref` returns it to the free
+    list only when the last holder lets go — which is what lets a trie
+    eviction and a decode slot disagree about a block's lifetime without
+    ever handing out KV that someone still reads."""
+
+    def __init__(self, n_blocks: int, *, reserve_scratch: bool = False):
+        lo = 1 if reserve_scratch else 0
+        if n_blocks < lo + 1:
+            raise ValueError(
+                f"n_blocks must be >= {lo + 1}, got {n_blocks}")
+        self.n_blocks = int(n_blocks)
+        self.scratch: Optional[int] = 0 if reserve_scratch else None
+        self._lo = lo
+        self._free = list(range(self.n_blocks - 1, lo - 1, -1))
+        self._refs = np.zeros(self.n_blocks, np.int64)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (excludes the scratch block)."""
+        return self.n_blocks - self._lo
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.capacity - len(self._free)
+
+    def refs(self, block: int) -> int:
+        return int(self._refs[block])
+
+    def alloc(self) -> Optional[int]:
+        """One free block at refcount 1, or ``None`` when the pool is dry
+        (the caller may then evict trie leaves and retry)."""
+        if not self._free:
+            return None
+        block = self._free.pop()
+        self._refs[block] = 1
+        return block
+
+    def incref(self, block: int) -> None:
+        self._refs[block] += 1
+
+    def decref(self, block: int) -> None:
+        self._refs[block] -= 1
+        if self._refs[block] == 0:
+            self._free.append(block)
+        elif self._refs[block] < 0:
+            raise RuntimeError(
+                f"block {block} over-released (refcount went negative)")
+
+    def reset(self) -> None:
+        """Everything free, all refcounts dropped — the engine's warm
+        ``restart()`` path (device store is rebuilt alongside)."""
+        self._free = list(range(self.n_blocks - 1, self._lo - 1, -1))
+        self._refs[:] = 0
 
 
 class _Node:
@@ -71,7 +152,8 @@ class PrefixMatch:
     """A pinned longest-cached-prefix result. ``length`` tokens
     (= ``len(block_ids) * block_size``) of the prompt are covered by
     ``block_ids`` in the device store; the holder must ``release()`` it
-    back to the index once the blocks have been copied slot-locally."""
+    back to the index once the blocks have been copied slot-locally (or,
+    paged mode, referenced from the slot's table)."""
 
     nodes: list
     length: int
@@ -102,19 +184,29 @@ class PrefixCacheIndex:
 
     Parameters
     ----------
-    n_blocks : total block slots in the device store (capacity).
+    n_blocks : total block slots in the device store (capacity). Ignored
+        when ``pool`` is given (the pool already knows).
     block_size : tokens per block; matches/inserts are multiples of this.
+    pool : optional shared :class:`BlockPool` — paged mode, where decode
+        slots and the trie draw from one store. Default: a private pool
+        of ``n_blocks`` (the legacy dense-engine configuration).
     """
 
-    def __init__(self, n_blocks: int, block_size: int) -> None:
-        if n_blocks < 1:
-            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+    def __init__(self, n_blocks: int, block_size: int,
+                 pool: Optional[BlockPool] = None) -> None:
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
-        self.n_blocks = int(n_blocks)
+        if pool is None:
+            if n_blocks < 1:
+                raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+            pool = BlockPool(n_blocks)
+            self._pool_private = True
+        else:
+            self._pool_private = False
+        self.pool = pool
+        self.n_blocks = pool.n_blocks
         self.block_size = int(block_size)
         self._root = _Node(None, -1, None)
-        self._free = list(range(self.n_blocks - 1, -1, -1))  # pop() -> 0, 1, ...
         self._clock = itertools.count(1)
         self._events = get_event_log()
         reg = get_registry()
@@ -219,7 +311,7 @@ class PrefixCacheIndex:
         if i >= total:
             return None
         node.refs += 1                    # pin the attachment point
-        blocks = self._alloc(total - i)
+        blocks = self.alloc_blocks(total - i)
         if not blocks:
             node.refs -= 1
             return None
@@ -254,7 +346,44 @@ class PrefixCacheIndex:
             return
         plan.closed = True
         plan.parent.refs -= 1
-        self._free.extend(plan.block_ids)
+        for block in plan.block_ids:
+            self.pool.decref(block)
+
+    def insert_shared(self, tokens, block_ids) -> int:
+        """Paged-mode zero-copy insert: **adopt** already-resident blocks.
+        ``block_ids[j]`` must hold the KV of the prompt's ``j``-th full
+        block (a freshly prefilled slot's table entries do, by
+        construction). Links trie nodes for the not-yet-cached tail of
+        full blocks, increfing each adopted block — the trie becomes a
+        co-owner alongside the donor slot, and the block outlives the
+        donor's retirement. No device work at all: under the unified
+        store, caching a prefix IS bookkeeping. Returns blocks adopted."""
+        tokens = np.asarray(tokens).reshape(-1)
+        bs = self.block_size
+        total = min(len(tokens) // bs, len(block_ids))
+        node, i = self._root, 0
+        t = next(self._clock)
+        while i < total:
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            child.last_use = t
+            node, i = child, i + 1
+        adopted = 0
+        for j in range(i, total):
+            block = int(block_ids[j])
+            self.pool.incref(block)
+            child = _Node(self._key(tokens, j), block, node)
+            child.last_use = t
+            node.children[child.key] = child
+            node = child
+            adopted += 1
+        if adopted:
+            self.inserted_blocks += adopted
+            self._c_inserted.inc(adopted)
+            self._events.emit("prefix_insert", blocks=adopted, depth=total,
+                              used=self.used_blocks, shared=True)
+        return adopted
 
     # ------------------------------------------------------------------ #
     # eviction / capacity                                                 #
@@ -270,31 +399,69 @@ class PrefixCacheIndex:
                 out.append(node)
         return out
 
-    def _alloc(self, n: int) -> list:
+    def alloc_blocks(self, n: int) -> list:
+        """Up to ``n`` blocks from the pool, evicting LRU ref-zero leaves
+        when the free list runs dry (a partial result is fine). Shared by
+        trie inserts and — paged mode — the engine's slot admissions and
+        lazy block appends, so both compete under the same LRU policy."""
         out = []
         while len(out) < n:
-            if self._free:
-                out.append(self._free.pop())
+            block = self.pool.alloc()
+            if block is not None:
+                out.append(block)
                 continue
             victims = self._evictable()
             if not victims:
                 break                      # partial allocation is fine
             victim = min(victims, key=lambda nd: nd.last_use)
             del victim.parent.children[victim.key]
-            self._free.append(victim.block)
+            # may not free the block immediately: a paged decode slot
+            # still referencing it keeps it alive until that slot retires
+            self.pool.decref(victim.block)
             self.evictions += 1
             self._c_evictions.inc()
             self._events.emit("prefix_evict", block=victim.block,
                               age=victim.last_use)
         return out
 
+    # kept as the historical internal name (engine/test callers predate
+    # the shared-pool refactor)
+    _alloc = alloc_blocks
+
+    def evictable_blocks(self) -> int:
+        """How many blocks eviction could *actually return to the free
+        list* right now: nodes in fully-unpinned subtrees whose block has
+        no other holder (pool refcount 1). The scheduler's block-budget
+        admission counts these on top of ``pool.free_blocks`` — a cached
+        but idle prefix is reclaimable capacity, not spent capacity."""
+        pool = self.pool
+
+        def walk(node):
+            unpinned = node is self._root or node.refs == 0
+            count = 0
+            for child in node.children.values():
+                child_ok, child_count = walk(child)
+                count += child_count
+                unpinned = unpinned and child_ok
+            if (node is not self._root and unpinned
+                    and pool.refs(node.block) == 1):
+                count += 1
+            return unpinned, count
+
+        return walk(self._root)[1]
+
     def clear(self) -> None:
-        """Drop every cached prefix and free every block — the engine
-        calls this from ``restart()`` together with rebuilding the device
-        store, because a trie naming blocks of a discarded store would
-        hand out KV that no longer exists."""
+        """Drop every cached prefix and release every trie-held block —
+        the engine calls this from ``restart()`` together with rebuilding
+        the device store, because a trie naming blocks of a discarded
+        store would hand out KV that no longer exists. A private pool is
+        reset wholesale (the legacy behavior — uncommitted plan blocks
+        reclaimed too); a shared pool only gives back the trie's own
+        references (the engine resets the pool itself after dropping the
+        slot tables)."""
         self._root = _Node(None, -1, None)
-        self._free = list(range(self.n_blocks - 1, -1, -1))
+        if self._pool_private:
+            self.pool.reset()
 
     # ------------------------------------------------------------------ #
     # stats                                                               #
@@ -302,7 +469,10 @@ class PrefixCacheIndex:
 
     @property
     def used_blocks(self) -> int:
-        return self.n_blocks - len(self._free)
+        """Allocated blocks in the pool. With a private pool this is the
+        trie's own footprint (legacy meaning); with a shared pool it
+        counts decode-slot blocks too (the whole store's occupancy)."""
+        return self.pool.used_blocks
 
     @property
     def hit_rate(self) -> float:
@@ -322,4 +492,4 @@ class PrefixCacheIndex:
         }
 
 
-__all__ = ["InsertPlan", "PrefixCacheIndex", "PrefixMatch"]
+__all__ = ["BlockPool", "InsertPlan", "PrefixCacheIndex", "PrefixMatch"]

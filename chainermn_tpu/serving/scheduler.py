@@ -28,6 +28,17 @@ unbounded in the legacy single-request configuration, whose behavior —
 including the ``serving.prefill`` fault cut-point and per-request retry —
 is preserved exactly).
 
+Block-budget admission (paged engines): when the engine runs the paged
+KV store, a group member admits only if its WORST-CASE block growth
+(``blocks_needed(prompt, max_new)``) fits ``free + evictable −
+reserved`` — an unaffordable head is put back QUEUED (FCFS preserved)
+instead of being allowed to starve mid-decode later. Before each decode
+step the scheduler appends blocks for slots crossing block boundaries;
+a genuinely dry pool (or an injected ``serving.kv_append`` fault)
+preempts the LOWEST-priority (newest) request back to the queue — its
+re-admission replays prompt+rng from scratch, reproducing the identical
+token stream — rather than failing anyone or burning a restart.
+
 Graceful degradation (the resilience layer):
 
 - **Bounded admission** — ``max_queue`` rejects overload at submit time
@@ -350,6 +361,12 @@ class FCFSScheduler:
                     break
                 calls += 1
                 emitted += self._admit_group(group)
+        # 1b. paged: make sure every active slot can take this step's
+        # token — lazily append blocks for slots crossing a block
+        # boundary, preempting (requeueing, not failing) the lowest-
+        # priority request when the pool runs dry
+        if getattr(self.engine, "paged", False):
+            self._ensure_decode_blocks()
         # 2. decode: every active slot, one token, one compiled call
         t_dec0 = time.perf_counter()
         try:
@@ -377,6 +394,8 @@ class FCFSScheduler:
         # reuse a donor slot
         self.engine.flush_inserts()
         self.metrics.record_step(self.queue_depth, self.engine.active_slots)
+        if getattr(self.engine, "paged", False):
+            self.metrics.record_kv_pool(*self.engine.kv_pool_stats())
         return emitted
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> int:
@@ -405,6 +424,7 @@ class FCFSScheduler:
         ...]``; every selected request is moved to PREFILL, every
         unselected candidate's plan is cancelled (match unpinned)."""
         eng = self.engine
+        paged = getattr(eng, "paged", False)
         cap = min(eng.prefill_batch, len(eng.free_slots))
         with self._lock:
             if not self._queue:
@@ -412,7 +432,21 @@ class FCFSScheduler:
             head = self._queue.popleft()
             head.state = RequestState.PREFILL
         self._span_to_admit(head)
-        plan = eng.plan_admission(head.prompt, head.rng)
+        plan = eng.plan_admission(head.prompt, head.rng,
+                                  max_new=head.max_new_tokens)
+        # block-budget admission (paged): admit only what free + evictable
+        # blocks cover at WORST-CASE growth — an over-admitted request
+        # would fail mid-decode later; a deferred one just stays QUEUED
+        # until retirements return blocks (FCFS order preserved)
+        budget = None
+        if paged:
+            budget = eng.kv_blocks_admittable()
+            need = eng.blocks_needed(len(head.prompt),
+                                     head.max_new_tokens, plan.start)
+            if need > budget:
+                self._defer_admission(head, plan, need, budget)
+                return []
+            budget -= need
         group = [(head, plan)]
         if cap <= 1:
             return group
@@ -420,7 +454,8 @@ class FCFSScheduler:
             candidates = list(self._queue)
         scored = []
         for idx, req in enumerate(candidates):
-            p = eng.plan_admission(req.prompt, req.rng)
+            p = eng.plan_admission(req.prompt, req.rng,
+                                   max_new=req.max_new_tokens)
             if p.bucket != plan.bucket:
                 eng.cancel_plan(p)
                 continue
@@ -429,7 +464,9 @@ class FCFSScheduler:
             scored.append((0 if shares else 1, idx, req, p))
         scored.sort(key=lambda t: (t[0], t[1]))
         for rank, (_, _, req, p) in enumerate(scored):
-            if rank < cap - 1:
+            need = (eng.blocks_needed(len(req.prompt), req.max_new_tokens,
+                                      p.start) if paged else 0)
+            if rank < cap - 1 and (budget is None or need <= budget):
                 with self._lock:
                     try:
                         self._queue.remove(req)   # lost a cancel() race?
@@ -439,9 +476,28 @@ class FCFSScheduler:
                     req.state = RequestState.PREFILL
                 self._span_to_admit(req)
                 group.append((req, p))
+                if budget is not None:
+                    budget -= need
             else:
                 eng.cancel_plan(p)
         return group
+
+    def _defer_admission(self, req: Request, plan, need: int,
+                         available: int) -> None:
+        """Paged admission gate tripped: put the request BACK at the
+        queue head (FCFS — it admits first once blocks free up) instead
+        of letting it fail mid-decode later. The pinned plan is
+        released; the wait shows up in the request's ``queue`` span."""
+        self.engine.cancel_plan(plan)
+        if req._span_admit is not None:
+            req.trace.end_span(req._span_admit)
+            req._span_admit = None
+        req._span_queue = req.trace.start_span("queue")
+        with self._lock:
+            req.state = RequestState.QUEUED
+            self._queue.appendleft(req)
+        self._events.emit("kv_admit_defer", req=req.id, need=need,
+                          available=available, **self._trace_label(req))
 
     def _span_to_admit(self, req: Request) -> None:
         """Queue wait is over: close the request's ``queue`` span and open
@@ -567,6 +623,72 @@ class FCFSScheduler:
             req._done.set()
 
     # ------------------------------------------------------------------ #
+    # paged-KV block management (decode-side)                             #
+    # ------------------------------------------------------------------ #
+
+    def _ensure_decode_blocks(self) -> None:
+        """Before a paged decode step: append a fresh block for every
+        active slot whose next write crosses a block boundary. When the
+        pool is dry (even after trie eviction), deterministically preempt
+        the LOWEST-priority request — the most recently submitted
+        (highest id) — requeueing it instead of failing anyone
+        mid-decode; an injected ``serving.kv_append`` fault is contained
+        the same way (only that slot's request preempts — no engine
+        restart burned, every other slot keeps decoding)."""
+        eng = self.engine
+        for slot in sorted(self._by_slot):
+            req = self._by_slot.get(slot)
+            if req is None:
+                continue
+            while eng.slot_needs_block(slot):
+                try:
+                    appended = eng.append_block(slot)
+                except Exception as e:  # noqa: BLE001 — containment
+                    self._preempt(req, reason=f"kv_append_"
+                                              f"{type(e).__name__}")
+                    break
+                if appended:
+                    break
+                victim = max(self._by_slot.values(), key=lambda r: r.id)
+                self._preempt(victim, reason="kv_pool_dry")
+                if victim is req:
+                    break   # we were the lowest priority ourselves
+
+    def _preempt(self, req: Request, reason: str) -> None:
+        """Evict a decoding request back to QUEUED: its slot and blocks
+        free immediately, its generated-so-far tokens are discarded, and
+        it re-enters the queue in submission-id order (FCFS). On
+        re-admission it replays the SAME prompt with the SAME rng, so the
+        sampler split sequence — and therefore the token stream —
+        reproduces exactly (greedy or sampled); a ``stream_cb`` consumer
+        sees the replayed tokens again."""
+        with self._lock:
+            if req.finished:
+                return
+            if req.slot >= 0:
+                self.engine.release(req.slot)
+                self._by_slot.pop(req.slot, None)
+            req.slot = -1
+            req.tokens = []
+            req.state = RequestState.QUEUED
+            # reinsert preserving id (arrival) order among QUEUED peers
+            idx = 0
+            for idx, queued in enumerate(self._queue):  # noqa: B007
+                if queued.id > req.id:
+                    break
+            else:
+                idx = len(self._queue)
+            self._queue.insert(idx, req)
+        self.metrics.record_preemption()
+        if req._span_admit is not None:
+            req.trace.end_span(req._span_admit)
+            req._span_admit = None
+        req._span_queue = req.trace.start_span("queue")
+        self._events.emit("kv_preempt", req=req.id, reason=reason,
+                          queue_depth=self.queue_depth,
+                          **self._trace_label(req))
+
+    # ------------------------------------------------------------------ #
     # degradation internals                                               #
     # ------------------------------------------------------------------ #
 
@@ -666,9 +788,15 @@ class FCFSScheduler:
             self._retire(req, "eos" if hit_eos else "length")
 
     def _retire(self, req: Request, reason: str) -> None:
+        paged = getattr(self.engine, "paged", False)
         with self._lock:
             if req.finished:   # a concurrent cancel() won the race
                 return
+            if paged:
+                # sampled BEFORE release drops the table: how many store
+                # blocks this request's whole life actually took
+                self.metrics.record_request_blocks(
+                    self.engine.slot_block_count(req.slot))
             self.engine.release(req.slot)
             self._by_slot.pop(req.slot, None)
             req.state = RequestState.DONE

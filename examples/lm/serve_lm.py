@@ -32,6 +32,14 @@ reuse — with ``--shared-prefix M`` every burst prompt shares an M-token
 system prompt, so admissions prefill only their ragged tails (the prefix
 stats print at the end: hit rate, evictions, store occupancy).
 
+And the paged KV store (PR 7): ``--paged-kv`` runs decode on one shared
+block store with per-slot block tables — slots stop reserving worst-case
+``cache_len`` regions, so the same device memory serves 4x+ more
+concurrent requests, prefix hits become zero-copy shared table entries,
+and ``--kv-quant int8`` halves resident KV bytes again; ``--kv-blocks``
+caps the pool (admission then defers to the queue, and a dry pool
+preempts+requeues the newest request instead of failing it).
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -98,6 +106,23 @@ def main() -> None:
                     help="give every burst prompt a shared system-prompt "
                          "prefix of this many tokens — the workload "
                          "prefix caching exists for (0: fully ragged)")
+    ap.add_argument("--paged-kv", action="store_true",
+                    help="paged KV decode: one shared block store under "
+                         "every slot (block-table indexed), admission by "
+                         "free blocks instead of worst-case slot "
+                         "regions — 4x+ more concurrent requests at the "
+                         "same device KV memory; prefix hits become "
+                         "zero-copy shared table entries")
+    ap.add_argument("--kv-blocks", type=int, default=0,
+                    help="paged: total store blocks incl. the scratch "
+                         "block (0: dense-equivalent capacity, "
+                         "slots x ceil(cache_len/block))")
+    ap.add_argument("--kv-block-size", type=int, default=16,
+                    help="paged: tokens per KV block")
+    ap.add_argument("--kv-quant", choices=("none", "int8"), default="none",
+                    help="paged: int8-quantize resident blocks (per-row "
+                         "per-head scales, ~2x less KV memory; small "
+                         "tested logit perturbation)")
     ap.add_argument("--max-new", type=int, default=24)
     ap.add_argument("--vocab", type=int, default=128)
     ap.add_argument("--d-model", type=int, default=64)
@@ -166,13 +191,22 @@ def main() -> None:
 
     buckets = (tuple(int(b) for b in args.prefill_buckets.split(","))
                if args.prefill_buckets else None)
+    paged_kw = {}
+    if args.paged_kv:
+        paged_kw = dict(paged=True, kv_blocks=args.kv_blocks or None,
+                        kv_block_size=args.kv_block_size,
+                        kv_quant=args.kv_quant)
+        if args.prefix_blocks:
+            raise SystemExit("--paged-kv unifies the prefix cache onto the "
+                             "shared block store; drop --prefix-blocks and "
+                             "size it with --kv-blocks/--kv-block-size")
     engine = ServingEngine(
         model, params, n_slots=args.slots, prefill_len=args.prefill_len,
         prefill_buckets=buckets, prefill_batch=args.prefill_batch,
         prefix_cache_blocks=args.prefix_blocks,
         prefix_block_size=args.prefix_block_size,
         temperature=args.temperature, comm=comm,
-        watchdog=args.watchdog or None,
+        watchdog=args.watchdog or None, **paged_kw,
     )
     engine.warmup()   # every bucket + decode compile once, off the burst
 
@@ -245,6 +279,9 @@ def main() -> None:
     if engine.prefix_enabled:
         print("prefix cache: " + ", ".join(
             f"{k}={v}" for k, v in engine.prefix_stats().items()))
+    if engine.paged:
+        print("paged KV: " + ", ".join(
+            f"{k}={v}" for k, v in engine.kv_stats().items()))
     print(f"engine executables: {engine.compile_counts_detailed()} "
           "(zero recompiles after warmup)")
     if slo_engine is not None:

@@ -159,6 +159,20 @@ def test_serve_lm():
     assert "zero recompiles" in proc.stdout
 
 
+def test_serve_lm_paged_kv():
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "6", "--slots", "4", "--max-new", "6",
+         "--prefill-len", "8", "--d-model", "32", "--layers", "1",
+         "--heads", "4", "--paged-kv", "--kv-block-size", "4",
+         "--kv-quant", "int8"],
+    )
+    assert "6/6 requests served" in proc.stdout
+    assert "paged KV: kv_blocks=" in proc.stdout
+    assert "kv_quant=int8" in proc.stdout
+    assert "zero recompiles" in proc.stdout
+
+
 def test_serve_lm_tensor_parallel():
     proc = run_example(
         "lm/serve_lm.py",

@@ -365,3 +365,110 @@ def test_zigzag_halves_causal_work(comm):
         q[:, perm], k[:, perm], v[:, perm])
     # theory: 0.5 + O(1/n); generous bound for timer noise
     assert zig < 0.8 * noskip, (zig, noskip)
+
+
+# --------------------------------------------------------------------- #
+# paged KV decode path (PR 7)                                            #
+# --------------------------------------------------------------------- #
+
+
+def _paged_setup(b=3, s=2, h=4, d=8, bs=4, n_max=4, quant="none", seed=3):
+    """Random q/k/v rows plus a dense cache and its paged twin holding
+    identical pre-existing KV, with identity block tables (row i's blocks
+    are a contiguous span of the store) and per-row positions."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 6)
+    t = n_max * bs
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+    kbuf = jax.random.normal(ks[3], (b, t, h, d), jnp.float32)
+    vbuf = jax.random.normal(ks[4], (b, t, h, d), jnp.float32)
+    pos = jnp.asarray([0, 5, 9][:b], jnp.int32)  # ragged per-row depths
+    dense = {"k": kbuf, "v": vbuf}
+    n_blocks = b * n_max + 1                     # + scratch block 0
+    store_k = kbuf.reshape(b * n_max, bs, h, d)
+    store_v = vbuf.reshape(b * n_max, bs, h, d)
+    pad = jnp.zeros((1, bs, h, d), jnp.float32)
+    paged = {
+        "k": jnp.concatenate([pad, store_k]),
+        "v": jnp.concatenate([pad, store_v]),
+        "table": (1 + jnp.arange(b * n_max, dtype=jnp.int32)
+                  ).reshape(b, n_max),
+    }
+    if quant == "int8":
+        # start from an EMPTY int8 store (pre-existing rows would need
+        # quantizing too; the engine only ever writes through the quant
+        # path, so an empty store + fresh writes is the honest setup)
+        z = jnp.zeros((n_blocks, bs, h, d), jnp.int8)
+        sc = jnp.zeros((n_blocks, bs, h), jnp.float32)
+        paged = {"k": z, "v": z, "k_scale": sc, "v_scale": sc,
+                 "table": paged["table"]}
+    return q, k, v, pos, dense, paged
+
+
+def test_paged_update_matches_dense_update():
+    """paged_update_cache_and_attend == the dense [B] path bit-for-bit
+    when the store holds the same KV: same writes (round-tripped through
+    the block layout), same attention output."""
+    from chainermn_tpu.parallel.sequence import update_cache_and_attend
+
+    q, k, v, pos, dense, paged = _paged_setup()
+    out_d, new_d = update_cache_and_attend(dense, q, k, v, pos)
+    out_p, new_p = update_cache_and_attend(paged, q, k, v, pos)
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_d))
+    b, _, h, d = q.shape
+    n_max = paged["table"].shape[1]
+    bs = paged["k"].shape[1]
+    for kk in ("k", "v"):
+        round_trip = np.asarray(new_p[kk])[1:].reshape(b, n_max * bs, h, d)
+        np.testing.assert_array_equal(round_trip, np.asarray(new_d[kk]))
+    assert "table" not in new_p       # host-managed state, not returned
+
+
+def test_paged_update_scatters_through_ragged_tables():
+    """A permuted (non-identity) table must read/write the same logical
+    rows: permuting each row's blocks AND its table entries together
+    changes nothing observable."""
+    from chainermn_tpu.parallel.sequence import update_cache_and_attend
+
+    q, k, v, pos, _, paged = _paged_setup(b=2, n_max=3)
+    out_ref, _ = update_cache_and_attend(paged, q, k, v, pos)
+    perm = np.array([0, 5, 3, 1, 6, 2, 4])       # fixed block shuffle
+    inv = np.argsort(perm)
+    shuffled = {
+        "k": jnp.asarray(np.asarray(paged["k"])[inv]),
+        "v": jnp.asarray(np.asarray(paged["v"])[inv]),
+        "table": jnp.asarray(perm[np.asarray(paged["table"])], jnp.int32),
+    }
+    out_sh, _ = update_cache_and_attend(shuffled, q, k, v, pos)
+    np.testing.assert_array_equal(np.asarray(out_sh), np.asarray(out_ref))
+
+
+def test_paged_int8_quant_tolerance():
+    """int8 resident blocks: per-row-per-head scales bound the dequant
+    error at ~0.8% of each row's max |x|, and the attention output stays
+    within a small absolute tolerance of the fp path built from the SAME
+    (quantize-on-write) history."""
+    from chainermn_tpu.parallel.sequence import update_cache_and_attend
+
+    q, k, v, pos, _, paged_q = _paged_setup(quant="int8")
+    _, _, _, _, _, paged_f = _paged_setup()
+    # write the same rows through both stores starting EMPTY (zero the fp
+    # store's pre-existing rows so both paths attend identical history)
+    paged_f = {"k": jnp.zeros_like(paged_f["k"]),
+               "v": jnp.zeros_like(paged_f["v"]),
+               "table": paged_f["table"]}
+    out_f, new_f = update_cache_and_attend(paged_f, q, k, v, pos)
+    out_q, new_q = update_cache_and_attend(paged_q, q, k, v, pos)
+    # round-trip error bound: |x - x_q*scale| <= scale/2 = max|x|/254
+    deq = (np.asarray(new_q["k"], np.float32)
+           * np.asarray(new_q["k_scale"])[..., None])
+    ref = np.asarray(new_f["k"])
+    written = np.abs(ref) > 0
+    err = np.abs(deq - ref)[written]
+    step = (np.abs(ref).max(axis=-1, keepdims=True) / 127.0
+            + 1e-8) * np.ones_like(ref)
+    assert (err <= 0.51 * step[written] + 1e-6).all()
+    # end-to-end attention perturbation stays small
+    np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_f),
+                               atol=0.08)

@@ -395,3 +395,31 @@ def test_batch_retry_absorbs_transient_admission_fault(lm_and_params):
     assert r1.state is RequestState.DONE and r2.state is RequestState.DONE
     assert sched.engine_restarts == 0
     assert sched.metrics.report()["requests_errored"] == 0
+
+
+def test_kv_append_fault_preempts_without_burning_a_restart(lm_and_params):
+    """Chaos case (PR 7): an injected fault at the paged engine's lazy
+    block append is contained by PREEMPTING only that slot's request —
+    requeued, replayed, finished — while the other slot decodes straight
+    through to a solo-parity completion. No engine restart, no ERRORED
+    request, exactly one preemption counted."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                           cache_len=24, paged=True, kv_block_size=4)
+    engine.warmup()
+    sched = FCFSScheduler(engine)
+    inj = FaultInjector()
+    inj.arm("serving.kv_append", kind="raise", times=1)
+    ra = sched.submit(np.array([1, 2, 3]), 8)    # crosses a boundary first
+    rb = sched.submit(np.array([4, 5]), 8)
+    with inj:
+        sched.run_until_idle()
+    assert inj.fired_log == [("serving.kv_append", "raise")]
+    assert sched.engine_restarts == 0
+    assert ra.state is RequestState.DONE and rb.state is RequestState.DONE
+    for req, prompt in ((ra, [1, 2, 3]), (rb, [4, 5])):
+        ref = generate(lm, params, jnp.asarray([prompt], jnp.int32), 8)
+        np.testing.assert_array_equal(req.output, np.asarray(ref[0]))
+    m = sched.metrics.report()
+    assert m["kv_preemptions"] == 1
+    assert m["requests_errored"] == 0

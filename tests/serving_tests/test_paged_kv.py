@@ -1,0 +1,370 @@
+"""Paged KV decode: one shared block store under every slot (PR 7).
+
+The load-bearing properties, in dependency order: the host
+:class:`BlockPool` refcounting that lets the trie and the decode slots
+co-own blocks; token-for-token parity of the paged engine vs solo
+``generate()`` on staggered ragged batches (incl. zero recompiles across
+lazy block appends); shared-prefix admission as plain table references
+(no copy programs exist in paged mode); LRU eviction under a tiny pool;
+block-budget admission deferring to QUEUED instead of failing
+mid-decode; preempt-then-resume replay parity (the ``serving.kv_append``
+fault path); ``restart()`` rebuilding store + pool + tables + trie
+together; int8-quantized resident blocks staying within greedy-token
+tolerance; and the tensor-parallel variant of the whole thing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.resilience import FaultInjector
+from chainermn_tpu.serving import (
+    BlockPool,
+    FCFSScheduler,
+    PrefixCacheIndex,
+    RequestState,
+    ServingEngine,
+)
+
+# --------------------------------------------------------------------- #
+# host pool (no jax, sub-millisecond)                                    #
+# --------------------------------------------------------------------- #
+
+
+def test_block_pool_refcounts_and_scratch():
+    pool = BlockPool(5, reserve_scratch=True)
+    assert pool.scratch == 0 and pool.capacity == 4
+    a, b = pool.alloc(), pool.alloc()
+    assert 0 not in (a, b)                       # scratch never allocated
+    assert pool.used_blocks == 2
+    pool.incref(a)                               # second holder (a slot)
+    pool.decref(a)                               # trie lets go first...
+    assert pool.used_blocks == 2                 # ...block still resident
+    pool.decref(a)                               # last holder retires
+    assert pool.used_blocks == 1
+    pool.decref(b)
+    assert pool.free_blocks == pool.capacity
+    with pytest.raises(RuntimeError, match="over-released"):
+        pool.decref(b)
+
+
+def test_trie_on_shared_pool_defers_frees_to_slot_holders():
+    """Evicting a trie node whose block a decode slot still references
+    must NOT free the block — and evictable_blocks() must not count it
+    as reclaimable either."""
+    pool = BlockPool(4, reserve_scratch=True)
+    idx = PrefixCacheIndex(4, 2, pool=pool)
+    adopted = pool.alloc()                       # "slot" block with KV
+    idx.insert_shared(np.array([1, 2]), [adopted])
+    assert pool.refs(adopted) == 2               # slot + trie
+    assert idx.evictable_blocks() == 0           # eviction wouldn't free it
+    # exhaust the pool through the trie: the adopted node IS evictable
+    # trie-wise (ref-zero leaf), so one eviction fires — but it frees
+    # nothing while the slot still holds the block
+    got = idx.alloc_blocks(3)
+    assert len(got) == 2
+    assert idx.evictions == 1 and pool.free_blocks == 0
+    pool.decref(adopted)                         # slot retires -> frees now
+    assert pool.free_blocks == 1
+
+
+# --------------------------------------------------------------------- #
+# engine: parity, sharing, recompiles                                    #
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=2,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+@pytest.fixture(scope="module")
+def warm_paged(lm_and_params):
+    """One warmed paged engine shared by the parity tests: two buckets,
+    batch-2 prefill, 2-token blocks on the unified store."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=3,
+                           prefill_buckets=(4, 8), prefill_batch=2,
+                           paged=True, kv_block_size=2, cache_len=32)
+    engine.warmup()
+    return engine
+
+
+def solo(lm, params, prompt, n, **kw):
+    out = generate(lm, params, jnp.asarray(prompt, jnp.int32)[None], n, **kw)
+    return np.asarray(out[0])
+
+
+PREFIX = [1, 2, 3, 4, 5, 6]
+
+
+def test_paged_staggered_ragged_matches_solo_and_never_recompiles(
+        lm_and_params, warm_paged):
+    """THE paged acceptance test: mixed ragged prompts through the block
+    store at staggered times — more requests than slots, slots retired
+    and reused, block tables appended lazily mid-decode — each request
+    token-for-token its solo generate(), with the executable count
+    pinned across every append (zero recompiles: table CONTENTS change,
+    shapes never)."""
+    lm, params = lm_and_params
+    engine = warm_paged
+    counts = engine.compile_counts_detailed()
+    assert set(counts.values()) == {1}, counts
+    sched = FCFSScheduler(engine)
+    prompts = [np.array([1, 2, 3]), np.array([4, 5, 6, 7, 8]),
+               np.array([9, 10]), np.array([11, 12, 13, 14]),
+               np.array([2, 4, 6, 8, 10, 12, 14, 16]), np.array([5])]
+    n_new = [6, 4, 7, 5, 3, 8]
+    reqs = [sched.submit(p, n) for p, n in zip(prompts, n_new)]
+    sched.run_until_idle()
+    assert all(r.finished for r in reqs)
+    for p, n, r in zip(prompts, n_new, reqs):
+        np.testing.assert_array_equal(r.output, solo(lm, params, p, n))
+    # decode crossed block boundaries -> lazy appends really happened
+    m = sched.metrics.report()
+    assert m["kv_blocks_per_request_max"] >= 2
+    assert engine.compile_counts_detailed() == counts
+    assert engine.recompiles == {}
+    # everything released: only trie-retained prefix blocks stay resident
+    assert engine.active_slots == 0
+    assert engine.kv_stats()["blocks_reserved"] == 0
+
+
+def test_shared_prefix_is_reference_not_copy(lm_and_params, warm_paged):
+    """A donor seeds the trie by pure adoption (its own blocks — no
+    device copy program even exists in paged mode) and RETIRES; two
+    followers sharing the prefix admit with the shared blocks as table
+    references, parity intact. The store must hold ONE copy of the
+    shared span, not three."""
+    lm, params = lm_and_params
+    engine = warm_paged
+    sched = FCFSScheduler(engine)
+    donor = sched.submit(np.array(PREFIX + [7]), 5)
+    sched.run_until_idle()
+    assert donor.finished
+    h0 = engine.prefix_cache.hits
+    used0 = engine._pool.used_blocks           # trie-retained blocks
+    r1 = sched.submit(np.array(PREFIX + [8]), 6)
+    r2 = sched.submit(np.array(PREFIX + [9, 10]), 4)
+    sched.step()                               # ONE admission round
+    assert r1.slot >= 0 and r2.slot >= 0       # same batched call
+    # both followers reference the donor's 3 prefix blocks instead of
+    # allocating fresh copies: growth is only their private tails
+    shared_blocks = len(PREFIX) // engine.kv_block_size
+    assert engine.slot_block_count(r1.slot) >= shared_blocks
+    assert (engine._tables[r1.slot, :shared_blocks]
+            == engine._tables[r2.slot, :shared_blocks]).all()
+    assert engine._pool.used_blocks < used0 + 2 * shared_blocks
+    sched.run_until_idle()
+    np.testing.assert_array_equal(r1.output,
+                                  solo(lm, params, PREFIX + [8], 6))
+    np.testing.assert_array_equal(r2.output,
+                                  solo(lm, params, PREFIX + [9, 10], 4))
+    assert engine.prefix_cache.hits >= h0 + 2
+    assert "prefix_insert" not in engine.compile_counts_detailed()
+
+
+def test_eviction_then_readmit_matches_solo(lm_and_params):
+    """Tiny pool: caching B must evict A's idle prefix; A then readmits
+    as a miss (full prefill into fresh blocks) with identical tokens."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_buckets=(8,),
+                           paged=True, kv_block_size=2, kv_blocks=8,
+                           cache_len=16)
+    engine.warmup()
+    sched = FCFSScheduler(engine)
+    a = np.array(PREFIX + [7])
+    b = np.array([9, 10, 11, 12, 13, 14, 15])
+    ra1 = sched.submit(a, 4)
+    sched.run_until_idle()
+    rb = sched.submit(b, 4)
+    sched.run_until_idle()
+    ra2 = sched.submit(a, 4)
+    sched.run_until_idle()
+    assert engine.prefix_cache.evictions >= 1
+    ref = solo(lm, params, a, 4)
+    np.testing.assert_array_equal(ra1.output, ref)
+    np.testing.assert_array_equal(ra2.output, ref)
+    np.testing.assert_array_equal(rb.output, solo(lm, params, b, 4))
+
+
+# --------------------------------------------------------------------- #
+# block-budget admission + preemption                                    #
+# --------------------------------------------------------------------- #
+
+
+def test_block_budget_admission_defers_to_queued(lm_and_params):
+    """Admission keys on free+evictable blocks at WORST-CASE growth, not
+    free slots: with 6 usable blocks and 3-block requests, the third
+    request stays QUEUED (never errors, never preempts) although a slot
+    is free, and admits once a retirement returns blocks."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=3, prefill_buckets=(6,),
+                           paged=True, kv_block_size=4, kv_blocks=7,
+                           cache_len=24)
+    engine.warmup()
+    sched = FCFSScheduler(engine)
+    reqs = [sched.submit(np.array([1 + i, 2, 3]), 8) for i in range(3)]
+    sched.step()
+    sched.step()
+    # two fit (2 x 3 blocks = the whole pool); the third waits QUEUED
+    assert sorted(r.slot >= 0 for r in reqs) == [False, True, True]
+    assert all(r.state in (RequestState.QUEUED, RequestState.DECODE)
+               for r in reqs)
+    assert engine.peak_active == 2
+    sched.run_until_idle()
+    for i, r in enumerate(reqs):
+        np.testing.assert_array_equal(
+            r.output, solo(lm, params, [1 + i, 2, 3], 8))
+    assert sched.metrics.report().get("kv_preemptions", 0) == 0
+
+
+def test_preempt_then_resume_replays_exactly(lm_and_params):
+    """An injected ``serving.kv_append`` fault preempts ONLY that slot's
+    request back to the queue; on re-admission it replays prompt+rng from
+    scratch and still matches solo generate() — and the other slot never
+    stopped decoding (no restart, no errors)."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_buckets=(6,),
+                           paged=True, kv_block_size=4, cache_len=24)
+    engine.warmup()
+    sched = FCFSScheduler(engine)
+    inj = FaultInjector(seed=0)
+    inj.arm("serving.kv_append", kind="raise", times=1)
+    ra = sched.submit(np.array([1, 2, 3]), 8)
+    rb = sched.submit(np.array([4, 5]), 8)
+    with inj:
+        sched.run_until_idle()
+    assert inj.fired_log == [("serving.kv_append", "raise")]
+    assert sched.engine_restarts == 0
+    assert ra.state is RequestState.DONE and rb.state is RequestState.DONE
+    np.testing.assert_array_equal(ra.output, solo(lm, params, [1, 2, 3], 8))
+    np.testing.assert_array_equal(rb.output, solo(lm, params, [4, 5], 8))
+    assert sched.metrics.report()["kv_preemptions"] == 1
+
+
+def test_restart_resets_tables_pool_and_trie_together(lm_and_params):
+    """Stale-table pinning: a warm restart must drop slot tables, reset
+    the pool, AND clear the trie with the rebuilt store — a survivor of
+    any of the three would pin (or serve) blocks of dead KV. Same
+    executables after (nothing recompiles)."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_buckets=(6,),
+                           paged=True, kv_block_size=2, cache_len=24)
+    engine.warmup()
+    counts = engine.compile_counts_detailed()
+    sched = FCFSScheduler(engine)
+    seed = sched.submit(np.array(PREFIX), 4)
+    sched.run_until_idle()
+    assert seed.finished and engine._pool.used_blocks > 0
+    inj = FaultInjector(seed=0)
+    inj.arm("serving.decode", kind="raise", times=1)
+    with inj:
+        victim = sched.submit(np.array([2, 3, 4]), 6)
+        sched.run_until_idle()
+    assert victim.state.value == "errored"
+    assert sched.engine_restarts == 1
+    assert engine._pool.used_blocks == 0
+    assert (engine._tables == 0).all()
+    assert engine.prefix_cache.match(np.array(PREFIX)) is None
+    redo = sched.submit(np.array([2, 3, 4]), 6)
+    sched.run_until_idle()
+    np.testing.assert_array_equal(redo.output,
+                                  solo(lm, params, [2, 3, 4], 6))
+    assert engine.compile_counts_detailed() == counts
+
+
+# --------------------------------------------------------------------- #
+# int8 quantized resident blocks                                         #
+# --------------------------------------------------------------------- #
+
+
+def test_int8_quant_greedy_tokens_within_tolerance(lm_and_params):
+    """kv_quant='int8' perturbs attention by <= the per-row quant step —
+    greedy decode must stay near-identical to the fp reference on this
+    model (the hard bit-parity bar applies to kv_quant='none' only, and
+    is pinned by the parity tests above)."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_buckets=(8,),
+                           paged=True, kv_block_size=4, kv_quant="int8",
+                           cache_len=32)
+    engine.warmup()
+    sched = FCFSScheduler(engine)
+    jobs = [([1, 2, 3], 8), ([4, 5, 6, 7, 8], 6)]
+    reqs = [sched.submit(np.array(p), n) for p, n in jobs]
+    sched.run_until_idle()
+    total = agree = 0
+    for (p, n), r in zip(jobs, reqs):
+        ref = solo(lm, params, p, n)
+        assert r.output[len(p)] == ref[len(p)]   # first token: exact
+        total += n
+        agree += int(np.sum(np.asarray(r.output) == ref)) - len(p)
+    assert agree / total >= 0.9, (agree, total)
+    assert engine.recompiles == {}
+
+
+# --------------------------------------------------------------------- #
+# tensor parallel                                                        #
+# --------------------------------------------------------------------- #
+
+
+def test_tp_paged_matches_solo_tp_generate():
+    """The paged store head-sharded over the mesh: same scheduler, same
+    parity bar — and a same-prefix follower shares head-sharded blocks."""
+    comm = chainermn_tpu.create_communicator("tpu")
+    lm = TransformerLM(vocab_size=32, d_model=16, n_heads=8, n_layers=2,
+                       max_len=32, tensor_axis=comm.axis_name,
+                       compute_dtype=jnp.float32)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    params = jax.jit(comm.shard_map(
+        lambda t: lm.init(jax.random.PRNGKey(1), t),
+        in_specs=P(), out_specs=P(),
+    ))(prompt)
+    ref = generate(lm, params, prompt, 5, comm=comm)
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=8,
+                           cache_len=16, comm=comm, paged=True,
+                           kv_block_size=2)
+    engine.warmup()
+    sched = FCFSScheduler(engine)
+    r1 = sched.submit(np.array([1, 2, 3]), 5)
+    r2 = sched.submit(np.array([4, 5, 6, 7]), 4)
+    sched.run_until_idle()
+    np.testing.assert_array_equal(r1.output, np.asarray(ref[0]))
+    assert len(r2.tokens) == 4
+    r3 = sched.submit(np.array([1, 2, 9]), 5)    # shares block [1, 2]
+    sched.run_until_idle()
+    assert engine.prefix_cache.hits >= 1
+    ref3 = generate(lm, params, jnp.asarray([[1, 2, 9]], jnp.int32), 5,
+                    comm=comm)
+    np.testing.assert_array_equal(r3.output, np.asarray(ref3[0]))
+    assert set(engine.compile_counts_detailed().values()) == {1}
+
+
+# --------------------------------------------------------------------- #
+# config validation                                                      #
+# --------------------------------------------------------------------- #
+
+
+def test_paged_config_validation(lm_and_params):
+    lm, params = lm_and_params
+    with pytest.raises(ValueError, match="unifies"):
+        ServingEngine(lm, params, n_slots=1, prefill_len=4, paged=True,
+                      prefix_cache_blocks=8)
+    with pytest.raises(ValueError, match="kv_quant"):
+        ServingEngine(lm, params, n_slots=1, prefill_len=4,
+                      kv_quant="int8")
+    with pytest.raises(ValueError, match="kv_quant"):
+        ServingEngine(lm, params, n_slots=1, prefill_len=4, paged=True,
+                      kv_quant="fp4")
+    engine = ServingEngine(lm, params, n_slots=1, prefill_len=4,
+                           paged=True, kv_block_size=4, kv_blocks=3,
+                           cache_len=16)
+    with pytest.raises(ValueError, match="KV blocks"):
+        engine.validate_request(4, 12)   # 4 blocks worst case, pool holds 2

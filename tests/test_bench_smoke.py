@@ -109,6 +109,18 @@ def test_bench_serving_mode_smoke():
     # workload (the CPU-mesh margin is ~3x — ample against timer noise)
     assert p["ttft_p50_ms"] < p["ttft_p50_ms_off"], p
     assert p["prefill_batch_occupancy"] > 1.0  # batching really batched
+    # ---- the PR-7 paged KV store (acceptance criterion) ------------- #
+    pg = rec["paged_serving"]
+    # >= 4x the dense engine's concurrency under the SAME device KV
+    # memory budget (identical resident-row count), token parity intact,
+    # nothing recompiled, and the clean run needed no preemption (block-
+    # budget admission reserved worst-case growth up front)
+    assert pg["concurrency_gain"] >= 4.0, pg
+    assert pg["max_concurrent_dense"] == pg["dense_slots"]
+    assert pg["parity_vs_solo_generate"] is True
+    assert pg["recompiles_after_warmup"] == 0
+    assert pg["preemptions"] == 0
+    assert pg["kv_blocks_per_request_mean"] >= 1.0
 
 
 def _run_monitor_mode(extra_env):
