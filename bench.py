@@ -850,6 +850,98 @@ def serving_main() -> None:
             f"{p['max_concurrent_dense']} concurrent "
             f"({p['concurrency_gain']}x) at {budget_rows} KV rows, "
             f"preemptions={p['preemptions']}, parity={pg_parity}")
+
+        # ---- fleet: N replicas vs 1 at equal total KV budget (ISSUE 8) - #
+        # The SAME prefix-heavy workload through a FleetRouter over
+        # fl_n replicas of n_slots/fl_n slots each (total KV budget ==
+        # the solo prefix engine above, whose numbers are the baseline),
+        # plus the kill-one-replica continuity probe: replica 0 is
+        # hard-killed once it owns live work — its queued/in-flight
+        # requests must re-route (replayed, stream-dedup'd) or end
+        # cleanly ERRORED per deadline policy; none may be lost.
+        from chainermn_tpu.fleet import FleetRouter
+        from chainermn_tpu.serving.scheduler import DeadlineExceededError
+
+        fl_n = int(e("CHAINERMN_TPU_SERVE_FLEET_REPLICAS", "2"))
+        fl_slots = max(1, n_slots // fl_n)
+        fl_engines = [ServingEngine(
+            model, params, n_slots=fl_slots, prefill_buckets=buckets,
+            prefill_batch=batch_k, prefix_cache_blocks=n_blocks,
+            prefix_block_size=block, prefix_min_insert_blocks=min_insert)
+            for _ in range(fl_n)]
+        router = FleetRouter(fl_engines, affinity=True)
+        try:
+            assert router.wait_ready(600), "fleet warmup timed out"
+            t0 = time.time()
+            frs = [router.submit(prompt, n) for prompt, n in jobs]
+            kill_deadline = time.time() + 60
+            while time.time() < kill_deadline:
+                snap0 = router.replicas[0].snapshot()
+                if snap0.queue_depth + snap0.active_slots > 0:
+                    break
+                if all(fr.finished for fr in frs):
+                    break
+                time.sleep(0.001)
+            router.kill_replica(0)
+            finished = [fr.wait(timeout=600) for fr in frs]
+            wall_fl = time.time() - t0
+            rep = router.fleet_report()
+            fl_parity = True
+            for i in (0, 1):
+                prompt, n = jobs[i]
+                if frs[i].state.value != "done":
+                    continue
+                ref = np.asarray(generate(model, params,
+                                          jnp.asarray(prompt)[None], n)[0])
+                fl_parity = fl_parity and bool(
+                    np.array_equal(frs[i].output, ref))
+            lost = [fr.id for fr in frs
+                    if not fr.finished
+                    or (fr.state.value != "done"
+                        and not isinstance(fr.error, DeadlineExceededError))]
+            survivors = [r for r in router.replicas
+                         if r.state.value != "quarantined"]
+            pooled = rep["pooled"]
+            pooled_ttft = pooled["histograms"].get(
+                "serving_ttft_seconds", {})
+            fl_tokens = pooled["counters"].get("serving_tokens_total", 0)
+            record["fleet_serving"] = {
+                "replicas": fl_n,
+                "slots_per_replica": fl_slots,
+                "solo_slots": n_slots,
+                "requests": len(jobs),
+                "done": sum(fr.state.value == "done" for fr in frs),
+                "all_terminal": all(finished),
+                "no_request_lost": not lost,
+                "killed_replica_quarantined":
+                    router.replicas[0].state.value == "quarantined",
+                "capacity_after_kill": rep["capacity"],
+                "reroutes": rep["reroutes_total"],
+                "shed": rep["shed_total"],
+                "route_fallbacks": rep["route_fallbacks_total"],
+                "affinity_hit_rate": rep["affinity"]["hit_rate"],
+                "tokens_per_sec": round(fl_tokens / max(wall_fl, 1e-9), 2),
+                "tokens_per_sec_solo": m_on["tokens_per_sec"],
+                "ttft_p50_ms": round(
+                    pooled_ttft.get("p50_s", 0.0) * 1e3, 3),
+                "ttft_p99_ms": round(
+                    pooled_ttft.get("p99_s", 0.0) * 1e3, 3),
+                "ttft_p50_ms_solo": round(m_on["ttft_p50_s"] * 1e3, 3),
+                "wall_s": round(wall_fl, 3),
+                "parity_vs_solo_generate": fl_parity,
+                "recompiles_after_warmup_survivors": sum(
+                    sum(r.engine.recompiles.values()) for r in survivors),
+                "replica_states": {k: v["state"]
+                                   for k, v in rep["replicas"].items()},
+            }
+        finally:
+            router.close()
+        fl = record["fleet_serving"]
+        log(f"fleet serving: {fl['replicas']}x{fl['slots_per_replica']} "
+            f"slots, done {fl['done']}/{fl['requests']} through a "
+            f"mid-run replica kill (reroutes={fl['reroutes']}, "
+            f"lost={not fl['no_request_lost']}), affinity "
+            f"hit_rate={fl['affinity_hit_rate']}, parity={fl_parity}")
         from chainermn_tpu.monitor import snapshot as monitor_snapshot
 
         record["monitor"] = monitor_snapshot()

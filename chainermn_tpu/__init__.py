@@ -21,6 +21,7 @@ from chainermn_tpu.extensions import (
 )
 from chainermn_tpu.global_except_hook import add_hook as add_global_except_hook
 from chainermn_tpu import dataflow
+from chainermn_tpu import fleet
 from chainermn_tpu import monitor
 from chainermn_tpu import resilience
 from chainermn_tpu.iterators import (
@@ -80,6 +81,7 @@ __all__ = [
     "create_multi_node_checkpointer",
     "add_global_except_hook",
     "dataflow",
+    "fleet",
     "functions",
     "monitor",
     "resilience",

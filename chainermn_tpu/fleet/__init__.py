@@ -1,0 +1,63 @@
+"""``chainermn_tpu.fleet`` — multi-replica serving: N engines, one
+service.
+
+The serving package (PR 1-7) ends at ONE
+:class:`~chainermn_tpu.serving.engine.ServingEngine`: one slot pool, one
+mesh, one failure domain. This package is the coordination tier above it
+— the paper's thesis (many identical workers behind a thin coordination
+layer) applied to the serving side:
+
+- :mod:`~chainermn_tpu.fleet.routing` — pure host policy:
+  :class:`RoutingPolicy` (prefix affinity vs occupancy-aware
+  least-loaded, deterministic tie-breaks, fleet-edge admission math) and
+  :class:`FleetTrie` (the router's belief of which replica caches which
+  prompt prefix);
+- :mod:`~chainermn_tpu.fleet.replica` — :class:`EngineReplica`: one
+  engine + scheduler on its own thread, under a supervisor that drains,
+  warm-restarts, or quarantines a failed replica (PR 3's exception
+  boundary, one level up);
+- :mod:`~chainermn_tpu.fleet.router` — :class:`FleetRouter`: the
+  ``submit``/``wait``/``stream`` front with prefix-affinity routing,
+  global ``max_queue`` shedding, replica failover with replayed
+  re-routes (stream-dedup'd — a consumer sees a seamless continuation),
+  and fleet-pooled observability (``/fleet`` via
+  ``monitor.http.serve(fleet=router)``).
+
+Correctness invariants (pinned in ``tests/fleet_tests``): a fleet serves
+a mixed prefix-heavy workload token-for-token equal to solo
+``generate()``; killing one replica mid-stream loses zero accepted
+requests (re-routed or cleanly ERRORED per deadline policy); and
+``recompiles_after_warmup == 0`` holds on every surviving replica.
+
+Import hygiene: fleet modules import the serving/resilience/extensions
+stack lazily (inside functions), never at module level — the same rule
+as ``chainermn_tpu.monitor``, pinned by
+``tests/monitor_tests/test_import_hygiene.py``.
+"""
+
+from chainermn_tpu.fleet.replica import (
+    EngineReplica,
+    ReplicaHang,
+    ReplicaKilled,
+    ReplicaState,
+)
+from chainermn_tpu.fleet.router import FleetRequest, FleetRouter
+from chainermn_tpu.fleet.routing import (
+    FleetTrie,
+    ReplicaSnapshot,
+    RouteDecision,
+    RoutingPolicy,
+)
+
+__all__ = [
+    "EngineReplica",
+    "FleetRequest",
+    "FleetRouter",
+    "FleetTrie",
+    "ReplicaHang",
+    "ReplicaKilled",
+    "ReplicaSnapshot",
+    "ReplicaState",
+    "RouteDecision",
+    "RoutingPolicy",
+]

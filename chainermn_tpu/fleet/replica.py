@@ -1,0 +1,324 @@
+"""One serving replica: an engine + scheduler on its own thread, under a
+supervisor that lifts PR 3's engine exception boundary one level.
+
+Inside a replica, the :class:`~chainermn_tpu.serving.scheduler.
+FCFSScheduler` runs with ``restart_on_error=False``: an engine-side
+failure still fails every in-flight request loudly (terminal ERRORED —
+the PR 3 contract), but the *recovery* decision escalates here instead of
+being taken inside the scheduler. The supervisor then:
+
+1. drains the scheduler's QUEUED work (:meth:`FCFSScheduler.
+   drain_queued`) and hands it to the router's failure callback — queued
+   requests never even started, so they re-route to a healthy replica
+   with nothing lost;
+2. warm-``restart()``\\ s the engine (fresh caches/slot mirrors/trie,
+   SAME compiled programs — zero recompiles across the restart) while the
+   replica reports ``RESTARTING``;
+3. past ``max_restarts`` — or on a hard :class:`ReplicaKilled` poison
+   (the bench continuity probe) — **quarantines**: the replica stops
+   accepting work and its thread exits; the fleet's capacity shrinks by
+   one replica instead of the service dying.
+
+A replica also watches its engine's :class:`~chainermn_tpu.extensions.
+profiling.Watchdog` (configure it with ``on_timeout='warn'`` for fleet
+use — abort mode kills the whole process, which is exactly what the
+fleet tier exists to avoid): a fired watchdog after a device call is
+treated as a replica failure, so a wedged collective on ONE mesh drains
+and restarts one replica while the others keep serving.
+
+Every transition is observable: a ``fleet_replica_state`` gauge per
+replica (0 starting, 1 healthy, 2 restarting, 3 quarantined, 4 stopped),
+``fleet_replica_restarts_total{replica=}``, and
+``fleet_replica_error`` / ``fleet_replica_quarantine`` flight-recorder
+events.
+
+This module must not import ``chainermn_tpu.extensions`` (or jax, or the
+serving package) at module level — serving/resilience are imported
+lazily at construction/call time; pinned by
+``tests/monitor_tests/test_import_hygiene.py``.
+"""
+
+from __future__ import annotations
+
+import enum
+import sys
+import threading
+from typing import Callable, Optional
+
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.fleet.routing import ReplicaSnapshot
+
+
+class ReplicaState(enum.Enum):
+    STARTING = "starting"
+    HEALTHY = "healthy"
+    RESTARTING = "restarting"
+    QUARANTINED = "quarantined"
+    STOPPED = "stopped"
+
+
+_STATE_CODE = {
+    ReplicaState.STARTING: 0,
+    ReplicaState.HEALTHY: 1,
+    ReplicaState.RESTARTING: 2,
+    ReplicaState.QUARANTINED: 3,
+    ReplicaState.STOPPED: 4,
+}
+
+
+class ReplicaKilled(RuntimeError):
+    """Hard-kill poison: the replica fails terminally (no restart budget
+    consulted — straight to quarantine). The bench continuity probe and
+    the kill-one-replica tests use this to simulate a dead worker."""
+
+
+class ReplicaHang(RuntimeError):
+    """The replica's engine watchdog fired during a device call — the
+    step eventually returned (or the injected hang cleared), but the
+    replica is treated as failed and restarted."""
+
+
+def _inject(point: str, **ctx) -> None:
+    # lazy: resilience's package init pulls the trainer (-> extensions);
+    # importing it at module level would break fleet's import hygiene
+    from chainermn_tpu.resilience.faults import inject
+
+    inject(point, **ctx)
+
+
+class EngineReplica:
+    """One engine + scheduler + driving thread, supervised.
+
+    Parameters
+    ----------
+    replica_id : int
+        Fleet-unique id (labels, routing, events).
+    engine : ServingEngine
+        Built by the caller (model/sharding/sampler config stays in one
+        place, exactly like :class:`~chainermn_tpu.serving.client.
+        ServingClient`). Warmup runs ON the replica thread at start, so
+        N replicas warm their compiled-program families in parallel.
+    eos_id / retry : forwarded to the replica's scheduler.
+    max_restarts : int
+        Warm restarts before quarantine (the supervisor's budget — the
+        scheduler's own restart path is disabled in fleet mode).
+    on_failure : callable(replica, drained, exc, restarted)
+        The router's failover hook, invoked from the replica thread after
+        in-flight work was failed, QUEUED work drained, and the
+        restart/quarantine decision taken.
+    """
+
+    def __init__(self, replica_id: int, engine, *,
+                 eos_id: Optional[int] = None,
+                 max_restarts: int = 2,
+                 idle_wait_s: float = 0.02,
+                 retry=None,
+                 on_failure: Optional[Callable] = None,
+                 labels: Optional[dict] = None,
+                 autostart: bool = True) -> None:
+        from chainermn_tpu.serving.metrics import ServingMetrics
+        from chainermn_tpu.serving.scheduler import FCFSScheduler
+
+        self.replica_id = int(replica_id)
+        self.engine = engine
+        self.metrics = ServingMetrics(engine.n_slots)
+        # restart_on_error=False: failure ESCALATES to this supervisor
+        # (in-flight still errors loudly inside the scheduler first)
+        self.scheduler = FCFSScheduler(
+            engine, eos_id=eos_id, metrics=self.metrics, retry=retry,
+            restart_on_error=False)
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self._idle_wait_s = idle_wait_s
+        self._on_failure = on_failure
+        self._state = ReplicaState.STARTING
+        self._poison: Optional[BaseException] = None
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self.ready = threading.Event()
+        self._events = get_event_log()
+        reg = get_registry()
+        # caller-supplied labels (the router's fleet= instance tag) keep
+        # successive fleets' replica-N series apart in the registry
+        labels = dict(labels or {}, replica=str(self.replica_id))
+        self._g_state = reg.gauge("fleet_replica_state", labels)
+        self._c_restarts = reg.counter("fleet_replica_restarts_total",
+                                       labels)
+        self._g_state.set(_STATE_CODE[self._state])
+        self._thread = threading.Thread(
+            target=self._loop, name=f"chainermn-fleet-replica-{replica_id}",
+            daemon=True)
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------------ #
+    # public surface (router-facing, any thread)                          #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def state(self) -> ReplicaState:
+        return self._state
+
+    @property
+    def accepting(self) -> bool:
+        """Routable: warming up or serving (a RESTARTING replica is mid-
+        recovery — don't pile new work onto it; QUARANTINED/STOPPED are
+        out of the fleet)."""
+        return self._state in (ReplicaState.STARTING, ReplicaState.HEALTHY)
+
+    def start(self) -> None:
+        if not self._thread.is_alive() and not self._stop.is_set():
+            self._thread.start()
+
+    def submit(self, prompt, max_new_tokens: int, *, rng=None,
+               stream_cb=None, deadline_s=None):
+        """Enqueue onto this replica's scheduler (thread-safe) and wake
+        the drive loop. The router owns the routing decision; this is
+        mechanism only."""
+        if not self.accepting:
+            raise RuntimeError(
+                f"replica {self.replica_id} is {self._state.value}, "
+                "not accepting work")
+        req = self.scheduler.submit(prompt, max_new_tokens, rng=rng,
+                                    stream_cb=stream_cb,
+                                    deadline_s=deadline_s)
+        self._work.set()
+        return req
+
+    def snapshot(self) -> ReplicaSnapshot:
+        """Routing-time occupancy (host counters only — the policy's
+        input)."""
+        occ = self.engine.occupancy()
+        ewma = self.metrics.ttft_ewma
+        return ReplicaSnapshot(
+            replica_id=self.replica_id,
+            healthy=self.accepting,
+            queue_depth=self.scheduler.queue_depth,
+            active_slots=occ["active_slots"],
+            n_slots=occ["n_slots"],
+            ttft_ewma_s=float(ewma) if ewma is not None else 0.0,
+            kv_free_frac=occ["kv_free_frac"],
+        )
+
+    def kill(self, exc: Optional[BaseException] = None) -> None:
+        """Poison the replica: the drive loop raises on its next
+        iteration and the supervisor quarantines (no restart) — the
+        kill-one-replica continuity probe."""
+        self._poison = exc if exc is not None else ReplicaKilled(
+            f"replica {self.replica_id} killed")
+        self._work.set()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop the drive thread (in-flight work is abandoned; the
+        router cancels outstanding requests)."""
+        self._stop.set()
+        self._work.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        if self._state is not ReplicaState.QUARANTINED:
+            self._set_state(ReplicaState.STOPPED)
+
+    # ------------------------------------------------------------------ #
+    # the drive loop (one thread per replica)                             #
+    # ------------------------------------------------------------------ #
+
+    def _set_state(self, state: ReplicaState) -> None:
+        self._state = state
+        self._g_state.set(_STATE_CODE[state])
+
+    def _loop(self) -> None:
+        try:
+            # each replica warms its OWN compiled-program family, in
+            # parallel with its peers (warmup is idempotent)
+            self.engine.warmup()
+            self._set_state(ReplicaState.HEALTHY)
+        except Exception as e:  # noqa: BLE001 — a replica that cannot warm
+            self._quarantine(e)  # up must not take traffic
+            self.ready.set()
+            return
+        finally:
+            self.ready.set()
+        while not self._stop.is_set():
+            try:
+                # the replica-level fault cut-point: a raise here models a
+                # worker-process death (not just one device call failing)
+                _inject("fleet.replica", replica=self.replica_id)
+                if self._poison is not None:
+                    poison, self._poison = self._poison, None
+                    raise poison
+                if self.scheduler.has_work:
+                    self.scheduler.step()
+                    self._check_watchdog()
+                else:
+                    self._work.clear()
+                    if self.scheduler.has_work:
+                        continue
+                    self._work.wait(self._idle_wait_s)
+            except Exception as e:  # noqa: BLE001 — the supervisor boundary
+                self._supervise_failure(e)
+                if self._state is not ReplicaState.HEALTHY:
+                    return
+
+    def _check_watchdog(self) -> None:
+        wd = getattr(self.engine, "watchdog", None)
+        if wd is not None and wd.fired:
+            raise ReplicaHang(
+                f"replica {self.replica_id} watchdog fired mid-step")
+
+    # ------------------------------------------------------------------ #
+    # the supervisor boundary                                             #
+    # ------------------------------------------------------------------ #
+
+    def _supervise_failure(self, e: BaseException) -> None:
+        """PR 3's exception boundary, one level up: fail in-flight work
+        loudly (idempotent — a failure inside ``step()`` already did),
+        drain QUEUED work for re-routing, then warm-restart within budget
+        or quarantine. The router's callback runs LAST, once this
+        replica's fate is decided, so re-routing sees the true fleet."""
+        self._set_state(ReplicaState.RESTARTING)
+        self.scheduler.fail_inflight(e)
+        drained = self.scheduler.drain_queued()
+        fatal = isinstance(e, ReplicaKilled)
+        restarted = False
+        if (not fatal and self.restarts < self.max_restarts
+                and not self._stop.is_set()):
+            try:
+                self.engine.restart()
+                wd = getattr(self.engine, "watchdog", None)
+                if wd is not None:
+                    wd._fired.clear()   # re-arm hang detection post-restart
+                self.restarts += 1
+                self._c_restarts.inc()
+                self._set_state(ReplicaState.HEALTHY)
+                restarted = True
+            except Exception as restart_exc:  # noqa: BLE001
+                e = restart_exc
+        if not restarted:
+            self._quarantine(e)
+        self._events.emit("fleet_replica_error", replica=self.replica_id,
+                          error=type(e).__name__, detail=str(e)[:200],
+                          drained=len(drained), restarted=restarted,
+                          restarts=self.restarts)
+        if self._on_failure is not None:
+            try:
+                self._on_failure(self, drained, e, restarted)
+            except Exception as cb_exc:  # noqa: BLE001 — never kill the loop
+                print(f"chainermn_tpu.fleet: replica {self.replica_id} "
+                      f"failure callback raised "
+                      f"{type(cb_exc).__name__}: {cb_exc}",
+                      file=sys.stderr, flush=True)
+
+    def _quarantine(self, e: BaseException) -> None:
+        self._set_state(ReplicaState.QUARANTINED)
+        self._events.emit("fleet_replica_quarantine",
+                          replica=self.replica_id,
+                          error=type(e).__name__, detail=str(e)[:200],
+                          restarts=self.restarts)
+
+
+__all__ = [
+    "EngineReplica",
+    "ReplicaHang",
+    "ReplicaKilled",
+    "ReplicaState",
+]

@@ -1,0 +1,641 @@
+"""The fleet router: N serving replicas behind one submit/wait/stream
+surface.
+
+This is the layer ROADMAP item 1 asks for — the refactor that turns "an
+engine" into "a service". Each :class:`~chainermn_tpu.fleet.replica.
+EngineReplica` runs its own :class:`~chainermn_tpu.serving.engine.
+ServingEngine` (own warmup'd compiled programs, slot pool, prefix/paged-
+KV store) on its own thread; the :class:`FleetRouter` in front of them:
+
+- **routes** each submission with the two-signal policy
+  (:mod:`~chainermn_tpu.fleet.routing`): prefix affinity through a
+  fleet-level trie (send a request sharing a cached prefix to the
+  replica whose trie holds it), falling back to occupancy-aware
+  least-loaded (queue depth + slot occupancy + EWMA TTFT from each
+  replica's metrics);
+- **admits at the edge**: a global ``max_queue`` sheds overload with
+  :class:`~chainermn_tpu.serving.scheduler.QueueFullError` at submit
+  (the PR 3 backpressure stance), and per-request deadlines ride through
+  to the replica schedulers' shedding machinery unchanged;
+- **fails over**: a replica that errors or trips its watchdog is
+  drained, warm-restarted, or quarantined by its supervisor
+  (:mod:`~chainermn_tpu.fleet.replica`); the router then re-routes the
+  drained QUEUED work — and any in-flight request the failure errored —
+  to a healthy replica. Re-routing REPLAYS the request (same prompt,
+  same rng), which reproduces the identical token stream (the PR 7
+  preemption argument, lifted across replicas); tokens already streamed
+  before the failure are de-duplicated, so a streaming consumer sees a
+  seamless continuation. A request whose deadline expired instead
+  finishes cleanly ERRORED (``DeadlineExceededError``) — re-routed or
+  cleanly shed, never lost, never stranded.
+
+The consumer surface is a :class:`FleetRequest` mirroring
+:class:`~chainermn_tpu.serving.scheduler.Request` (``wait`` / ``stream``
+/ ``output`` / ``state``), so :meth:`FleetRouter.submit` and
+:meth:`FleetRouter.generate` drop in where
+:class:`~chainermn_tpu.serving.client.ServingClient` was.
+
+Observability rides the existing monitor spine: ``fleet_replica_state``
+gauges and per-replica restart counters (the replica module),
+``fleet_requests_total`` / ``fleet_reroutes_total`` / ``fleet_shed_total``
+/ ``fleet_affinity_{hits,misses}_total`` / ``fleet_route_fallbacks_total``
+counters, a ``route`` span (replica id + affinity hit/miss) on every
+request trace so a slow request's *placement* shows up in its PR 6
+critical path, and :meth:`FleetRouter.fleet_report` pooling the
+replicas' TTFT/TPOT/occupancy reservoirs with
+:func:`~chainermn_tpu.monitor.registry.merge_rank_payloads` — the same
+merge ``MetricsRegistry.aggregate(comm)`` applies across ranks, here
+applied across replica registries. ``monitor.http.serve(fleet=router)``
+exposes the whole report at ``/fleet``.
+
+Fault cut-points (PR 3's injection surface, extended): ``fleet.route``
+fires inside the routing decision — an injected raise falls back to the
+lowest-id accepting replica (the request still lands, on the fallback);
+``fleet.replica`` fires in each replica's drive loop — an injected raise
+exercises the whole supervisor path (drain, restart/quarantine,
+re-route).
+
+This module must not import ``chainermn_tpu.extensions`` (or jax, or the
+serving package) at module level — serving types are imported lazily;
+pinned by ``tests/monitor_tests/test_import_hygiene.py``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from chainermn_tpu.fleet.replica import EngineReplica, ReplicaState
+from chainermn_tpu.fleet.routing import (
+    FleetTrie,
+    RouteDecision,
+    RoutingPolicy,
+)
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.monitor.registry import merge_rank_payloads
+
+
+def _inject(point: str, **ctx) -> None:
+    from chainermn_tpu.resilience.faults import inject  # lazy: hygiene
+
+    inject(point, **ctx)
+
+
+_fleet_ids = itertools.count()
+
+
+class FleetRequest:
+    """One request's fleet-level handle: stable across re-routes.
+
+    The underlying scheduler :class:`Request` may be replaced when a
+    replica fails (the replay binds a fresh one on a healthy replica);
+    this handle's ``tokens`` / ``wait`` / ``stream`` / ``output`` present
+    one continuous request regardless. Terminal state is owned by the
+    router (:meth:`FleetRouter._resolve`) — consumers block on the
+    fleet-level event, never on a dead replica's scheduler."""
+
+    def __init__(self, router: "FleetRouter", fid: int, prompt,
+                 max_new_tokens: int, rng, stream_cb, deadline_s) -> None:
+        self._router = router
+        self.id = fid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.rng = rng
+        self.stream_cb = stream_cb
+        self.deadline_s = deadline_s
+        self.t_submit = time.perf_counter()
+        self.t_deadline = (self.t_submit + float(deadline_s)
+                           if deadline_s is not None else None)
+        self.tokens: list = []           # delivered to THIS handle (deduped)
+        self.error: Optional[BaseException] = None
+        self.replica_id: Optional[int] = None
+        self.reroutes = 0
+        self.affinity_hit = False
+        self._inner = None               # current scheduler Request binding
+        self._terminal = threading.Event()
+        self._final_state = None
+
+    @property
+    def finished(self) -> bool:
+        return self._terminal.is_set()
+
+    @property
+    def state(self):
+        """Fleet-level request state (the serving ``RequestState``
+        enum). Before a terminal decision this mirrors the current
+        binding; after, the router's verdict."""
+        if self._final_state is not None:
+            return self._final_state
+        inner = self._inner
+        if inner is not None:
+            return inner.state
+        from chainermn_tpu.serving.scheduler import RequestState
+
+        return RequestState.QUEUED
+
+    @property
+    def output(self) -> np.ndarray:
+        """``prompt + generated`` tokens; an ERRORED request re-raises its
+        stored exception (never a silent partial)."""
+        if self.error is not None:
+            raise self.error
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the router settles this request (DONE / CANCELLED
+        / ERRORED — re-routes are transparent); True when finished. An
+        ERRORED request re-raises its stored exception here."""
+        return self._router._await(self, timeout)
+
+    def stream(self, poll_s: float = 0.01):
+        """Yield generated tokens as they arrive, across re-routes
+        (replayed tokens are de-duplicated); re-raises the stored
+        exception at the end of an ERRORED request's stream."""
+        i = 0
+        while True:
+            while i < len(self.tokens):
+                yield self.tokens[i]
+                i += 1
+            if self._terminal.is_set():
+                while i < len(self.tokens):
+                    yield self.tokens[i]
+                    i += 1
+                if self.error is not None:
+                    raise self.error
+                return
+            self._router._await(self, poll_s, _raise=False)
+
+
+class FleetRouter:
+    """N engine replicas behind one serving surface (module docstring).
+
+    Parameters
+    ----------
+    engines : sequence of ServingEngine
+        One per replica, built by the caller (identical model/params/
+        sampler config is the caller's contract — routing assumes any
+        replica can serve any request). Warmup runs on each replica's
+        own thread; :meth:`wait_ready` blocks until the fleet is warm.
+    eos_id / retry : forwarded to every replica's scheduler.
+    affinity : bool
+        Prefix-affinity routing (auto-disabled when the engines have no
+        prefix cache — there is nothing to be affine to).
+    max_queue : int, optional
+        GLOBAL queued-request bound: submissions beyond it are shed at
+        the fleet edge with ``QueueFullError``.
+    default_deadline_s : float, optional
+        Default per-request deadline (PR 3 semantics, applied through
+        the replica schedulers; also bounds how long a re-route keeps
+        retrying a request).
+    max_restarts : int
+        Per-replica warm-restart budget before quarantine.
+    max_reroutes : int, optional
+        Re-route budget per request (default: the replica count).
+    """
+
+    def __init__(self, engines: Sequence, *, eos_id: Optional[int] = None,
+                 affinity: bool = True,
+                 affinity_block_size: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 max_restarts: int = 2,
+                 max_reroutes: Optional[int] = None,
+                 policy: Optional[RoutingPolicy] = None,
+                 retry=None, idle_wait_s: float = 0.02,
+                 autostart: bool = True) -> None:
+        if not engines:
+            raise ValueError("a fleet needs at least one engine")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        prefix_on = all(getattr(e, "prefix_enabled", False) for e in engines)
+        self.affinity = bool(affinity) and prefix_on
+        if affinity_block_size is None:
+            affinity_block_size = (engines[0].prefix_cache.block_size
+                                   if prefix_on else 16)
+        self.max_queue = max_queue
+        self.default_deadline_s = default_deadline_s
+        self._policy = policy if policy is not None else RoutingPolicy(
+            affinity=self.affinity)
+        self._trie = FleetTrie(affinity_block_size)
+        self._lock = threading.RLock()
+        self._ids = itertools.count()
+        self._requests: dict[int, FleetRequest] = {}
+        self._closed = False
+        self._events = get_event_log()
+        reg = get_registry()
+        # per-router instance label (the ServingMetrics convention):
+        # successive/concurrent fleets in one process never mix series
+        labels = {"fleet": str(next(_fleet_ids))}
+        self._c_requests = reg.counter("fleet_requests_total", labels)
+        self._c_reroutes = reg.counter("fleet_reroutes_total", labels)
+        self._c_shed = reg.counter("fleet_shed_total", labels)
+        self._c_aff_hits = reg.counter("fleet_affinity_hits_total", labels)
+        self._c_aff_miss = reg.counter("fleet_affinity_misses_total", labels)
+        self._c_fallbacks = reg.counter("fleet_route_fallbacks_total",
+                                        labels)
+        self.max_reroutes = (int(max_reroutes) if max_reroutes is not None
+                             else len(engines))
+        self.replicas = [
+            EngineReplica(i, eng, eos_id=eos_id, max_restarts=max_restarts,
+                          retry=retry, idle_wait_s=idle_wait_s,
+                          on_failure=self._on_replica_failure,
+                          labels=labels, autostart=autostart)
+            for i, eng in enumerate(engines)
+        ]
+
+    # ------------------------------------------------------------------ #
+    # lifecycle                                                           #
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> None:
+        """Start replica threads (only needed after ``autostart=False``,
+        the deterministic-tests configuration)."""
+        for r in self.replicas:
+            r.start()
+
+    def wait_ready(self, timeout: float = 300.0) -> bool:
+        """Block until every replica finished warmup (compiled programs
+        built); True when all are ready within the timeout."""
+        deadline = time.perf_counter() + timeout
+        for r in self.replicas:
+            if not r.ready.wait(max(0.0, deadline - time.perf_counter())):
+                return False
+        return True
+
+    @property
+    def capacity(self) -> int:
+        """Replicas currently accepting work (shrinks on quarantine)."""
+        return sum(1 for r in self.replicas if r.accepting)
+
+    def kill_replica(self, replica_id: int) -> None:
+        """Hard-kill one replica (poison -> quarantine; its work is
+        re-routed) — the continuity probe's entry point."""
+        self.replicas[replica_id].kill()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop every replica thread and settle every outstanding request
+        (CANCELLED) so no waiter hangs."""
+        self._closed = True
+        for r in self.replicas:
+            r.stop(timeout)
+        from chainermn_tpu.serving.scheduler import RequestState
+
+        with self._lock:
+            pending = [fr for fr in self._requests.values()
+                       if not fr.finished]
+            for fr in pending:
+                self._finalize_locked(fr, RequestState.CANCELLED, None)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------ #
+    # submission surface (any thread)                                     #
+    # ------------------------------------------------------------------ #
+
+    def submit(self, prompt, max_new_tokens: int, *, rng=None,
+               stream_cb: Optional[Callable[[int], None]] = None,
+               deadline_s: Optional[float] = None) -> FleetRequest:
+        """Route and enqueue one request; returns immediately. Raises
+        ``QueueFullError`` when the fleet-wide queue bound is hit
+        (counted as a fleet shed) and ``RuntimeError`` when no replica
+        is accepting work."""
+        from chainermn_tpu.serving.scheduler import QueueFullError
+
+        if self._closed:
+            raise RuntimeError("fleet router is closed")
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        with self._lock:
+            snaps = [r.snapshot() for r in self.replicas]
+            if not any(s.healthy for s in snaps):
+                raise RuntimeError(
+                    "no replica accepting work (all quarantined/stopped)")
+            if self._policy.overloaded(snaps, self.max_queue):
+                self._c_shed.inc()
+                self._events.emit(
+                    "fleet_shed", reason="queue_full",
+                    queue_depth=sum(s.queue_depth for s in snaps))
+                raise QueueFullError(
+                    f"fleet admission queue full ({self.max_queue} queued "
+                    f"across {self.capacity} replicas); retry later"
+                )
+            fid = next(self._ids)
+            fr = FleetRequest(self, fid, prompt, max_new_tokens, rng,
+                              stream_cb, deadline_s)
+            t0 = time.perf_counter()
+            decision = self._route(fr.prompt, snaps)
+            self._bind_locked(fr, decision, t0)
+            self._requests[fid] = fr
+            self._c_requests.inc()
+        return fr
+
+    def generate(self, prompt, max_new_tokens: int, *, rng=None,
+                 timeout: Optional[float] = None,
+                 deadline_s: Optional[float] = None) -> np.ndarray:
+        """Blocking single-request decode through the fleet — the
+        ``ServingClient.generate`` shape."""
+        fr = self.submit(prompt, max_new_tokens, rng=rng,
+                         deadline_s=deadline_s)
+        if not fr.wait(timeout):
+            self.cancel(fr)
+            raise TimeoutError(
+                f"fleet request {fr.id} did not finish within {timeout}s")
+        return fr.output
+
+    def cancel(self, fr: FleetRequest) -> bool:
+        from chainermn_tpu.serving.scheduler import RequestState
+
+        with self._lock:
+            if fr.finished:
+                return False
+            inner = fr._inner
+            self._finalize_locked(fr, RequestState.CANCELLED, None)
+        if inner is not None and fr.replica_id is not None:
+            self.replicas[fr.replica_id].scheduler.cancel(inner)
+        return True
+
+    # ------------------------------------------------------------------ #
+    # routing internals                                                   #
+    # ------------------------------------------------------------------ #
+
+    def _route(self, prompt, snaps, exclude: Optional[int] = None
+               ) -> RouteDecision:
+        """The two-signal decision, with the ``fleet.route`` fault
+        cut-point inside: an injected (or real) routing failure falls
+        back to the lowest-id accepting replica — placement degrades,
+        the request still lands."""
+        candidates = [s for s in snaps if s.healthy
+                      and s.replica_id != exclude]
+        if not candidates:
+            candidates = [s for s in snaps if s.healthy]
+        try:
+            _inject("fleet.route", candidates=len(candidates))
+            rid, blocks = ((None, 0) if not self.affinity
+                           else self._trie.lookup(prompt))
+            decision = self._policy.route(candidates, rid, blocks)
+            if decision is None:
+                raise RuntimeError("no healthy replica")
+            return decision
+        except Exception as e:  # noqa: BLE001 — routing must not lose work
+            fallback = min(s.replica_id for s in candidates)
+            self._c_fallbacks.inc()
+            self._events.emit("fleet_route_fallback",
+                              error=type(e).__name__, replica=fallback)
+            return RouteDecision(fallback, affinity_hit=False,
+                                 reason=f"fallback:{type(e).__name__}")
+
+    def _bind_locked(self, fr: FleetRequest, decision: RouteDecision,
+                     t0: float, rerouted: bool = False) -> None:
+        """Submit ``fr`` to the decided replica (holding the router
+        lock): install the de-duplicating token relay, attach the
+        ``route`` span to the new binding's trace, stamp the fleet trie,
+        and count the affinity outcome."""
+        replica = self.replicas[decision.replica_id]
+        replayed = len(fr.tokens)
+        seen = 0
+
+        def relay(tok: int, fr=fr) -> None:
+            # engine-thread callback: skip the replayed prefix (identical
+            # by the prompt+rng replay argument), append the rest
+            nonlocal seen
+            seen += 1
+            if seen > replayed:
+                fr.tokens.append(int(tok))
+                if fr.stream_cb is not None:
+                    try:
+                        fr.stream_cb(int(tok))
+                    except Exception:  # noqa: BLE001 — consumer's problem
+                        pass
+
+        remaining = None
+        if fr.t_deadline is not None:
+            remaining = fr.t_deadline - time.perf_counter()
+        inner = replica.submit(fr.prompt, fr.max_new_tokens, rng=fr.rng,
+                               stream_cb=relay, deadline_s=remaining)
+        t1 = time.perf_counter()
+        inner.trace.add_span("route", t0, t1, replica=decision.replica_id,
+                             affinity="hit" if decision.affinity_hit
+                             else "miss", reason=decision.reason,
+                             rerouted=rerouted)
+        fr._inner = inner
+        fr.replica_id = decision.replica_id
+        fr.affinity_hit = decision.affinity_hit
+        (self._c_aff_hits if decision.affinity_hit
+         else self._c_aff_miss).inc()
+        if self.affinity:
+            self._trie.note(fr.prompt, decision.replica_id)
+        self._events.emit("fleet_route", req=fr.id,
+                          replica=decision.replica_id,
+                          affinity=decision.affinity_hit,
+                          reason=decision.reason, rerouted=rerouted)
+
+    # ------------------------------------------------------------------ #
+    # settlement (consumer waits + failover)                              #
+    # ------------------------------------------------------------------ #
+
+    def _await(self, fr: FleetRequest, timeout: Optional[float],
+               _raise: bool = True) -> bool:
+        end = (None if timeout is None
+               else time.perf_counter() + float(timeout))
+        while True:
+            if fr._terminal.is_set():
+                if _raise and fr.error is not None:
+                    raise fr.error
+                return True
+            slice_s = 0.05 if end is None else min(
+                0.05, end - time.perf_counter())
+            if slice_s <= 0:
+                return False
+            inner = fr._inner
+            if inner is None:
+                time.sleep(min(slice_s, 0.002))   # mid-rebind blink
+                continue
+            inner._done.wait(slice_s)
+            if inner.finished:
+                self._resolve(fr, inner)
+
+    def _resolve(self, fr: FleetRequest, inner) -> None:
+        """One finished binding's verdict (idempotent, router-locked):
+        DONE/CANCELLED settle the fleet request; an engine-failure error
+        re-routes (replay on a healthy replica) within the deadline and
+        re-route budgets, anything else settles ERRORED."""
+        from chainermn_tpu.serving.scheduler import (
+            DeadlineExceededError,
+            EngineFailed,
+            RequestState,
+        )
+
+        with self._lock:
+            if fr.finished or fr._inner is not inner:
+                return
+            st = inner.state
+            if st is RequestState.DONE:
+                self._finalize_locked(fr, st, None)
+                return
+            if st is RequestState.CANCELLED:
+                self._finalize_locked(fr, st, None)
+                return
+            if st is not RequestState.ERRORED:
+                return   # spurious wake: binding not actually terminal
+            err = inner.error
+            if not isinstance(err, EngineFailed):
+                # deadline shed, validation, ... — the replica's verdict
+                # IS the fleet verdict (PR 3 semantics pass through)
+                if isinstance(err, DeadlineExceededError):
+                    self._c_shed.inc()
+                self._finalize_locked(fr, st, err)
+                return
+            # engine failure: replay on a healthy replica if budgets allow
+            if (fr.t_deadline is not None
+                    and time.perf_counter() >= fr.t_deadline):
+                self._c_shed.inc()
+                self._finalize_locked(fr, st, DeadlineExceededError(
+                    f"fleet request {fr.id} hit its {fr.deadline_s}s "
+                    "deadline during replica failover"))
+                return
+            snaps = [r.snapshot() for r in self.replicas]
+            if (fr.reroutes >= self.max_reroutes
+                    or not any(s.healthy for s in snaps)):
+                self._finalize_locked(fr, st, err)
+                return
+            t0 = time.perf_counter()
+            decision = self._route(fr.prompt, snaps,
+                                   exclude=fr.replica_id)
+            fr.reroutes += 1
+            self._c_reroutes.inc()
+            try:
+                self._bind_locked(fr, decision, t0, rerouted=True)
+            except Exception as bind_exc:  # noqa: BLE001 — target died too
+                failure = EngineFailed(
+                    f"fleet re-route of request {fr.id} failed: "
+                    f"{type(bind_exc).__name__}: {bind_exc}")
+                failure.__cause__ = bind_exc
+                self._finalize_locked(fr, RequestState.ERRORED, failure)
+
+    def _finalize_locked(self, fr: FleetRequest, state,
+                         error: Optional[BaseException]) -> None:
+        fr.error = error
+        fr._final_state = state
+        fr._terminal.set()
+        self._requests.pop(fr.id, None)
+
+    def _on_replica_failure(self, replica: EngineReplica, drained: list,
+                            exc: BaseException, restarted: bool) -> None:
+        """The supervisor's callback (replica thread): forget the failed
+        replica's prefix beliefs, then proactively settle every fleet
+        request it owned — drained QUEUED work re-binds immediately
+        (nothing ever started, nothing lost); errored in-flight work goes
+        through the normal :meth:`_resolve` replay path."""
+        rid = replica.replica_id
+        with self._lock:
+            self._trie.drop_replica(rid)
+            drained_ids = {id(req) for req in drained}
+            affected = [fr for fr in list(self._requests.values())
+                        if fr.replica_id == rid and not fr.finished]
+        for fr in affected:
+            inner = fr._inner
+            if inner is None:
+                continue
+            if id(inner) in drained_ids:
+                self._rebind_drained(fr, inner)
+            elif inner.finished:
+                self._resolve(fr, inner)
+
+    def _rebind_drained(self, fr: FleetRequest, inner) -> None:
+        from chainermn_tpu.serving.scheduler import (
+            DeadlineExceededError,
+            EngineFailed,
+            RequestState,
+        )
+
+        with self._lock:
+            if fr.finished or fr._inner is not inner:
+                return
+            if (fr.t_deadline is not None
+                    and time.perf_counter() >= fr.t_deadline):
+                self._c_shed.inc()
+                self._finalize_locked(
+                    fr, RequestState.ERRORED, DeadlineExceededError(
+                        f"fleet request {fr.id} hit its {fr.deadline_s}s "
+                        "deadline during replica failover"))
+                return
+            snaps = [r.snapshot() for r in self.replicas]
+            if (fr.reroutes >= self.max_reroutes
+                    or not any(s.healthy for s in snaps)):
+                failure = EngineFailed(
+                    f"request {fr.id} drained from failed replica "
+                    f"{fr.replica_id} with no healthy replica to take it")
+                self._finalize_locked(fr, RequestState.ERRORED, failure)
+                return
+            t0 = time.perf_counter()
+            decision = self._route(fr.prompt, snaps, exclude=fr.replica_id)
+            fr.reroutes += 1
+            self._c_reroutes.inc()
+            try:
+                self._bind_locked(fr, decision, t0, rerouted=True)
+            except Exception as bind_exc:  # noqa: BLE001
+                failure = EngineFailed(
+                    f"fleet re-route of request {fr.id} failed: "
+                    f"{type(bind_exc).__name__}: {bind_exc}")
+                failure.__cause__ = bind_exc
+                self._finalize_locked(fr, RequestState.ERRORED, failure)
+
+    # ------------------------------------------------------------------ #
+    # observability                                                       #
+    # ------------------------------------------------------------------ #
+
+    def fleet_report(self) -> dict:
+        """One JSON-able fleet view: per-replica state/occupancy/restarts,
+        router counters (reroutes, sheds, affinity outcomes), and the
+        replicas' latency/occupancy series POOLED with the same merge
+        ``MetricsRegistry.aggregate(comm)`` uses across ranks — so
+        ``pooled.histograms["serving_ttft_seconds"]`` carries the
+        fleet-wide p50/p99, not one replica's."""
+        replicas = {}
+        for r in self.replicas:
+            occ = r.engine.occupancy()
+            replicas[str(r.replica_id)] = {
+                "state": r.state.value,
+                "restarts": r.restarts,
+                "queue_depth": r.scheduler.queue_depth,
+                "active_slots": occ["active_slots"],
+                "n_slots": occ["n_slots"],
+                "kv_free_frac": occ["kv_free_frac"],
+                "recompiles_after_warmup":
+                    sum(r.engine.recompiles.values()),
+                "requests_completed": r.metrics.requests_completed,
+                "requests_errored": r.metrics.requests_errored,
+            }
+        pooled = merge_rank_payloads(
+            [r.metrics.payload() for r in self.replicas])
+        hits = int(self._c_aff_hits.value)
+        misses = int(self._c_aff_miss.value)
+        return {
+            "replicas": replicas,
+            "capacity": self.capacity,
+            "n_replicas": len(self.replicas),
+            "affinity": {
+                "enabled": self.affinity,
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": round(hits / max(hits + misses, 1), 4),
+                "trie_nodes": self._trie.n_nodes,
+            },
+            "requests_total": int(self._c_requests.value),
+            "reroutes_total": int(self._c_reroutes.value),
+            "shed_total": int(self._c_shed.value),
+            "route_fallbacks_total": int(self._c_fallbacks.value),
+            "pooled": pooled,
+        }
+
+
+__all__ = ["FleetRequest", "FleetRouter"]

@@ -12,6 +12,10 @@ browser, ``curl``, a future fleet router polling replica burn rates:
   evaluate` pass as JSON (scraping IS the periodic evaluation driver);
 - ``/events``  — the flight-recorder tail as JSON (``?last=N``, default
   64);
+- ``/fleet``   — the serving fleet's :meth:`~chainermn_tpu.fleet.router.
+  FleetRouter.fleet_report` as JSON (replica states, reroute/shed
+  counters, affinity hit rate, fleet-pooled latency percentiles) when a
+  router was passed to :func:`serve`; ``{}`` otherwise;
 - ``/``        — a plain-text index of the above.
 
 Serving is read-only and allocation-light: every handler renders from
@@ -41,11 +45,12 @@ class MonitorServer:
     """Owns the background HTTP server; build via :func:`serve`."""
 
     def __init__(self, host: str, port: int, *, registry, events, tracer,
-                 slo) -> None:
+                 slo, fleet=None) -> None:
         self._registry = registry
         self._events = events
         self._tracer = tracer
         self._slo = slo
+        self._fleet = fleet
         owner = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -101,12 +106,19 @@ class MonitorServer:
             body = json.dumps({"events": self._events.tail(last)},
                               default=str).encode()
             return 200, "application/json", body
+        if route == "/fleet":
+            payload = (self._fleet.fleet_report()
+                       if self._fleet is not None else {})
+            return (200, "application/json",
+                    json.dumps(payload, default=str).encode())
         if route == "/":
             index = ("chainermn_tpu monitor\n"
                      "  /metrics  Prometheus text exposition\n"
                      "  /traces   Chrome trace-event JSON (?kind=)\n"
                      "  /slo      SLO burn-rate evaluation\n"
-                     "  /events   flight-recorder tail (?last=N)\n")
+                     "  /events   flight-recorder tail (?last=N)\n"
+                     "  /fleet    serving-fleet report (replica states, "
+                     "pooled percentiles)\n")
             return 200, "text/plain; charset=utf-8", index.encode()
         return 404, "text/plain; charset=utf-8", b"not found\n"
 
@@ -129,13 +141,15 @@ class MonitorServer:
 
 
 def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
-          events=None, tracer=None, slo=None) -> MonitorServer:
+          events=None, tracer=None, slo=None, fleet=None) -> MonitorServer:
     """Stand up the scrape endpoint on a background thread and return the
     running :class:`MonitorServer` (``.port`` carries the bound port when
     ``port=0``). Defaults wire the process-wide registry, flight
     recorder, tracer, and SLO engine; pass private instances for
-    isolation (tests). Close with :meth:`MonitorServer.close` (also a
-    context manager)."""
+    isolation (tests), and a :class:`~chainermn_tpu.fleet.router.
+    FleetRouter` as ``fleet=`` to light up ``/fleet`` (there is no
+    process-wide default router — fleets are explicitly owned). Close
+    with :meth:`MonitorServer.close` (also a context manager)."""
     if registry is None:
         registry = get_registry()
     if events is None:
@@ -149,7 +163,7 @@ def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
 
         slo = get_slo_engine()
     return MonitorServer(host, port, registry=registry, events=events,
-                         tracer=tracer, slo=slo)
+                         tracer=tracer, slo=slo, fleet=fleet)
 
 
 __all__ = ["MonitorServer", "serve"]

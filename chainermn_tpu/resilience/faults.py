@@ -50,6 +50,15 @@ point                       where it fires
                             is contained by preempting+requeueing ONLY
                             that slot's request (no restart)
 ``serving.decode``          ``ServingEngine.decode_step``, same window
+``fleet.route``             ``FleetRouter``'s routing decision — a raise
+                            degrades placement to the lowest-id accepting
+                            replica (the request still lands, on the
+                            fallback) instead of losing the submission
+``fleet.replica``           each ``EngineReplica`` drive-loop iteration —
+                            a raise models a worker death and exercises
+                            the whole supervisor path: fail in-flight,
+                            drain QUEUED, warm-restart or quarantine,
+                            re-route to healthy replicas
 ``trainer.step``            each ``resilient_fit`` iteration, inside its
                             exception boundary
 ``checkpoint.save``         ``MultiNodeCheckpointer.save`` before any I/O

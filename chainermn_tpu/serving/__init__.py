@@ -37,6 +37,11 @@ Correctness invariant (pinned in ``tests/serving_tests``): requests
 admitted at staggered times into the shared slot pool produce
 token-for-token the same outputs as isolated ``generate()`` calls with
 the same params and rng.
+
+Everything here is ONE engine — one slot pool, one mesh, one failure
+domain. The multi-replica tier (N engines behind a prefix-affinity,
+occupancy-aware router with replica-level failover) is
+:mod:`chainermn_tpu.fleet`, which drives these classes unchanged.
 """
 
 from chainermn_tpu.serving.client import ServingClient

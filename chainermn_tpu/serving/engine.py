@@ -1425,5 +1425,27 @@ class ServingEngine:
         when disabled) — embedded in the serving bench record."""
         return self.prefix_cache.stats() if self.prefix_cache else {}
 
+    def occupancy(self) -> dict:
+        """Cheap host-side occupancy snapshot — the fleet router's
+        occupancy-aware-admission input (no device call, no locks beyond
+        numpy reads): slot fill, free-KV fraction (paged engines count
+        blocks; dense engines count free slots), and whether the prefix
+        trie is live on this engine."""
+        active = self.active_slots
+        if self.paged:
+            free = self._pool.free_blocks
+            kv_free_frac = free / max(self._pool.capacity, 1)
+        else:
+            kv_free_frac = len(self.free_slots) / max(self.n_slots, 1)
+        return {
+            "n_slots": self.n_slots,
+            "active_slots": active,
+            "free_slots": len(self.free_slots),
+            "kv_free_frac": round(float(kv_free_frac), 4),
+            "prefix_enabled": self.prefix_enabled,
+            "paged": self.paged,
+            "warm": self._warm,
+        }
+
 
 __all__ = ["AdmitPlan", "EngineStateError", "ServingEngine"]

@@ -101,6 +101,10 @@ class ServingMetrics:
         self._h_req_blocks = reg.histogram("kv_blocks_per_request", labels)
         self._t_first_token: Optional[float] = None
         self._t_last_token: Optional[float] = None
+        # EWMA TTFT (alpha=0.2): the routing layer's cheap "how slow is
+        # this replica right now" signal — O(1), no percentile math on
+        # the admission path
+        self.ttft_ewma: Optional[float] = None
         # per-trace critical path (the tracing layer): phase-attributed
         # time per retired request, plus the single worst request's full
         # breakdown — the "where did the p99 go" exhibit in report()
@@ -119,6 +123,8 @@ class ServingMetrics:
                            cached_frac: Optional[float] = None) -> None:
         ttft = t_token - t_submit
         self._h_ttft.observe(ttft)
+        self.ttft_ewma = (ttft if self.ttft_ewma is None
+                          else 0.8 * self.ttft_ewma + 0.2 * ttft)
         self._record_token_time(t_token)
         self._c_tokens.inc()
         if cached_frac is not None:
@@ -243,6 +249,43 @@ class ServingMetrics:
             return 0.0
         # the first token opens the span, the rest fill it
         return (self.tokens_generated - 1) / span
+
+    def payload(self) -> dict:
+        """This scheduler's series in the
+        :meth:`~chainermn_tpu.monitor.registry.MetricsRegistry.
+        _rank_payload` shape, keyed by PLAIN metric names (no ``instance``
+        label) — so a fleet router can pool N replicas' metrics with
+        :func:`~chainermn_tpu.monitor.registry.merge_rank_payloads`
+        exactly the way ``aggregate(comm)`` pools ranks: counters sum,
+        gauges mean, histogram reservoirs concatenate into fleet-wide
+        p50/p99."""
+        hists = {
+            "serving_ttft_seconds": self._h_ttft,
+            "serving_tpot_seconds": self._h_tpot,
+            "serving_queue_depth": self._h_queue,
+            "serving_slot_occupancy": self._h_occ,
+        }
+        return {
+            "counters": {
+                "serving_requests_submitted_total": self.requests_submitted,
+                "serving_requests_completed_total": self.requests_completed,
+                "serving_requests_cancelled_total": self.requests_cancelled,
+                "serving_requests_rejected_total": self.requests_rejected,
+                "serving_requests_shed_total": self.requests_shed,
+                "serving_requests_errored_total": self.requests_errored,
+                "serving_scheduler_restarts_total": self.engine_restarts,
+                "serving_tokens_total": self.tokens_generated,
+            },
+            "gauges": {
+                "serving_queue_depth_now": float(self._g_queue.value),
+                "serving_active_slots": float(self._g_active.value),
+            },
+            "hist": {
+                name: {"unit": h.unit, "count": h.count, "sum": h.sum,
+                       "samples": h.samples}
+                for name, h in hists.items()
+            },
+        }
 
     def report(self) -> dict:
         out = {

@@ -340,6 +340,43 @@ class FCFSScheduler:
         return self._restarts
 
     # ------------------------------------------------------------------ #
+    # supervisor surface (the fleet layer)                                 #
+    # ------------------------------------------------------------------ #
+
+    def drain_queued(self) -> list:
+        """Remove and return every QUEUED request — the fleet failover
+        hook: a supervising layer re-routes the drained work to a healthy
+        replica instead of letting it wait on a scheduler whose engine
+        just failed. Each drained request keeps state QUEUED (the caller
+        owns it now); its trace is closed with ``reason="rerouted"`` —
+        the re-submission opens a fresh one on the target replica."""
+        with self._lock:
+            drained = list(self._queue)
+            self._queue.clear()
+        for req in drained:
+            if req._span_queue is not None:
+                req.trace.end_span(req._span_queue)
+                req._span_queue = None
+            req.trace.finish(reason="rerouted")
+        return drained
+
+    def fail_inflight(self, e: BaseException) -> None:
+        """Public supervisor boundary: fail every in-flight request loudly
+        (terminal ERRORED, ``wait()`` re-raises) WITHOUT restarting the
+        engine — the caller (a replica supervisor) owns the warm-restart /
+        quarantine decision one level up. Idempotent per request: work
+        already errored by the step's own exception boundary is left
+        untouched."""
+        with self._lock:
+            has_inflight = bool(self._by_slot)
+        if has_inflight:
+            restart, self._restart_on_error = self._restart_on_error, False
+            try:
+                self._engine_failure(e)
+            finally:
+                self._restart_on_error = restart
+
+    # ------------------------------------------------------------------ #
     # the scheduling loop (one driving thread)                            #
     # ------------------------------------------------------------------ #
 

@@ -40,10 +40,24 @@ and ``--kv-quant int8`` halves resident KV bytes again; ``--kv-blocks``
 caps the pool (admission then defers to the queue, and a dry pool
 preempts+requeues the newest request instead of failing it).
 
+And the serving fleet (ISSUE 8): ``--replicas N`` runs N engine replicas
+(each with its own warmup'd programs, slot pool, and prefix store) behind
+a ``FleetRouter`` — prefix-affinity + occupancy-aware routing
+(``--no-affinity`` for pure least-loaded), a global ``--max-queue`` shed
+at the fleet edge, and replica-level failover; the fleet report (replica
+states, affinity hit rate, fleet-pooled TTFT percentiles) prints at the
+end, and ``--verify-parity`` checks the first few outputs token-for-token
+against solo ``generate()``.
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/lm/serve_lm.py --requests 16 --slots 4 --prometheus
+
+    # two replicas behind the prefix-affinity router:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/lm/serve_lm.py --replicas 2 --shared-prefix 4 \
+        --prefix-blocks 16 --prefix-block-size 2 --verify-parity
 
     # shared-system-prompt traffic through the prefix-cached fast path:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -123,6 +137,20 @@ def main() -> None:
                     help="paged: int8-quantize resident blocks (per-row "
                          "per-head scales, ~2x less KV memory; small "
                          "tested logit perturbation)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="run this many engine replicas behind the fleet "
+                         "router (1: the plain single-engine client)")
+    ap.add_argument("--affinity", dest="affinity", action="store_true",
+                    default=True,
+                    help="prefix-affinity routing (default): requests "
+                         "sharing a cached prefix go to the replica whose "
+                         "trie holds it, within the load-imbalance bound")
+    ap.add_argument("--no-affinity", dest="affinity", action="store_false",
+                    help="pure occupancy-aware least-loaded routing")
+    ap.add_argument("--verify-parity", action="store_true",
+                    help="after the burst, check the first few completed "
+                         "requests token-for-token against solo "
+                         "generate() with the same rng")
     ap.add_argument("--max-new", type=int, default=24)
     ap.add_argument("--vocab", type=int, default=128)
     ap.add_argument("--d-model", type=int, default=64)
@@ -200,15 +228,32 @@ def main() -> None:
             raise SystemExit("--paged-kv unifies the prefix cache onto the "
                              "shared block store; drop --prefix-blocks and "
                              "size it with --kv-blocks/--kv-block-size")
-    engine = ServingEngine(
-        model, params, n_slots=args.slots, prefill_len=args.prefill_len,
+    engine_kw = dict(
+        n_slots=args.slots, prefill_len=args.prefill_len,
         prefill_buckets=buckets, prefill_batch=args.prefill_batch,
         prefix_cache_blocks=args.prefix_blocks,
         prefix_block_size=args.prefix_block_size,
         temperature=args.temperature, comm=comm,
         watchdog=args.watchdog or None, **paged_kw,
     )
-    engine.warmup()   # every bucket + decode compile once, off the burst
+    fleet_mode = args.replicas > 1
+    eos = None if args.eos_id < 0 else args.eos_id
+    if fleet_mode:
+        from chainermn_tpu.fleet import FleetRouter
+
+        engines = [ServingEngine(model, params, **engine_kw)
+                   for _ in range(args.replicas)]
+        engine = engines[0]
+        front = FleetRouter(engines, eos_id=eos, affinity=args.affinity,
+                            max_queue=args.max_queue or None,
+                            default_deadline_s=args.deadline or None)
+        front.wait_ready(600)   # every replica warm, off the burst clock
+    else:
+        engine = ServingEngine(model, params, **engine_kw)
+        engine.warmup()   # every bucket + decode compile once, off the burst
+        front = ServingClient(engine, eos_id=eos,
+                              max_queue=args.max_queue or None,
+                              default_deadline_s=args.deadline or None)
 
     monitor.get_tracer().configure(sample=args.trace)
     slo_engine = None
@@ -219,19 +264,17 @@ def main() -> None:
             threshold_s=args.slo_ttft_ms / 1e3, windows=(30.0, 120.0)))
     server = None
     if args.http_port >= 0:
-        server = monitor.http.serve(port=args.http_port, slo=slo_engine)
+        server = monitor.http.serve(port=args.http_port, slo=slo_engine,
+                                    fleet=front if fleet_mode else None)
         print(f"monitor endpoints at {server.url} "
-              "(/metrics /traces /slo /events)")
+              "(/metrics /traces /slo /events /fleet)")
     shared = (rng.randint(2, args.vocab, args.shared_prefix)
               .astype(np.int32) if args.shared_prefix else
               np.zeros((0,), np.int32))
-    eos = None if args.eos_id < 0 else args.eos_id
     t0 = time.time()
     rejected = shed_or_failed = 0
-    with monitor.annotate("chainermn.serve_lm_burst"), \
-            ServingClient(engine, eos_id=eos,
-                          max_queue=args.max_queue or None,
-                          default_deadline_s=args.deadline or None) as client:
+    parity_jobs = []
+    with monitor.annotate("chainermn.serve_lm_burst"), front as client:
         # one streaming request: tokens arrive as they are decoded
         tail_max = max(1, args.prefill_len - len(shared))
         stream_toks: list[int] = []
@@ -248,14 +291,15 @@ def main() -> None:
         # the submitter's signal — a real client would retry later)
         handles = []
         for i in range(args.requests - 1):
+            prompt = np.concatenate([shared, rng.randint(
+                2, args.vocab, rng.randint(1, tail_max + 1))
+                .astype(np.int32)])
+            n_new = int(rng.randint(1, args.max_new + 1))
+            key = jax.random.PRNGKey(100 + i)
             try:
-                handles.append(client.submit(
-                    np.concatenate([shared, rng.randint(
-                        2, args.vocab, rng.randint(1, tail_max + 1))
-                        .astype(np.int32)]),
-                    int(rng.randint(1, args.max_new + 1)),
-                    rng=jax.random.PRNGKey(100 + i),
-                ))
+                h = client.submit(prompt, n_new, rng=key)
+                handles.append(h)
+                parity_jobs.append((h, prompt, n_new, key))
             except QueueFullError:
                 rejected += 1
         for h in handles + [streamed]:
@@ -264,7 +308,23 @@ def main() -> None:
             except Exception as e:  # shed past --deadline, or engine-failed
                 shed_or_failed += 1
                 print(f"request {h.id}: {type(e).__name__}: {e}")
-        report = client.metrics.report()
+        if fleet_mode:
+            fleet_rep = client.fleet_report()
+            pooled_ttft = fleet_rep["pooled"]["histograms"].get(
+                "serving_ttft_seconds", {})
+            report = {
+                "fleet_requests_total": fleet_rep["requests_total"],
+                "fleet_reroutes_total": fleet_rep["reroutes_total"],
+                "fleet_shed_total": fleet_rep["shed_total"],
+                "fleet_capacity": fleet_rep["capacity"],
+                "affinity_hit_rate": fleet_rep["affinity"]["hit_rate"],
+                "ttft_p50_s": pooled_ttft.get("p50_s"),
+                "ttft_p99_s": pooled_ttft.get("p99_s"),
+                "tokens_generated": fleet_rep["pooled"]["counters"].get(
+                    "serving_tokens_total", 0),
+            }
+        else:
+            report = client.metrics.report()
 
     print(f"streamed request: {len(stream_toks)} tokens "
           f"(first few: {stream_toks[:8]})")
@@ -276,14 +336,39 @@ def main() -> None:
           "shed/failed)")
     for k, v in sorted(report.items()):
         print(f"  {k}: {v}")
-    if engine.prefix_enabled:
-        print("prefix cache: " + ", ".join(
-            f"{k}={v}" for k, v in engine.prefix_stats().items()))
-    if engine.paged:
-        print("paged KV: " + ", ".join(
-            f"{k}={v}" for k, v in engine.kv_stats().items()))
-    print(f"engine executables: {engine.compile_counts_detailed()} "
-          "(zero recompiles after warmup)")
+    if args.verify_parity:
+        from chainermn_tpu.models import generate as solo_generate
+
+        checked = 0
+        for h, prompt, n_new, key in parity_jobs:
+            if h.state.value != "done" or checked >= 3:
+                continue
+            ref = np.asarray(solo_generate(
+                model, params, jnp.asarray(prompt)[None], n_new,
+                temperature=args.temperature, rng=key, eos_id=eos,
+                comm=comm)[0])
+            out = h.output
+            assert np.array_equal(out, ref[:len(out)]), (
+                f"request {h.id} diverged from solo generate()")
+            checked += 1
+        print(f"parity vs solo generate: OK ({checked} requests)")
+    if fleet_mode:
+        for r in front.replicas:
+            print(f"replica {r.replica_id}: state={r.state.value} "
+                  f"served={r.metrics.requests_completed} "
+                  f"executables={r.engine.compile_counts_detailed()} "
+                  "(zero recompiles after warmup)")
+        print("fleet: " + ", ".join(
+            f"{k}={v}" for k, v in fleet_rep["affinity"].items()))
+    else:
+        if engine.prefix_enabled:
+            print("prefix cache: " + ", ".join(
+                f"{k}={v}" for k, v in engine.prefix_stats().items()))
+        if engine.paged:
+            print("paged KV: " + ", ".join(
+                f"{k}={v}" for k, v in engine.kv_stats().items()))
+        print(f"engine executables: {engine.compile_counts_detailed()} "
+              "(zero recompiles after warmup)")
     if slo_engine is not None:
         import json
 

@@ -173,6 +173,31 @@ def test_serve_lm_paged_kv():
     assert "zero recompiles" in proc.stdout
 
 
+def test_serve_lm_fleet():
+    """ISSUE 8: two replicas behind the FleetRouter serve interleaved
+    shared-prefix traffic with token parity vs solo generate() — both
+    replicas take requests, affinity routes real hits, and every
+    replica's compiled-program family stays at exactly one executable."""
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "8", "--slots", "2", "--replicas", "2",
+         "--max-new", "6", "--prefill-len", "8", "--d-model", "32",
+         "--layers", "1", "--heads", "4", "--prefix-blocks", "16",
+         "--prefix-block-size", "2", "--shared-prefix", "4",
+         "--verify-parity"],
+    )
+    assert "8/8 requests served" in proc.stdout
+    assert "parity vs solo generate: OK (3 requests)" in proc.stdout
+    assert "replica 0:" in proc.stdout and "replica 1:" in proc.stdout
+    # interleaved: each replica actually served part of the burst
+    for line in proc.stdout.splitlines():
+        if line.startswith("replica "):
+            served = int(line.split("served=")[1].split()[0])
+            assert served > 0, line
+            assert "zero recompiles" in line
+    assert "affinity_hit_rate" in proc.stdout
+
+
 def test_serve_lm_tensor_parallel():
     proc = run_example(
         "lm/serve_lm.py",

@@ -1,0 +1,221 @@
+"""Fleet acceptance: N replicas behind one router serve interleaved
+traffic token-for-token equal to solo ``generate()``; replica failover
+loses zero accepted requests; the edge sheds deterministically; fleet
+observability pools per-replica series (ISSUE 8)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.fleet import FleetRouter, ReplicaState
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.serving import QueueFullError, RequestState, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=1,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make_engine(lm, params, *, n_slots=2, prefix=True):
+    kw = dict(prefix_cache_blocks=8, prefix_block_size=2) if prefix else {}
+    return ServingEngine(lm, params, n_slots=n_slots, prefill_len=6,
+                         cache_len=32, **kw)
+
+
+def make_fleet(lm, params, n=2, *, prefix=True, **kw):
+    return FleetRouter([make_engine(lm, params, prefix=prefix)
+                        for _ in range(n)], **kw)
+
+
+def solo(lm, params, prompt, n):
+    return np.asarray(generate(lm, params,
+                               jnp.asarray([prompt], jnp.int32), n)[0])
+
+
+# --------------------------------------------------------------------- #
+# parity + zero recompiles (acceptance)                                  #
+# --------------------------------------------------------------------- #
+
+
+def test_two_replicas_interleaved_parity(lm_and_params):
+    """Mixed prefix-heavy traffic through 2 replicas is token-for-token
+    a set of solo generate() calls, no surviving replica recompiled
+    after warmup, and the streaming/blocking consumer surfaces behave
+    like ServingClient's (one router session keeps tier-1 cheap)."""
+    lm, params = lm_and_params
+    prompts = [[1, 2, 3], [4, 5], [1, 2, 3, 4], [6, 7, 8],
+               [1, 2], [9, 10, 11], [1, 2, 3, 4, 5], [12, 13]]
+    with make_fleet(lm, params) as router:
+        assert router.wait_ready(300)
+        frs = [router.submit(np.array(p, np.int32), 5) for p in prompts]
+        for fr, p in zip(frs, prompts):
+            assert fr.wait(timeout=120)
+            assert fr.state is RequestState.DONE
+            np.testing.assert_array_equal(fr.output, solo(lm, params, p, 5))
+        # both replicas actually took traffic (interleaved, not failover)
+        served = [r.metrics.requests_completed for r in router.replicas]
+        assert all(s > 0 for s in served), served
+        for r in router.replicas:
+            assert r.engine.recompiles == {}, r.engine.recompiles
+        rep = router.fleet_report()
+        assert rep["capacity"] == 2
+        # shared-prefix traffic produced real affinity hits
+        assert rep["affinity"]["enabled"] and rep["affinity"]["hits"] > 0
+        # the consumer surfaces: per-token streaming + blocking generate
+        toks = []
+        fr = router.submit(np.array([1, 2, 3], np.int32), 5,
+                           stream_cb=toks.append)
+        got = list(fr.stream())
+        assert got == toks == fr.tokens and len(got) == 5
+        out = router.generate(np.array([4, 5], np.int32), 4, timeout=120)
+        np.testing.assert_array_equal(out, solo(lm, params, [4, 5], 4))
+
+
+# --------------------------------------------------------------------- #
+# kill-one-replica continuity (acceptance)                               #
+# --------------------------------------------------------------------- #
+
+
+def test_kill_replica_mid_stream_loses_nothing(lm_and_params):
+    """The continuity probe: kill the replica that owns a mid-stream
+    decode; its queued+in-flight work replays on the survivor with the
+    identical token stream (dedup'd — the consumer sees each token once),
+    zero accepted requests lost, and the survivor never recompiles."""
+    lm, params = lm_and_params
+    with make_fleet(lm, params, prefix=False, max_restarts=2) as router:
+        router.wait_ready(300)
+        streams: dict[int, list] = {}
+        frs = []
+        for i in range(6):
+            streams[i] = []
+            frs.append(router.submit(np.array([1 + i, 2 + i], np.int32), 16,
+                                     stream_cb=streams[i].append))
+        # wait until some request is mid-stream on replica 0, then kill it
+        deadline = time.perf_counter() + 60
+        victim = None
+        while time.perf_counter() < deadline and victim is None:
+            victim = next((fr for fr in frs
+                           if fr.replica_id == 0 and len(fr.tokens) > 0
+                           and not fr.finished), None)
+            if victim is None:
+                time.sleep(0.002)
+        router.kill_replica(0)
+        for fr in frs:
+            assert fr.wait(timeout=120)
+            assert fr.state is RequestState.DONE      # nothing lost
+        for i, fr in enumerate(frs):
+            ref = solo(lm, params, [1 + i, 2 + i], 16)
+            np.testing.assert_array_equal(fr.output, ref)
+            assert streams[i] == fr.tokens            # each token ONCE
+        assert router.replicas[0].state is ReplicaState.QUARANTINED
+        assert router.capacity == 1
+        if victim is not None:                        # mid-stream replay ran
+            assert router.fleet_report()["reroutes_total"] >= 1
+        # survivor: healthy, still serving, zero recompiles
+        assert router.replicas[1].engine.recompiles == {}
+        out = router.generate(np.array([9, 9], np.int32), 3, timeout=120)
+        np.testing.assert_array_equal(out, solo(lm, params, [9, 9], 3))
+        # kill the survivor too: capacity 0, submissions fail LOUDLY
+        router.kill_replica(1)
+        deadline = time.perf_counter() + 60
+        while router.capacity and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        assert router.capacity == 0
+        with pytest.raises(RuntimeError, match="no replica"):
+            router.submit(np.array([1, 2], np.int32), 2)
+
+
+# --------------------------------------------------------------------- #
+# fleet-edge admission + deterministic routing (autostart=False)         #
+# --------------------------------------------------------------------- #
+
+
+def test_affinity_routing_and_edge_shed_deterministic(lm_and_params):
+    """With a paused fleet (autostart=False) the placement sequence is
+    exact: first request takes the lowest-id replica (tie-break), a
+    shared-prefix follower sticks to it (affinity), an unrelated prompt
+    balances away — and the global max_queue sheds the overflow at the
+    fleet edge with QueueFullError. Starting the fleet then serves every
+    ACCEPTED request to completion."""
+    lm, params = lm_and_params
+    router = make_fleet(lm, params, max_queue=3, autostart=False)
+    try:
+        a = router.submit(np.array([1, 2, 3, 4, 5], np.int32), 2)
+        assert a.replica_id == 0 and not a.affinity_hit
+        b = router.submit(np.array([1, 2, 3, 4, 6], np.int32), 2)
+        assert b.replica_id == 0 and b.affinity_hit    # 2 shared blocks
+        c = router.submit(np.array([9, 8, 7], np.int32), 2)
+        assert c.replica_id == 1 and not c.affinity_hit  # least-loaded
+        rep = router.fleet_report()
+        assert rep["affinity"]["hits"] == 1
+        assert rep["affinity"]["misses"] == 2
+        with pytest.raises(QueueFullError):            # 3 queued == bound
+            router.submit(np.array([5, 6], np.int32), 2)
+        assert router.fleet_report()["shed_total"] == 1
+        router.start()
+        assert router.wait_ready(300)
+        for fr in (a, b, c):
+            assert fr.wait(timeout=120) and fr.state is RequestState.DONE
+    finally:
+        router.close()
+
+
+def test_no_affinity_flag_disables_trie_routing(lm_and_params):
+    lm, params = lm_and_params
+    router = make_fleet(lm, params, affinity=False, autostart=False)
+    try:
+        a = router.submit(np.array([1, 2, 3, 4], np.int32), 2)
+        b = router.submit(np.array([1, 2, 3, 4], np.int32), 2)
+        assert a.replica_id == 0
+        assert b.replica_id == 1 and not b.affinity_hit  # pure load balance
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------------- #
+# observability                                                          #
+# --------------------------------------------------------------------- #
+
+
+def test_fleet_report_pools_percentiles_and_http_endpoint(lm_and_params):
+    """The report's pooled block merges per-replica reservoirs the way
+    aggregate(comm) merges ranks (fleet-wide TTFT p50/p99 over BOTH
+    replicas' samples, counters summed), and the SAME live report is
+    scrapeable at monitor.http's /fleet."""
+    import json
+    from urllib.request import urlopen
+
+    from chainermn_tpu.monitor import http as monitor_http
+
+    lm, params = lm_and_params
+    with make_fleet(lm, params, prefix=False) as router:
+        router.wait_ready(300)
+        frs = [router.submit(np.array([1 + i, 2], np.int32), 3)
+               for i in range(6)]
+        for fr in frs:
+            fr.wait(timeout=120)
+        rep = router.fleet_report()
+        pooled = rep["pooled"]
+        assert pooled["ranks"] == 2
+        ttft = pooled["histograms"]["serving_ttft_seconds"]
+        assert ttft["count"] == 6                     # both replicas' TTFTs
+        assert ttft["p99_s"] >= ttft["p50_s"] > 0
+        assert pooled["counters"]["serving_requests_completed_total"] == 6
+        states = {v["state"] for v in rep["replicas"].values()}
+        assert states == {"healthy"}
+        with monitor_http.serve(port=0, fleet=router) as srv:
+            body = urlopen(f"{srv.url}/fleet", timeout=10).read()
+            scraped = json.loads(body)
+            assert scraped["n_replicas"] == 2
+            assert scraped["requests_total"] >= 6
+            assert "pooled" in scraped and "affinity" in scraped
+            index = urlopen(f"{srv.url}/", timeout=10).read().decode()
+            assert "/fleet" in index

@@ -1,0 +1,191 @@
+"""Routing policy as pure host logic: no engine, no device programs —
+the :mod:`chainermn_tpu.fleet.routing` decision functions against
+synthetic occupancy snapshots (ISSUE 8 satellite). Everything here is
+sub-second."""
+
+import pytest
+
+from chainermn_tpu.fleet.routing import (
+    FleetTrie,
+    ReplicaSnapshot,
+    RoutingPolicy,
+)
+
+
+def snap(rid, *, healthy=True, queued=0, active=0, slots=4, ttft=0.0,
+         kv_free=1.0):
+    return ReplicaSnapshot(replica_id=rid, healthy=healthy,
+                           queue_depth=queued, active_slots=active,
+                           n_slots=slots, ttft_ewma_s=ttft,
+                           kv_free_frac=kv_free)
+
+
+# --------------------------------------------------------------------- #
+# least-loaded + tie-breaks                                              #
+# --------------------------------------------------------------------- #
+
+
+def test_least_loaded_picks_emptiest():
+    p = RoutingPolicy()
+    d = p.route([snap(0, queued=3, active=4), snap(1, queued=0, active=1),
+                 snap(2, queued=1, active=2)])
+    assert d.replica_id == 1 and not d.affinity_hit
+    assert d.reason == "least_loaded"
+
+
+def test_load_normalizes_by_slot_count():
+    # 4 busy slots of 16 is LESS loaded than 1 busy slot of 2
+    p = RoutingPolicy()
+    d = p.route([snap(0, active=1, slots=2), snap(1, active=4, slots=16)])
+    assert d.replica_id == 1
+
+
+def test_deterministic_tie_break_lowest_id():
+    p = RoutingPolicy()
+    for order in ([0, 1, 2], [2, 1, 0], [1, 2, 0]):
+        d = p.route([snap(i) for i in order])
+        assert d.replica_id == 0     # equal load/ttft -> lowest id, always
+
+
+def test_ttft_ewma_breaks_load_ties():
+    p = RoutingPolicy()
+    d = p.route([snap(0, ttft=0.5), snap(1, ttft=0.1)])
+    assert d.replica_id == 1
+
+
+def test_unhealthy_replicas_never_route():
+    p = RoutingPolicy()
+    d = p.route([snap(0, healthy=False), snap(1, queued=9, active=4)])
+    assert d.replica_id == 1
+    assert p.route([snap(0, healthy=False), snap(1, healthy=False)]) is None
+
+
+# --------------------------------------------------------------------- #
+# affinity vs least-loaded                                               #
+# --------------------------------------------------------------------- #
+
+
+def test_affinity_beats_least_loaded_when_resident():
+    p = RoutingPolicy(max_imbalance=1.0)
+    snaps = [snap(0, queued=1, active=1), snap(1)]    # 1 is emptier
+    d = p.route(snaps, affinity_replica=0, affinity_blocks=3)
+    assert d.replica_id == 0 and d.affinity_hit
+    assert d.affinity_blocks == 3 and d.reason == "affinity"
+
+
+def test_no_residency_means_least_loaded():
+    """Affinity only wins when the prefix is ACTUALLY believed resident —
+    zero matched blocks routes by load."""
+    p = RoutingPolicy()
+    snaps = [snap(0, queued=1), snap(1)]
+    d = p.route(snaps, affinity_replica=None, affinity_blocks=0)
+    assert d.replica_id == 1 and not d.affinity_hit
+    d = p.route(snaps, affinity_replica=0, affinity_blocks=0)
+    assert d.replica_id == 1 and not d.affinity_hit
+
+
+def test_min_affinity_blocks_gate():
+    p = RoutingPolicy(min_affinity_blocks=2)
+    snaps = [snap(0, queued=1), snap(1)]
+    assert p.route(snaps, 0, 1).replica_id == 1       # 1 block: not worth it
+    assert p.route(snaps, 0, 2).replica_id == 0
+
+
+def test_overloaded_holder_loses_affinity():
+    """The imbalance guard: a cached prefix is not worth queueing behind
+    a hot replica (PERF.md's crossover)."""
+    p = RoutingPolicy(max_imbalance=1.0)
+    # holder load 2.0 vs base 0.0: past the imbalance bound
+    snaps = [snap(0, queued=4, active=4), snap(1)]
+    d = p.route(snaps, affinity_replica=0, affinity_blocks=8)
+    assert d.replica_id == 1 and not d.affinity_hit
+    # just inside the bound: affinity holds
+    snaps = [snap(0, queued=2, active=2), snap(1)]
+    assert p.route(snaps, 0, 8).replica_id == 0
+
+
+def test_affinity_to_unhealthy_or_dry_holder_falls_back():
+    p = RoutingPolicy()
+    snaps = [snap(0, healthy=False), snap(1)]
+    assert p.route(snaps, 0, 4).replica_id == 1
+    # paged pool dry: the holder loses its affinity claim (the busier
+    # load would otherwise have kept it) and load balancing takes over
+    snaps = [snap(0, queued=1, kv_free=0.0), snap(1)]
+    d = p.route(snaps, 0, 4)
+    assert d.replica_id == 1 and not d.affinity_hit
+
+
+def test_affinity_disabled_policy_ignores_trie():
+    p = RoutingPolicy(affinity=False)
+    d = p.route([snap(0, queued=1), snap(1)], affinity_replica=0,
+                affinity_blocks=9)
+    assert d.replica_id == 1 and not d.affinity_hit
+
+
+# --------------------------------------------------------------------- #
+# fleet-edge admission math                                              #
+# --------------------------------------------------------------------- #
+
+
+def test_overloaded_sums_healthy_queues():
+    p = RoutingPolicy()
+    snaps = [snap(0, queued=2), snap(1, queued=1),
+             snap(2, queued=50, healthy=False)]       # quarantined: ignored
+    assert not p.overloaded(snaps, 4)
+    assert p.overloaded(snaps, 3)
+    assert p.overloaded(snaps, 2)
+    assert not p.overloaded(snaps, None)              # unbounded
+
+
+# --------------------------------------------------------------------- #
+# the fleet trie                                                         #
+# --------------------------------------------------------------------- #
+
+
+def test_trie_longest_holder_and_block_granularity():
+    t = FleetTrie(block_size=2)
+    t.note([1, 2, 3, 4, 5, 6], 0)                     # 3 full blocks
+    t.note([1, 2, 3, 4], 1)                           # 2 full blocks
+    rid, blocks = t.lookup([1, 2, 3, 4, 5, 6, 7, 8])
+    assert (rid, blocks) == (0, 3)                    # deepest coverage wins
+    rid, blocks = t.lookup([1, 2, 9, 9])
+    assert blocks == 1                                # shared first block
+    assert t.lookup([7, 7, 7, 7]) == (None, 0)        # miss
+    assert t.lookup([1]) == (None, 0)                 # no full block
+
+
+def test_trie_tie_breaks_most_recent_then_lowest_id():
+    t = FleetTrie(block_size=2)
+    t.note([1, 2, 3, 4], 1)
+    t.note([1, 2, 3, 4], 0)                           # same depth, newer
+    assert t.lookup([1, 2, 3, 4, 5]) == (0, 2)
+    t2 = FleetTrie(block_size=2)
+    t2.note([1, 2], 1)
+    t2.note([1, 2], 0)
+    t2.note([1, 2], 1)                                # 1 re-stamped newest
+    assert t2.lookup([1, 2, 3]) == (1, 1)
+
+
+def test_trie_drop_replica_forgets_and_prunes():
+    t = FleetTrie(block_size=2)
+    t.note([1, 2, 3, 4], 0)
+    t.note([1, 2], 1)                                 # shares the first node
+    assert t.n_nodes == 2
+    pruned = t.drop_replica(0)
+    assert pruned == 1                                # (3,4) was 0-only
+    assert t.lookup([1, 2, 3, 4]) == (1, 1)           # first block survives
+    t.drop_replica(1)
+    assert t.n_nodes == 0 and t.lookup([1, 2]) == (None, 0)
+
+
+def test_trie_bounded_nodes_evict_lru():
+    t = FleetTrie(block_size=1, max_nodes=4)
+    for i in range(8):
+        t.note([100 + i], 0)                          # 8 distinct leaves
+    assert t.n_nodes <= 4
+    assert t.lookup([107]) == (0, 1)                  # newest retained
+
+
+def test_trie_rejects_bad_block_size():
+    with pytest.raises(ValueError, match="block_size"):
+        FleetTrie(block_size=0)

@@ -1,16 +1,20 @@
-"""Import-cycle guard: no ``chainermn_tpu.monitor`` module may import
-``chainermn_tpu.extensions`` at module level.
+"""Import-cycle guard: no ``chainermn_tpu.monitor`` (or
+``chainermn_tpu.fleet``) module may import ``chainermn_tpu.extensions``
+at module level.
 
 ``extensions/__init__`` imports ``checkpoint``, which imports the monitor
 package (registry counters + flight-recorder events on checkpoint I/O); a
 module-level import the other way closes the cycle and breaks whichever
 side loads second (PR 3 hit exactly this — ``registry.py`` now imports
 ``latency_report`` lazily inside functions, and every monitor module
-added since must obey the same rule).
+added since must obey the same rule). The fleet package (ISSUE 8) obeys
+the same rule — and goes further: its modules import the whole
+serving/resilience stack lazily too, so the router/policy layer stays a
+pure host-logic import (jax-free until an engine is actually driven).
 
 Mechanism: a fresh subprocess stubs the ``chainermn_tpu`` parent package
 (so the top-level facade — which legitimately imports extensions — never
-runs), imports every monitor module, then asserts
+runs), imports every module of the package under test, then asserts
 ``chainermn_tpu.extensions`` is absent from ``sys.modules``. One
 subprocess covers all modules; it pins the property for future additions
 by globbing the package directory rather than hard-coding the list.
@@ -20,6 +24,7 @@ import os
 import subprocess
 import sys
 
+import chainermn_tpu.fleet as fleet_pkg
 import chainermn_tpu.monitor as monitor_pkg
 
 _SCRIPT = r"""
@@ -30,11 +35,13 @@ import sys
 import types
 
 pkg_dir = sys.argv[1]
+pkg_name = sys.argv[2]                       # e.g. chainermn_tpu.monitor
+required = set(sys.argv[3].split(","))       # glob sanity check
 
 # Stub the parent package: submodule imports resolve against the real
 # directory, but the real chainermn_tpu/__init__.py (which imports
 # extensions by design) never executes — isolating exactly the property
-# under test: what the MONITOR modules themselves import.
+# under test: what the package's OWN modules import.
 stub = types.ModuleType("chainermn_tpu")
 stub.__path__ = [os.path.dirname(pkg_dir)]
 sys.modules["chainermn_tpu"] = stub
@@ -43,30 +50,43 @@ modules = sorted(
     os.path.splitext(os.path.basename(p))[0]
     for p in glob.glob(os.path.join(pkg_dir, "*.py"))
 )
-assert "trace" in modules and "slo" in modules and "http" in modules, \
-    f"glob missed the new modules: {modules}"
+missing = required - set(modules)
+assert not missing, f"glob missed {missing}: {modules}"
 for name in modules:
-    mod = "chainermn_tpu.monitor" if name == "__init__" else \
-        f"chainermn_tpu.monitor.{name}"
+    mod = pkg_name if name == "__init__" else f"{pkg_name}.{name}"
     importlib.import_module(mod)
     offenders = [m for m in sys.modules
                  if m.startswith("chainermn_tpu.extensions")]
     assert not offenders, (
         f"importing {mod} pulled in {offenders} at module level — "
-        "chainermn_tpu.monitor must import extensions lazily (inside "
-        "functions) to avoid the extensions<->monitor cycle"
+        f"{pkg_name} must import extensions lazily (inside functions) "
+        "to avoid the extensions<->monitor cycle"
     )
 print("clean:", len(modules), "modules")
 """
 
 
-def test_monitor_modules_never_import_extensions_at_module_level():
-    pkg_dir = os.path.dirname(monitor_pkg.__file__)
+def _run_hygiene(pkg, pkg_name, required):
+    pkg_dir = os.path.dirname(pkg.__file__)
     proc = subprocess.run(
-        [sys.executable, "-c", _SCRIPT, pkg_dir],
+        [sys.executable, "-c", _SCRIPT, pkg_dir, pkg_name,
+         ",".join(required)],
         capture_output=True, text=True, timeout=120,
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
     )
     assert proc.returncode == 0, (
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
     assert "clean:" in proc.stdout
+
+
+def test_monitor_modules_never_import_extensions_at_module_level():
+    _run_hygiene(monitor_pkg, "chainermn_tpu.monitor",
+                 ("trace", "slo", "http"))
+
+
+def test_fleet_modules_never_import_extensions_at_module_level():
+    """ISSUE 8 satellite: the fleet tier rides the monitor spine and must
+    stay out of the extensions cycle the same way — router/replica pull
+    serving (which pulls extensions) lazily, never at module level."""
+    _run_hygiene(fleet_pkg, "chainermn_tpu.fleet",
+                 ("router", "replica", "routing"))

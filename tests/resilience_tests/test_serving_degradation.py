@@ -397,6 +397,101 @@ def test_batch_retry_absorbs_transient_admission_fault(lm_and_params):
     assert sched.metrics.report()["requests_errored"] == 0
 
 
+# --------------------------------------------------------------------- #
+# fleet tier: route faults, replica failure, quarantine (ISSUE 8)        #
+# --------------------------------------------------------------------- #
+
+
+def make_fleet(lm, params, n=2, **kw):
+    from chainermn_tpu.fleet import FleetRouter
+
+    engines = [ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                             cache_len=32) for _ in range(n)]
+    return FleetRouter(engines, **kw)
+
+
+def test_fleet_route_fault_falls_back_then_replica_fault_reroutes(
+        lm_and_params):
+    """One router session, both fleet cut-points. (1) ``fleet.route``
+    raise: placement degrades to the lowest-id accepting replica — the
+    request still lands, with solo parity. (2) ``fleet.replica`` raise:
+    the supervisor fails in-flight work loudly, drains QUEUED work,
+    warm-restarts the replica (no recompiles), and the router replays
+    the affected requests on a healthy replica — every request DONE
+    with solo parity, and the fleet keeps serving after."""
+    lm, params = lm_and_params
+    with make_fleet(lm, params, max_restarts=2) as router:
+        assert router.wait_ready(300)
+        inj = FaultInjector()
+        inj.arm("fleet.route", kind="raise", times=1)
+        with inj:
+            fr = router.submit(np.array([3, 4, 5]), 4)
+        assert fr.wait(timeout=120)
+        assert fr.state is RequestState.DONE
+        assert fr.replica_id == 0                    # the fallback replica
+        assert router.fleet_report()["route_fallbacks_total"] >= 1
+        ref = generate(lm, params, jnp.asarray([[3, 4, 5]], jnp.int32), 4)
+        np.testing.assert_array_equal(fr.output, np.asarray(ref[0]))
+        # (2) replica-level failure -> supervisor restart + re-route
+        inj2 = FaultInjector()
+        inj2.arm("fleet.replica", kind="raise", times=1)
+        with inj2:
+            frs = [router.submit(np.array([1 + i, 2 + i]), 6)
+                   for i in range(4)]
+            for r in frs:
+                assert r.wait(timeout=120)
+        assert all(r.state is RequestState.DONE for r in frs)
+        for i, r in enumerate(frs):
+            ref = generate(lm, params,
+                           jnp.asarray([[1 + i, 2 + i]], jnp.int32), 6)
+            np.testing.assert_array_equal(r.output, np.asarray(ref[0]))
+        assert sum(r.restarts for r in router.replicas) == 1
+        assert router.capacity == 2                  # restarted, not lost
+        for r in router.replicas:
+            assert r.engine.recompiles == {}         # warm restart
+        # and the fleet is still serving
+        out = router.generate(np.array([9, 9]), 3, timeout=120)
+        ref = generate(lm, params, jnp.asarray([[9, 9]], jnp.int32), 3)
+        np.testing.assert_array_equal(out, np.asarray(ref[0]))
+
+
+def test_fleet_quarantine_shrinks_capacity_sheds_counted(lm_and_params):
+    """Past max_restarts the supervisor quarantines: capacity shrinks to
+    the survivors, fleet-edge sheds are counted against the global
+    queue bound, and no waiter strands — every accepted request reaches
+    a terminal state on the surviving replica."""
+    lm, params = lm_and_params
+    router = make_fleet(lm, params, max_restarts=0, max_queue=2,
+                        autostart=False)
+    try:
+        accepted = [router.submit(np.array([1 + i, 2 + i]), 3)
+                    for i in range(2)]
+        from chainermn_tpu.serving import QueueFullError
+
+        with pytest.raises(QueueFullError):          # edge shed, counted
+            router.submit(np.array([9, 9]), 3)
+        inj = FaultInjector()
+        inj.arm("fleet.replica", kind="raise", times=1)
+        with inj:
+            router.start()
+            assert router.wait_ready(300)
+            for fr in accepted:                      # no stranded waiters
+                assert fr.wait(timeout=120)
+        assert all(fr.state is RequestState.DONE for fr in accepted)
+        rep = router.fleet_report()
+        assert router.capacity == 1                  # quarantined, for good
+        states = sorted(v["state"] for v in rep["replicas"].values())
+        assert states == ["healthy", "quarantined"]
+        assert rep["shed_total"] >= 1
+        # the quarantined replica's drained work was re-routed or it had
+        # none; either way the fleet serves on
+        out = router.generate(np.array([5, 6]), 4, timeout=120)
+        ref = generate(lm, params, jnp.asarray([[5, 6]], jnp.int32), 4)
+        np.testing.assert_array_equal(out, np.asarray(ref[0]))
+    finally:
+        router.close()
+
+
 def test_kv_append_fault_preempts_without_burning_a_restart(lm_and_params):
     """Chaos case (PR 7): an injected fault at the paged engine's lazy
     block append is contained by PREEMPTING only that slot's request —
