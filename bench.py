@@ -851,6 +851,86 @@ def serving_main() -> None:
             f"({p['concurrency_gain']}x) at {budget_rows} KV rows, "
             f"preemptions={p['preemptions']}, parity={pg_parity}")
 
+        # ---- hot swap: online weight publish through the version fence - #
+        # ISSUE 10 serving-continuity probe: n_swaps publishes land in the
+        # base engine while it decodes. Each cycle fills the pool, fences
+        # a swap mid-stream (publish_async — this thread drives step(), so
+        # a blocking publish would deadlock against its own fence), keeps
+        # stepping until the swap lands, then submits post-swap work. The
+        # record carries swap latency p50/max, the tokens/s dip inside the
+        # swap windows vs steady state, the version ledger, and the
+        # zero-recompile invariant across every swap.
+        from chainermn_tpu.deploy import WeightPublisher
+
+        n_swaps = int(e("CHAINERMN_TPU_SERVE_SWAPS", "3"))
+        hs_sched = FCFSScheduler(engine)
+        hs_pub = WeightPublisher(engine, hs_sched)
+        hs_counts = engine.compile_counts_detailed()
+        new_params = jax.tree_util.tree_map(lambda l: l * 1.001, params)
+        base_version = engine.weight_version
+        swap_total, swap_fence, swap_commit = [], [], []
+        window_tokens = window_wall = 0.0
+        versions_ok = True
+        hs_done = 0
+        hs_total = 0
+        t0 = time.time()
+        for k in range(n_swaps):
+            pre = [hs_sched.submit(
+                rng.randint(1, vocab, rng.randint(
+                    1, prefill_len + 1)).astype(np.int32), max_new)
+                for _ in range(n_slots)]
+            hs_sched.step()            # admit the pool on the OLD weights
+            handle = hs_pub.publish_async(new_params)
+            t_sw = time.time()
+            while not handle.done:     # fence drains, swap lands mid-loop
+                window_tokens += hs_sched.step()
+            window_wall += time.time() - t_sw
+            post = [hs_sched.submit(
+                rng.randint(1, vocab, rng.randint(
+                    1, prefill_len + 1)).astype(np.int32), max_new)
+                for _ in range(2)]
+            hs_sched.run_until_idle()
+            swap_total.append(handle.total_s)
+            swap_fence.append(handle.fence_s)
+            swap_commit.append(handle.commit_s)
+            want_pre = base_version + k
+            versions_ok = versions_ok and all(
+                r.weight_version == want_pre for r in pre) and all(
+                r.weight_version == want_pre + 1 for r in post)
+            hs_total += len(pre) + len(post)
+            hs_done += sum(r.state.value == "done" for r in pre + post)
+        wall_hs = time.time() - t0
+        hs_m = hs_sched.metrics.report()
+        steady_tps = hs_m["tokens_per_sec"]
+        window_tps = window_tokens / max(window_wall, 1e-9)
+        assert engine.compile_counts_detailed() == hs_counts, "recompiled!"
+        record["hot_swap"] = {
+            "swaps": n_swaps,
+            "swap_total_s_p50": round(
+                float(np.percentile(swap_total, 50)), 6),
+            "swap_total_s_max": round(float(max(swap_total)), 6),
+            "swap_fence_s_p50": round(
+                float(np.percentile(swap_fence, 50)), 6),
+            "swap_commit_s_p50": round(
+                float(np.percentile(swap_commit, 50)), 6),
+            "tokens_per_sec_steady": steady_tps,
+            "tokens_per_sec_during_swap": round(window_tps, 2),
+            "throughput_dip_frac": round(
+                1.0 - window_tps / max(steady_tps, 1e-9), 4),
+            "requests": hs_total,
+            "requests_done": hs_done,
+            "weight_version": engine.weight_version,
+            "versions_correct": versions_ok,
+            "wall_s": round(wall_hs, 3),
+            "recompiles_after_warmup": sum(engine.recompiles.values()),
+        }
+        hsr = record["hot_swap"]
+        log(f"hot swap: {n_swaps} swaps, total_p50="
+            f"{hsr['swap_total_s_p50'] * 1e3:.1f}ms (fence "
+            f"{hsr['swap_fence_s_p50'] * 1e3:.1f}ms), dip="
+            f"{hsr['throughput_dip_frac']}, versions_ok={versions_ok}, "
+            f"recompiles={hsr['recompiles_after_warmup']}")
+
         # ---- fleet: N replicas vs 1 at equal total KV budget (ISSUE 8) - #
         # The SAME prefix-heavy workload through a FleetRouter over
         # fl_n replicas of n_slots/fl_n slots each (total KV budget ==
@@ -933,6 +1013,20 @@ def serving_main() -> None:
                     sum(r.engine.recompiles.values()) for r in survivors),
                 "replica_states": {k: v["state"]
                                    for k, v in rep["replicas"].items()},
+            }
+            # rolling publish through the surviving replicas: the
+            # quarantined kill-probe victim is skipped, everyone still
+            # accepting takes the new version with zero recompiles
+            pub_out = router.publish(new_params, timeout=120.0)
+            rep2 = router.fleet_report()
+            record["fleet_serving"]["publish"] = {
+                "ok": pub_out["ok"],
+                "outcomes": pub_out["replicas"],
+                "weight_versions": {
+                    k: v["weight_version"]
+                    for k, v in rep2["replicas"].items()},
+                "recompiles_after_publish_survivors": sum(
+                    sum(r.engine.recompiles.values()) for r in survivors),
             }
         finally:
             router.close()

@@ -1,0 +1,234 @@
+"""Online weight hot-swap into a live serving engine.
+
+:class:`WeightPublisher` commits a new param pytree into a running
+:class:`~chainermn_tpu.serving.engine.ServingEngine` without stopping
+traffic and without a single recompile. The mechanism is split so the
+expensive part happens OUTSIDE the fence:
+
+1. **Commit** (publisher thread): every leaf of the incoming tree is
+   ``device_put`` against the sharding of the engine's current leaf —
+   the exact shardings warmup compiled against (sharding is part of the
+   jit cache key) — and blocked until resident. After this, the swap
+   itself is a pointer exchange.
+2. **Fence** (scheduler thread): :meth:`FCFSScheduler.request_swap`
+   pauses admissions; in-flight requests drain on the weights they
+   started with (each response carries its ``weight_version``); between
+   two decode steps the drained scheduler executes the swap on the one
+   thread that owns the engine. ``swap_params`` validates structure,
+   shapes, dtypes, and shardings BEFORE assigning, so a failed swap
+   rolls back to the prior version by never having left it.
+
+``publish`` blocks for the whole cycle and must be called from a thread
+that is NOT driving ``scheduler.step()`` (the in-process
+:class:`~chainermn_tpu.serving.client.ServingClient` owns such a driving
+thread); single-threaded drivers (benchmarks, tests that call ``step()``
+by hand) use :meth:`publish_async` and keep stepping until the returned
+handle completes — a blocking wait on the driving thread would deadlock
+against the fence it is supposed to drain.
+
+Import hygiene: jax and the serving stack (which pulls extensions) load
+lazily inside methods — pinned by ``test_import_hygiene.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from chainermn_tpu.deploy.versions import VersionLog
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+
+
+class PublishError(RuntimeError):
+    """A weight publish failed; the engine kept its prior weights."""
+
+
+class SwapHandle:
+    """Progress/result handle for one publish cycle."""
+
+    def __init__(self, ticket, t_start: float, commit_s: float) -> None:
+        self._ticket = ticket
+        self._t_start = t_start
+        self.commit_s = commit_s          # device_put + block_until_ready
+        self.version: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        return self._ticket.done
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._ticket.error
+
+    @property
+    def fence_s(self) -> Optional[float]:
+        return self._ticket.fence_s
+
+    @property
+    def total_s(self) -> Optional[float]:
+        if self._ticket.t_executed is None:
+            return None
+        return self._ticket.t_executed - self._t_start
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        """Block until the swap executed; returns the new weight version.
+        Raises :class:`PublishError` if the swap failed (engine still on
+        its prior weights) or the wait timed out."""
+        try:
+            ok = self._ticket.wait(timeout)
+        except BaseException as e:
+            raise PublishError(f"weight publish failed: {e}") from e
+        if not ok:
+            raise PublishError(
+                f"weight publish still fenced after {timeout}s — is the "
+                "scheduler being stepped? (publish from a non-driving "
+                "thread, or use publish_async with a manual step loop)")
+        self.version = self._ticket.result
+        return self.version
+
+
+class WeightPublisher:
+    """Publishes versioned weight sets into one live engine.
+
+    ``scheduler=None`` is the offline mode: the swap applies immediately
+    on the calling thread and requires the engine to be idle (no slots
+    decoding) — the caller owns that guarantee.
+    """
+
+    def __init__(self, engine, scheduler=None, *,
+                 log: Optional[VersionLog] = None) -> None:
+        self.engine = engine
+        self.scheduler = scheduler
+        self.log = log if log is not None else VersionLog()
+        reg = get_registry()
+        labels = {"engine": "serving"}
+        self._c_swaps = reg.counter("deploy_swaps_total", labels)
+        self._c_failed = reg.counter("deploy_swap_failures_total", labels)
+        self._h_swap = reg.histogram("deploy_swap_seconds", labels)
+        self._events = get_event_log()
+
+    # ------------------------------------------------------------------ #
+
+    def _commit(self, params):
+        """Move the incoming tree onto the engine's exact shardings and
+        wait for residency — the transfer happens on the publisher's
+        thread, BEFORE the fence, so fence time is drain-only."""
+        import jax
+
+        from chainermn_tpu.resilience.faults import inject
+
+        inject("deploy.publish", version=self.engine.weight_version + 1)
+        old_leaves = jax.tree_util.tree_leaves(self.engine.params)
+        new_leaves, treedef = jax.tree_util.tree_flatten(params)
+        if len(old_leaves) != len(new_leaves):
+            # full structural validation happens in swap_params; this
+            # early check just keeps the zip below honest
+            raise PublishError(
+                f"publish: {len(new_leaves)} leaves for an engine with "
+                f"{len(old_leaves)}")
+        committed = []
+        for old, new in zip(old_leaves, new_leaves):
+            sh = getattr(old, "sharding", None)
+            if sh is None:
+                committed.append(new)
+            elif getattr(old, "_committed", True):
+                committed.append(jax.device_put(new, sh))
+            else:
+                # the engine's leaf is UNcommitted (plain single-device
+                # init) — committed-ness is part of the jit cache key, so
+                # an explicitly-placed replacement would recompile; a
+                # bare device_put keeps the new leaf uncommitted on the
+                # default device, matching the warmup key exactly
+                committed.append(jax.device_put(new))
+        committed = jax.block_until_ready(
+            jax.tree_util.tree_unflatten(treedef, committed))
+        return committed
+
+    def _swap_fn(self, committed, step: Optional[int]):
+        def run():
+            version = self.engine.swap_params(committed)
+            self.log.record(version, source="publish", step=step)
+            return version
+        return run
+
+    def publish_async(self, params, *, step: Optional[int] = None
+                      ) -> SwapHandle:
+        """Commit ``params`` device-side, then fence the swap through the
+        scheduler; returns a :class:`SwapHandle` immediately. The caller
+        must keep the scheduler stepping (or be running a client/replica
+        loop that does) for the handle to complete."""
+        t0 = time.perf_counter()
+        try:
+            committed = self._commit(params)
+        except Exception as e:
+            self._c_failed.inc()
+            self._events.emit("publish_failed", phase="commit",
+                              error=type(e).__name__)
+            raise PublishError(
+                f"weight publish failed during commit: {e}") from e
+        commit_s = time.perf_counter() - t0
+        if self.scheduler is not None:
+            ticket = self.scheduler.request_swap(
+                self._swap_fn(committed, step))
+        else:
+            # offline: no fence needed, the engine must be idle
+            from chainermn_tpu.serving.scheduler import SwapTicket
+
+            ticket = SwapTicket(self._swap_fn(committed, step))
+            if getattr(self.engine, "active_slots", 0):
+                ticket.error = PublishError(
+                    "publish without a scheduler requires an idle engine")
+            else:
+                try:
+                    ticket.result = ticket.fn()
+                except Exception as e:  # noqa: BLE001 — on the ticket
+                    ticket.error = e
+            ticket.t_executed = time.perf_counter()
+            ticket._done.set()
+        handle = SwapHandle(ticket, t0, commit_s)
+        self._watch(handle)
+        return handle
+
+    def publish(self, params, *, step: Optional[int] = None,
+                timeout: Optional[float] = 60.0) -> int:
+        """Blocking publish cycle; returns the new weight version. Must
+        NOT be called from the thread driving ``scheduler.step()`` (see
+        module docstring)."""
+        return self.publish_async(params, step=step).wait(timeout)
+
+    # ------------------------------------------------------------------ #
+
+    def _watch(self, handle: SwapHandle) -> None:
+        """Record metrics when the handle resolves — inline if it already
+        did (offline mode), else from the ticket's completion via a
+        cheap poll at wait() time is not enough (async callers may never
+        wait), so we piggyback on the ticket event in a tiny daemon
+        thread only when still pending."""
+        if handle.done:
+            self._record(handle)
+            return
+        import threading
+
+        def run():
+            handle._ticket._done.wait()
+            self._record(handle)
+
+        threading.Thread(target=run, daemon=True,
+                         name="deploy-swap-watch").start()
+
+    def _record(self, handle: SwapHandle) -> None:
+        if handle.error is None:
+            self._c_swaps.inc()
+            if handle.total_s is not None:
+                self._h_swap.observe(handle.total_s)
+            self._events.emit(
+                "publish", version=handle._ticket.result,
+                commit_s=round(handle.commit_s, 6),
+                fence_s=round(handle.fence_s or 0.0, 6))
+        else:
+            self._c_failed.inc()
+            self._events.emit("publish_failed", phase="swap",
+                              error=type(handle.error).__name__)
+
+
+__all__ = ["PublishError", "SwapHandle", "WeightPublisher"]

@@ -1,0 +1,68 @@
+"""Versioned-weights bookkeeping shared by both halves of the deploy layer.
+
+A weight *version* is a monotonically increasing integer scoped to one
+``ServingEngine``: version 0 is whatever the engine was constructed with,
+and every successful :class:`~chainermn_tpu.deploy.publish.WeightPublisher`
+commit (or elastic restore into a spawned replica) bumps it by one. The
+number is deliberately engine-local — a fleet rolling through a publish has
+replicas briefly on different versions, and the router's report exposes
+exactly that skew rather than pretending to a global counter.
+
+The :class:`VersionLog` is the host-side audit trail: who published which
+version, from where (``init`` / ``publish`` / ``restore``), at which train
+step. It is plain host state (no jax import) so the fleet/router layer can
+read it without touching the device stack.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class WeightVersion:
+    """One committed weight set, as seen by one engine."""
+
+    version: int
+    source: str = "init"          # "init" | "publish" | "restore"
+    step: Optional[int] = None    # producer's train step, when known
+    wall_time: float = field(default_factory=time.time)
+
+
+class VersionLog:
+    """Thread-safe append-only log of :class:`WeightVersion` records.
+
+    ``record`` is called from whichever thread executes the swap (the
+    scheduler's driving thread, usually a replica loop); ``history`` and
+    ``current`` are called from publisher/router threads — hence the lock.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: List[WeightVersion] = [WeightVersion(0, "init")]
+
+    def record(self, version: int, *, source: str,
+               step: Optional[int] = None) -> WeightVersion:
+        entry = WeightVersion(version, source, step)
+        with self._lock:
+            self._entries.append(entry)
+        return entry
+
+    @property
+    def current(self) -> WeightVersion:
+        with self._lock:
+            return self._entries[-1]
+
+    def history(self) -> List[WeightVersion]:
+        with self._lock:
+            return list(self._entries)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+__all__ = ["VersionLog", "WeightVersion"]

@@ -8,22 +8,40 @@ TPU-idiomatic upgrade SURVEY S5 calls out as *exceeding* upstream: it saves
 
 - each process writes only its local shards (a ZeRO-sharded optimizer state
   costs 1/n of the bytes per process, not n copies of everything);
-- restore places every leaf back onto its original sharding (replicated
-  leaves stay replicated, rank-sharded moments stay rank-sharded) given a
-  template of like-sharded arrays;
+- restore places every leaf back onto the **template's** shardings — which
+  need not be the save-time ones: orbax gathers-or-slices each leaf onto
+  whatever mesh/spec the template (or an explicit ``shardings=`` override)
+  declares, which is what makes snapshots the elastic-restore substrate
+  (``chainermn_tpu.deploy.reshard`` builds on exactly this, adding the
+  TP-degree permutation orbax cannot know about);
 - snapshots are step-stamped and GC'd to ``keep`` newest, mirroring the
   round-robin GC of the reference checkpointer.
 
+Hardening (unified with ``MultiNodeCheckpointer``): every save also writes
+a small **manifest** sidecar (save-time mesh shape / TP degree / caller
+meta) carrying the same CRC32 checksum footer, written atomically
+(tmp + rename); a corrupt manifest is reported as absent rather than
+trusted, and legacy footerless/manifest-less checkpoints restore exactly
+as before. An optional :class:`~chainermn_tpu.resilience.retry.RetryPolicy`
+wraps the save/restore I/O (``sharded_checkpoint.save`` /
+``sharded_checkpoint.load`` ops), and both paths carry the matching
+fault-injection cut-points.
+
 Single- and multi-process: orbax coordinates multi-host writes through
-jax.distributed on its own.
+jax.distributed on its own. The manifest lives in a sibling ``<path>.meta``
+directory so the orbax-managed tree stays exclusively orbax's.
 """
 
 from __future__ import annotations
 
 import os
+import pickle
 from typing import Any, Optional
 
 import jax
+
+from chainermn_tpu.extensions.checkpoint import _add_footer, _strip_footer
+from chainermn_tpu.resilience.faults import inject
 
 
 class ShardedCheckpointer:
@@ -37,11 +55,13 @@ class ShardedCheckpointer:
             {"params": params, "opt": opt_state})   # template: like-sharded
     """
 
-    def __init__(self, path: str, keep: int = 3) -> None:
+    def __init__(self, path: str, keep: int = 3, *, retry=None) -> None:
         import orbax.checkpoint as ocp
 
         self._path = os.path.abspath(path)
         self._keep = keep
+        self._retry = retry
+        self._meta_dir = self._path + ".meta"
         self._mgr = ocp.CheckpointManager(
             self._path,
             options=ocp.CheckpointManagerOptions(
@@ -49,34 +69,133 @@ class ShardedCheckpointer:
             ),
         )
 
-    def save(self, step: int, state: Any, *, wait: bool = True) -> None:
+    def _call(self, fn, *args, op: str):
+        if self._retry is not None:
+            return self._retry.call(fn, *args, op=op)
+        return fn(*args)
+
+    # ------------------------------------------------------------------ #
+    # save                                                                #
+    # ------------------------------------------------------------------ #
+
+    def save(self, step: int, state: Any, *, wait: bool = True,
+             meta: Optional[dict] = None) -> None:
         """Write a snapshot of ``state`` (a pytree of jax.Arrays) at
-        ``step``; each process persists only its addressable shards."""
+        ``step``; each process persists only its addressable shards.
+        ``meta`` (mesh shape, TP degree, model dims — anything picklable)
+        lands in the step's manifest sidecar for restore-time decisions."""
         import orbax.checkpoint as ocp
 
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        def write():
+            inject("sharded_checkpoint.save", step=step)
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+        self._call(write, op="sharded_checkpoint.save")
+        self._write_manifest(step, meta or {})
         if wait:
             self._mgr.wait_until_finished()
 
-    def maybe_restore(self, template: Any) -> tuple[Optional[Any], Optional[int]]:
-        """Restore the newest snapshot onto ``template``'s shardings.
+    def _write_manifest(self, step: int, meta: dict) -> None:
+        """CRC32-footered, atomically-renamed sidecar (the
+        ``MultiNodeCheckpointer`` hardening idiom) holding save-time
+        metadata; pruned alongside orbax's own GC."""
+        os.makedirs(self._meta_dir, exist_ok=True)
+        payload = pickle.dumps(dict(meta, step=int(step)))
+        final = self._manifest_path(step)
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_add_footer(payload))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        # GC manifests for steps orbax no longer retains
+        live = {int(s) for s in self._mgr.all_steps()} | {int(step)}
+        for name in os.listdir(self._meta_dir):
+            if not name.startswith("manifest_") or name.endswith(".tmp"):
+                continue
+            try:
+                s = int(name[len("manifest_"):].split(".", 1)[0])
+            except ValueError:
+                continue
+            if s not in live:
+                try:
+                    os.remove(os.path.join(self._meta_dir, name))
+                except OSError:
+                    pass
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._meta_dir, f"manifest_{int(step)}.bin")
+
+    def manifest(self, step: Optional[int] = None) -> Optional[dict]:
+        """The manifest saved with ``step`` (newest when None), or None
+        when this checkpoint predates manifests OR the sidecar is corrupt
+        (a bad checksum is reported as absence, never trusted — restoring
+        without metadata degrades to the legacy same-shape path)."""
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                return None
+        path = self._manifest_path(step)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            payload, verified = _strip_footer(f.read())
+        if verified is False:
+            return None
+        try:
+            return pickle.loads(payload)
+        except Exception:  # noqa: BLE001 — corrupt == absent
+            return None
+
+    # ------------------------------------------------------------------ #
+    # restore                                                             #
+    # ------------------------------------------------------------------ #
+
+    def maybe_restore(self, template: Any, *, shardings: Any = None,
+                      step: Optional[int] = None,
+                      ) -> tuple[Optional[Any], Optional[int]]:
+        """Restore a snapshot onto a **target** sharding layout.
 
         Returns ``(state, step)`` or ``(None, None)`` when no snapshot
         exists. ``template`` supplies structure, dtypes, shapes AND
-        shardings (pass the live state you would otherwise initialize)."""
+        shardings (pass the live state you would otherwise initialize) —
+        the target layout may differ from the save-time one: each leaf is
+        gathered-or-sliced onto the template's sharding. ``shardings``
+        overrides the template's layout — either ONE sharding applied to
+        every leaf (e.g. replicated for a pre-reshard gather) or a
+        like-structured pytree of shardings. ``step`` pins a specific
+        snapshot (newest when None)."""
         import orbax.checkpoint as ocp
 
-        step = self._mgr.latest_step()
         if step is None:
-            return None, None
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.StandardRestore(jax.tree_util.tree_map(
-                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=l.sharding)
-                if hasattr(l, "sharding") else l,
-                template,
-            )),
-        )
+            step = self._mgr.latest_step()
+            if step is None:
+                return None, None
+
+        def struct(leaf, sh):
+            if sh is not None:
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=sh)
+            if hasattr(leaf, "sharding"):
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=leaf.sharding)
+            return leaf
+
+        if shardings is None:
+            target = jax.tree_util.tree_map(
+                lambda l: struct(l, None), template)
+        elif isinstance(shardings, jax.sharding.Sharding):
+            target = jax.tree_util.tree_map(
+                lambda l: struct(l, shardings), template)
+        else:
+            target = jax.tree_util.tree_map(struct, template, shardings)
+
+        def load():
+            inject("sharded_checkpoint.load", step=step)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target))
+
+        restored = self._call(load, op="sharded_checkpoint.load")
         return restored, step
 
     def all_steps(self) -> list[int]:

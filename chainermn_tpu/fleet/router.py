@@ -138,6 +138,15 @@ class FleetRequest:
         return RequestState.QUEUED
 
     @property
+    def weight_version(self):
+        """Weight version the current binding decoded under (stamped at
+        admission; the fence guarantees it never changes mid-decode).
+        None while still queued."""
+        inner = self._inner
+        return (getattr(inner, "weight_version", None)
+                if inner is not None else None)
+
+    @property
     def output(self) -> np.ndarray:
         """``prompt + generated`` tokens; an ERRORED request re-raises its
         stored exception (never a silent partial)."""
@@ -240,11 +249,18 @@ class FleetRouter:
                                         labels)
         self.max_reroutes = (int(max_reroutes) if max_reroutes is not None
                              else len(engines))
+        # replicas added later (spawn_replica) are built with the same
+        # configuration as the constructor's set
+        self._replica_cfg = dict(eos_id=eos_id, max_restarts=max_restarts,
+                                 retry=retry, idle_wait_s=idle_wait_s)
+        self._labels = labels
+        # replicas currently inside a publish fence: routing steers new
+        # work away from them (unless nothing else is healthy)
+        self._publishing: set[int] = set()
         self.replicas = [
-            EngineReplica(i, eng, eos_id=eos_id, max_restarts=max_restarts,
-                          retry=retry, idle_wait_s=idle_wait_s,
-                          on_failure=self._on_replica_failure,
-                          labels=labels, autostart=autostart)
+            EngineReplica(i, eng, on_failure=self._on_replica_failure,
+                          labels=labels, autostart=autostart,
+                          **self._replica_cfg)
             for i, eng in enumerate(engines)
         ]
 
@@ -374,7 +390,14 @@ class FleetRouter:
         back to the lowest-id accepting replica — placement degrades,
         the request still lands."""
         candidates = [s for s in snaps if s.healthy
-                      and s.replica_id != exclude]
+                      and s.replica_id != exclude
+                      and s.replica_id not in self._publishing]
+        if not candidates:
+            # every healthy replica is mid-publish (or excluded): landing
+            # on a fenced replica just queues through its swap window —
+            # better than shedding
+            candidates = [s for s in snaps if s.healthy
+                          and s.replica_id != exclude]
         if not candidates:
             candidates = [s for s in snaps if s.healthy]
         try:
@@ -590,6 +613,107 @@ class FleetRouter:
                 self._finalize_locked(fr, RequestState.ERRORED, failure)
 
     # ------------------------------------------------------------------ #
+    # weight lifecycle (the deploy layer's fleet surface)                 #
+    # ------------------------------------------------------------------ #
+
+    def publish(self, params, *, step: Optional[int] = None,
+                timeout: float = 60.0) -> dict:
+        """Rolling weight publish: swap ``params`` into every replica,
+        ONE at a time. While a replica is fenced (draining its in-flight
+        work before the swap), routing steers new submissions to its
+        peers — the fleet keeps serving at N-1 capacity through each
+        window, and every accepted request completes on the weights it
+        started with. A replica that fails its swap (or is quarantined)
+        is recorded and skipped; the roll continues, so one bad replica
+        never wedges the deployment. Returns a per-replica outcome dict;
+        ``ok`` is True only when every accepting replica took the new
+        version."""
+        from chainermn_tpu.deploy.publish import WeightPublisher
+
+        results: dict[str, dict] = {}
+        for replica in list(self.replicas):
+            rid = replica.replica_id
+            if not replica.accepting:
+                results[str(rid)] = {"ok": False,
+                                     "skipped": replica.state.value}
+                continue
+            with self._lock:
+                self._publishing.add(rid)
+            try:
+                publisher = WeightPublisher(replica.engine,
+                                            replica.scheduler)
+                # the replica's own drive loop keeps stepping through the
+                # fence (has_work includes the pending swap), so blocking
+                # here is safe — this thread never drives that scheduler
+                handle = publisher.publish_async(params, step=step)
+                version = handle.wait(timeout)
+                results[str(rid)] = {
+                    "ok": True, "version": version,
+                    "commit_s": round(handle.commit_s, 6),
+                    "fence_s": round(handle.fence_s or 0.0, 6),
+                }
+            except Exception as e:  # noqa: BLE001 — roll past one failure
+                results[str(rid)] = {"ok": False,
+                                     "error": f"{type(e).__name__}: {e}"}
+            finally:
+                with self._lock:
+                    self._publishing.discard(rid)
+        ok = all(r.get("ok") for r in results.values()
+                 if "skipped" not in r) and bool(results)
+        self._events.emit("fleet_publish", ok=ok,
+                          replicas={k: v.get("version", None)
+                                    for k, v in results.items()})
+        return {"ok": ok, "replicas": results}
+
+    def spawn_replica(self, engine=None, *, checkpoint=None,
+                      engine_factory=None, params_template=None,
+                      comm=None, model=None,
+                      wait_ready: bool = True,
+                      timeout: float = 300.0) -> EngineReplica:
+        """Bring one MORE replica into the fleet without stopping
+        traffic — elastic scale-up and deployment in one mechanism.
+
+        Either pass a constructed ``engine``, or a ``checkpoint``
+        (:class:`~chainermn_tpu.extensions.sharded_checkpoint
+        .ShardedCheckpointer`) plus ``engine_factory(params) ->
+        ServingEngine`` and a like-sharded ``params_template``: the new
+        replica's params come from :func:`~chainermn_tpu.deploy.reshard
+        .elastic_restore` onto the template's mesh — which may be a
+        DIFFERENT shape from both the snapshot's and the existing
+        replicas' meshes. The replica warms up on its own thread and
+        starts taking routed traffic once healthy; existing replicas
+        never pause."""
+        if engine is None:
+            if checkpoint is None or engine_factory is None \
+                    or params_template is None:
+                raise ValueError(
+                    "spawn_replica needs either engine= or all of "
+                    "checkpoint=/engine_factory=/params_template=")
+            from chainermn_tpu.deploy.reshard import elastic_restore
+
+            state, ckpt_step = elastic_restore(
+                checkpoint, {"params": params_template},
+                comm=comm, model=model)
+            if state is None:
+                raise RuntimeError(
+                    "spawn_replica: checkpoint has no snapshot to "
+                    "restore from")
+            engine = engine_factory(state["params"])
+            self._events.emit("fleet_spawn_restore", step=ckpt_step)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("fleet router is closed")
+            rid = len(self.replicas)
+            replica = EngineReplica(
+                rid, engine, on_failure=self._on_replica_failure,
+                labels=self._labels, autostart=True, **self._replica_cfg)
+            self.replicas.append(replica)
+        self._events.emit("fleet_spawn", replica=rid)
+        if wait_ready:
+            replica.ready.wait(timeout)
+        return replica
+
+    # ------------------------------------------------------------------ #
     # observability                                                       #
     # ------------------------------------------------------------------ #
 
@@ -612,6 +736,7 @@ class FleetRouter:
                 "kv_free_frac": occ["kv_free_frac"],
                 "recompiles_after_warmup":
                     sum(r.engine.recompiles.values()),
+                "weight_version": occ.get("weight_version", 0),
                 "requests_completed": r.metrics.requests_completed,
                 "requests_errored": r.metrics.requests_errored,
             }

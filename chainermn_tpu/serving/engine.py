@@ -316,6 +316,12 @@ class ServingEngine:
         self._c_restarts = reg.counter("serving_engine_restarts_total",
                                        labels)
         self._c_appends = reg.counter("kv_block_appends_total", labels)
+        # versioned weights (the deploy layer's hot-swap surface):
+        # version 0 is the constructor's params; every successful
+        # swap_params bumps it and moves the gauge
+        self.weight_version = 0
+        self._g_weight_version = reg.gauge("serving_weight_version", labels)
+        self._g_weight_version.set(0)
 
         # paged mode: ONE shared block store (pool + trie on it), per-slot
         # block tables; the dense caches/prefix store are never built
@@ -1390,6 +1396,56 @@ class ServingEngine:
         self._events.emit("engine_restart")
 
     # ------------------------------------------------------------------ #
+    # versioned weights (the deploy layer's swap surface)                 #
+    # ------------------------------------------------------------------ #
+
+    def swap_params(self, new_params, *, version: Optional[int] = None) -> int:
+        """Commit a new param pytree in place; returns the new version.
+
+        The caller (normally :class:`~chainermn_tpu.deploy.publish
+        .WeightPublisher`, via the scheduler's swap fence) must hand over
+        a tree with the EXACT structure, per-leaf shape/dtype, and
+        shardings of the current params — sharding is part of the jit
+        cache key, so an identically-committed tree makes the swap a
+        pure pointer exchange: the compiled prefill/decode programs next
+        run on the new weights with ZERO recompiles. Validation happens
+        BEFORE anything is assigned, so a rejected swap leaves the
+        engine bit-for-bit on its prior weights (never a half-written
+        engine). Params are never donated (see :meth:`restart`), so the
+        old tree stays alive for any caller-held reference.
+        """
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new_params)
+        if new_def != old_def:
+            raise EngineStateError(
+                f"swap_params: tree structure mismatch — engine has "
+                f"{old_def}, got {new_def}")
+        for i, (old, new) in enumerate(zip(old_leaves, new_leaves)):
+            if getattr(new, "shape", None) != old.shape or \
+                    getattr(new, "dtype", None) != old.dtype:
+                raise EngineStateError(
+                    f"swap_params: leaf {i} is "
+                    f"{getattr(new, 'shape', None)}/"
+                    f"{getattr(new, 'dtype', None)}, engine compiled "
+                    f"against {old.shape}/{old.dtype}")
+            old_sh = getattr(old, "sharding", None)
+            new_sh = getattr(new, "sharding", None)
+            if old_sh is not None and (
+                    new_sh is None
+                    or not new_sh.is_equivalent_to(old_sh, old.ndim)):
+                raise EngineStateError(
+                    f"swap_params: leaf {i} sharding {new_sh} is not "
+                    f"equivalent to the warmup-compiled {old_sh} — "
+                    "device_put against engine.params shardings first "
+                    "(jit cache key discipline)")
+        self.params = new_params
+        self.weight_version = (int(version) if version is not None
+                               else self.weight_version + 1)
+        self._g_weight_version.set(self.weight_version)
+        self._events.emit("weight_swap", version=self.weight_version)
+        return self.weight_version
+
+    # ------------------------------------------------------------------ #
     # observability                                                       #
     # ------------------------------------------------------------------ #
 
@@ -1445,6 +1501,7 @@ class ServingEngine:
             "prefix_enabled": self.prefix_enabled,
             "paged": self.paged,
             "warm": self._warm,
+            "weight_version": self.weight_version,
         }
 
 

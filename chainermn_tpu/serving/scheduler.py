@@ -142,6 +142,9 @@ class Request:
     t_submit: float = 0.0
     t_deadline: Optional[float] = None
     t_last_token: float = 0.0
+    # engine weight version this request decodes on, stamped at slot
+    # commit (None until admitted, or on engines without versioning)
+    weight_version: Optional[int] = None
     # request-scoped trace context: rides the request through queue ->
     # admit -> prefill -> decode -> retire (NULL_TRACE when tracing off)
     trace: object = NULL_TRACE
@@ -191,6 +194,43 @@ class Request:
                     raise self.error
                 return
             self._done.wait(poll_s)
+
+
+class SwapTicket:
+    """Handle for one pending weight swap (see
+    :meth:`FCFSScheduler.request_swap`). ``wait()`` blocks until the
+    scheduler's driving thread executed (or failed) the swap; ``result``
+    holds the swap fn's return value, ``error`` the exception if it
+    raised — a failed swap leaves the engine on its prior weights (the
+    swap fn validates before assigning), so the ticket is the only place
+    the failure surfaces."""
+
+    def __init__(self, fn: Callable[[], object]) -> None:
+        self.fn = fn
+        self.result: object = None
+        self.error: Optional[BaseException] = None
+        self.t_request = time.perf_counter()
+        self.t_executed: Optional[float] = None
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the swap executed; re-raises the swap's exception
+        in the caller. True when it completed within ``timeout``."""
+        ok = self._done.wait(timeout)
+        if self.error is not None:
+            raise self.error
+        return ok
+
+    @property
+    def fence_s(self) -> Optional[float]:
+        """Wall time the swap spent fenced (request -> execution)."""
+        if self.t_executed is None:
+            return None
+        return self.t_executed - self.t_request
 
 
 class FCFSScheduler:
@@ -248,6 +288,7 @@ class FCFSScheduler:
         self._by_slot: dict[int, Request] = {}
         self._lock = threading.Lock()
         self._ids = itertools.count()
+        self._pending_swap: Optional[SwapTicket] = None
 
     # ------------------------------------------------------------------ #
     # submission surface (any thread)                                     #
@@ -327,7 +368,8 @@ class FCFSScheduler:
     @property
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._queue) or bool(self._by_slot)
+            return (bool(self._queue) or bool(self._by_slot)
+                    or self._pending_swap is not None)
 
     @property
     def queue_depth(self) -> int:
@@ -369,12 +411,42 @@ class FCFSScheduler:
         untouched."""
         with self._lock:
             has_inflight = bool(self._by_slot)
+            ticket, self._pending_swap = self._pending_swap, None
+        if ticket is not None:
+            # a publisher waiting on this ticket must hear about the
+            # death instead of hanging on a fence that will never drain
+            ticket.error = EngineFailed(
+                "engine failed while a weight swap was fenced")
+            ticket.error.__cause__ = e
+            ticket.t_executed = time.perf_counter()
+            ticket._done.set()
         if has_inflight:
             restart, self._restart_on_error = self._restart_on_error, False
             try:
                 self._engine_failure(e)
             finally:
                 self._restart_on_error = restart
+
+    def request_swap(self, fn: Callable[[], object]) -> SwapTicket:
+        """Enqueue a weight swap to run on the scheduler's driving thread
+        at the next safe point (thread-safe; the publisher's entry point).
+
+        The swap is a *version fence*: while a ticket is pending, NO new
+        admissions happen — every in-flight request completes (or
+        retires) entirely on the weights it started with — and once the
+        slot pool drains, ``fn`` executes between decode steps on the one
+        thread that touches the engine. Queued requests admit after the
+        swap, on the new weights; the fence wait shows up as a ``swap``
+        span in their traces. Only one swap may be pending at a time.
+        """
+        ticket = SwapTicket(fn)
+        with self._lock:
+            if self._pending_swap is not None:
+                raise RuntimeError(
+                    "a weight swap is already pending on this scheduler")
+            self._pending_swap = ticket
+        self._events.emit("swap_fence", queue_depth=self.queue_depth)
+        return ticket
 
     # ------------------------------------------------------------------ #
     # the scheduling loop (one driving thread)                            #
@@ -386,12 +458,25 @@ class FCFSScheduler:
         decode step, so a retirement's slot never sits idle for a step."""
         emitted = 0
         self._shed_expired()
+        # 0. version fence: while a swap is pending, admissions pause so
+        # every in-flight request finishes on the weights it started
+        # with; once the pool drains the swap runs HERE, between device
+        # calls, on the one thread that owns the engine
+        with self._lock:
+            swapping = self._pending_swap is not None
+            if swapping and not self._by_slot:
+                ticket, self._pending_swap = self._pending_swap, None
+                swapping = False
+            else:
+                ticket = None
+        if ticket is not None:
+            self._execute_swap(ticket)
         # 1. admission: one group (>= 1 same-bucket requests, one device
         # call) per iteration, FCFS-anchored; bounded prefill interleave
         # in cost-aware mode so a deep queue can't stall decode
         with annotate("chainermn.serving_admit"):
             calls = 0
-            while self.engine.free_slots and (
+            while not swapping and self.engine.free_slots and (
                     self._max_prefills is None or calls < self._max_prefills):
                 group = self._next_group()
                 if not group:
@@ -623,6 +708,11 @@ class FCFSScheduler:
                 req.slot = slot
                 self._by_slot[slot] = req
                 req.state = RequestState.DECODE
+                # stamp the engine weight version this request will
+                # decode on — the fence guarantees it never changes
+                # between here and retirement
+                req.weight_version = getattr(
+                    self.engine, "weight_version", None)
             self._events.emit("slot_admit", req=req.id, slot=slot,
                               prompt_len=len(req.prompt),
                               bucket=plan.bucket, cached=plan.start,
@@ -634,6 +724,33 @@ class FCFSScheduler:
             self._deliver(req, first, now)
             emitted += 1
         return emitted
+
+    def _execute_swap(self, ticket: SwapTicket) -> None:
+        """Run a fenced weight swap on the driving thread (pool already
+        drained). A raising swap fn surfaces ONLY on the ticket — the
+        engine keeps its prior weights (the fn validates before
+        assigning), the queue keeps being served."""
+        t0 = time.perf_counter()
+        try:
+            ticket.result = ticket.fn()
+        except Exception as e:  # noqa: BLE001 — surfaced on the ticket
+            ticket.error = e
+        t1 = time.perf_counter()
+        ticket.t_executed = t1
+        with self._lock:
+            waiting = list(self._queue)
+        for req in waiting:
+            # the fence held these requests back: make the wait visible
+            # in their traces as the swap window itself
+            req.trace.add_span("swap", t0, t1,
+                               ok=ticket.error is None)
+        self._events.emit(
+            "swap_exec", ok=ticket.error is None,
+            fence_s=round(t1 - ticket.t_request, 6),
+            queue_depth=len(waiting),
+            **({"error": type(ticket.error).__name__}
+               if ticket.error is not None else {}))
+        ticket._done.set()
 
     def _fail_group(self, reqs: list, e: BaseException) -> None:
         """A batched admission failed with the engine intact: the group's
@@ -856,4 +973,5 @@ __all__ = [
     "QueueFullError",
     "Request",
     "RequestState",
+    "SwapTicket",
 ]

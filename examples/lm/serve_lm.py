@@ -49,6 +49,14 @@ states, affinity hit rate, fleet-pooled TTFT percentiles) prints at the
 end, and ``--verify-parity`` checks the first few outputs token-for-token
 against solo ``generate()``.
 
+And the weight lifecycle (ISSUE 10): ``--reshard-from <dir>`` restores
+the serving params from a ``ShardedCheckpointer`` snapshot directory
+through ``deploy.elastic_restore`` — a snapshot saved while training at
+one mesh shape / TP degree serves at another (the manifest's save-time
+geometry drives the fused-qkv layout permutation); pair with
+``train_lm.py --snapshot-to`` for the train→reshard→serve chain, or with
+``train_lm.py --publish-to engine`` for the online hot-swap variant.
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -147,6 +155,14 @@ def main() -> None:
                          "trie holds it, within the load-imbalance bound")
     ap.add_argument("--no-affinity", dest="affinity", action="store_false",
                     help="pure occupancy-aware least-loaded routing")
+    ap.add_argument("--reshard-from", default="",
+                    help="restore the serving params from a "
+                         "ShardedCheckpointer snapshot directory through "
+                         "deploy.elastic_restore: the manifest's "
+                         "save-time TP degree is resharded onto THIS "
+                         "run's layout (dense or --tensor-parallel at "
+                         "any degree), so a training snapshot serves "
+                         "directly — see train_lm.py --snapshot-to")
     ap.add_argument("--verify-parity", action="store_true",
                     help="after the burst, check the first few completed "
                          "requests token-for-token against solo "
@@ -216,6 +232,28 @@ def main() -> None:
         ))(init_tok)
     else:
         params = model.init(jax.random.PRNGKey(0), init_tok)
+
+    if args.reshard_from:
+        # elastic restore (ISSUE 10): the fresh-init params are only the
+        # restore TEMPLATE (structure + target shardings); a snapshot
+        # saved on a different mesh shape or TP degree is gathered,
+        # qkv-permuted per the manifest, and re-sliced onto this layout
+        from chainermn_tpu.deploy import elastic_restore
+        from chainermn_tpu.extensions.sharded_checkpoint import (
+            ShardedCheckpointer,
+        )
+
+        with ShardedCheckpointer(args.reshard_from) as cp:
+            mf = cp.manifest() or {}
+            restored, step = elastic_restore(
+                cp, {"params": params}, comm=comm, model=model)
+        if restored is None:
+            raise SystemExit(
+                f"--reshard-from {args.reshard_from}: no snapshot found")
+        params = restored["params"]
+        print(f"resharded snapshot step {step}: save-time tp_degree="
+              f"{mf.get('tp_degree', 1)} -> serving tp_degree="
+              f"{comm.size if comm else 1}")
 
     buckets = (tuple(int(b) for b in args.prefill_buckets.split(","))
                if args.prefill_buckets else None)

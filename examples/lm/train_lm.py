@@ -123,6 +123,75 @@ def _serve_samples(args, comm, model, params, tokens_all):
           f"{engine.compile_counts_detailed()} (zero recompiles)")
 
 
+class _OnlinePublisher:
+    """``--publish-to engine``: the online train→serve loop (ISSUE 10).
+
+    A live serving engine (initial weights) plus its background client
+    thread come up BEFORE training starts; every ``--publish-every``
+    iterations the freshly trained params hot-swap in through the deploy
+    version fence — the client thread drains the fence, which is what
+    makes the blocking ``publish`` from the training loop safe — a
+    continuation samples at the new version, and training continues.
+    The jit cache is asserted unchanged across every swap at close."""
+
+    def __init__(self, args, model, params, tokens_all) -> None:
+        from chainermn_tpu.deploy import WeightPublisher
+        from chainermn_tpu.serving import ServingClient, ServingEngine
+
+        infer = (model.clone(moe_impl="gshard") if model.moe_experts
+                 else model)
+        ctx_len = min(args.seq_len // 2, 16)
+        self._ctx = np.asarray(tokens_all[0][:ctx_len], np.int32)
+        self.every = args.publish_every or max(1, args.iterations // 2)
+        self._engine = ServingEngine(
+            infer, jax.device_get(params), n_slots=2,
+            prefill_len=ctx_len, cache_len=ctx_len + 16)
+        self._engine.warmup()
+        self._client = ServingClient(self._engine)
+        self._pub = WeightPublisher(self._engine, self._client.scheduler)
+        self._counts = dict(self._engine.compile_counts_detailed())
+        self._sample("serving v0 (initial weights)")
+
+    def _sample(self, label: str) -> None:
+        out = self._client.generate(
+            self._ctx, 12,
+            rng=jax.random.PRNGKey(self._engine.weight_version),
+            timeout=300)
+        print(f"{label}: ...{[int(t) for t in out[-8:]]}")
+
+    def publish(self, it: int, params) -> None:
+        # host copy, like --serve-samples: the engine runs plain-jit
+        # uncommitted leaves and the publisher re-places to match them
+        v = self._pub.publish(jax.device_get(params), step=it,
+                              timeout=120.0)
+        self._sample(f"published v{v} at iter {it}")
+
+    def close(self) -> None:
+        assert dict(self._engine.compile_counts_detailed()) == self._counts
+        self._client.close()
+        print(f"publish-to engine: weight_version="
+              f"{self._engine.weight_version}, zero recompiles across "
+              "swaps")
+
+
+def _save_snapshot(args, comm, model, params) -> None:
+    """``--snapshot-to``: step-stamped sharded snapshot of the trained
+    params with the resharding manifest (mesh shape, TP degree, head
+    geometry) — what ``serve_lm.py --reshard-from`` consumes, on any
+    mesh shape or TP degree."""
+    from chainermn_tpu.deploy import snapshot_meta
+    from chainermn_tpu.extensions.sharded_checkpoint import (
+        ShardedCheckpointer,
+    )
+
+    meta = snapshot_meta(comm=comm, model=model)
+    with ShardedCheckpointer(args.snapshot_to) as cp:
+        cp.save(args.iterations, {"params": params}, meta=meta)
+    if comm.rank == 0:
+        print(f"snapshot -> {args.snapshot_to} (step {args.iterations}, "
+              f"tp_degree={meta.get('tp_degree', 1)})")
+
+
 def _drop_suffix(acc) -> str:
     """Footer fragment for the aggregated MoE drop telemetry ('' when the
     run had no MoE steps) — shared by every mode's final log line."""
@@ -414,6 +483,25 @@ def main() -> None:
                              "fast path (bucketed batched prefill + "
                              "prefix KV reuse) — training-to-serving in "
                              "one script (plain/MoE modes; 0: off)")
+    parser.add_argument("--publish-to", default="",
+                        help="online train->serve (ISSUE 10): 'engine' "
+                             "stands up a live in-process serving engine "
+                             "BEFORE training and hot-swaps the params "
+                             "into it every --publish-every iterations "
+                             "through the deploy version fence (zero "
+                             "recompiles, traffic keeps flowing), "
+                             "sampling a continuation at each version "
+                             "(address-shaped targets are reserved for a "
+                             "network front)")
+    parser.add_argument("--publish-every", type=int, default=0,
+                        help="with --publish-to: publish cadence in "
+                             "iterations (default: half the run)")
+    parser.add_argument("--snapshot-to", default="",
+                        help="save a sharded snapshot of the trained "
+                             "params (with the resharding manifest: mesh "
+                             "shape, TP degree, head geometry) to this "
+                             "directory — serve it on a DIFFERENT mesh/"
+                             "TP degree via serve_lm.py --reshard-from")
     parser.add_argument("--trace-out", default="",
                         help="write the run's train-step span trees "
                              "(prefetch-wait / dispatch / loss-fetch / "
@@ -466,6 +554,23 @@ def main() -> None:
                          "loop through training.fit; the gspmd/pipeline/"
                          "resume modes build their own loops and would "
                          "silently ignore them")
+    if args.publish_to and args.publish_to != "engine":
+        raise SystemExit("--publish-to: only the in-process 'engine' "
+                         "target exists (a network front would take an "
+                         "address here)")
+    if args.publish_to and (
+            args.gspmd or args.pipeline or args.seq_parallel
+            or args.tensor_parallel or args.resume
+            or args.prefetch_depth or args.fetch_every > 1):
+        raise SystemExit("--publish-to rides the plain synchronous train "
+                         "loop (like --serve-samples): it does not "
+                         "combine with the sharded-model, resume, or "
+                         "async-loop flags")
+    if args.snapshot_to and (args.gspmd or args.pipeline or args.resume):
+        raise SystemExit("--snapshot-to snapshots the plain/SP/TP loop's "
+                         "params; the gspmd/pipeline/resume modes own "
+                         "their state layouts and would silently ignore "
+                         "it")
     if args.gspmd:
         return run_gspmd(args, comm)
     if args.pipeline:
@@ -617,10 +722,16 @@ def main() -> None:
                   f"{args.prefetch_depth}, fetch_every={args.fetch_every}),"
                   f" loss {losses[0]:.3f} -> {losses[-1]:.3f}  "
                   f"{tok_s:.0f} tok/s incl. compile")
+        if args.snapshot_to:
+            _save_snapshot(args, comm, model, params)
         _dump_traces(args)
         return
 
     from chainermn_tpu.parallel import MoeStatsAccumulator
+
+    publisher = None
+    if args.publish_to and comm.rank == 0:
+        publisher = _OnlinePublisher(args, model, params, tokens_all)
 
     gen = batches()
     t0, toks = time.time(), 0
@@ -646,6 +757,8 @@ def main() -> None:
                 print(f"compiled; first loss {first:.3f} "
                       f"(uniform = {np.log(args.vocab):.3f})")
         toks += tok.size
+        if publisher is not None and it % publisher.every == 0:
+            publisher.publish(it, params)
         if it % 20 == 0 and comm.rank == 0:
             last = float(loss)
             drop = (f"  moe_drop {float(stats['moe_drop_frac']):.1%}"
@@ -656,6 +769,10 @@ def main() -> None:
     if comm.rank == 0:
         print(f"done: {args.iterations} iterations, "
               f"loss {first:.3f} -> {last:.3f}{_drop_suffix(acc)}")
+    if publisher is not None:
+        publisher.close()
+    if args.snapshot_to:
+        _save_snapshot(args, comm, model, params)
     if args.serve_samples:
         _serve_samples(args, comm, model, params, tokens_all)
     _dump_traces(args)

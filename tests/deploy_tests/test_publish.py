@@ -1,0 +1,216 @@
+"""Online weight hot-swap (ISSUE 10): new params enter a LIVE engine with
+zero recompiles and zero dropped requests.
+
+The acceptance shape: requests decoding when the publish lands finish
+token-for-token on the weights they started with; requests admitted
+after the fence decode on the new weights; every response carries the
+weight version it ran under; the jit cache never grows. A failed swap
+is a rollback by construction — validation happens before assignment,
+so the engine never leaves its prior version."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.deploy import PublishError, VersionLog, WeightPublisher
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.monitor.trace import Tracer
+from chainermn_tpu.serving import (
+    EngineFailed,
+    EngineStateError,
+    FCFSScheduler,
+    ServingEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=2,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def _bump(params, f=1.001):
+    return jax.tree_util.tree_map(lambda l: l * f, params)
+
+
+def solo(lm, params, prompt, n):
+    out = generate(lm, params, jnp.asarray(prompt, jnp.int32)[None], n)
+    return np.asarray(out[0])
+
+
+def test_offline_publish_without_scheduler(lm_and_params):
+    """scheduler=None: the swap applies immediately on an idle engine,
+    bumping the version, gauge, and the shared VersionLog."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                           cache_len=32)
+    log = VersionLog()
+    pub = WeightPublisher(engine, log=log)
+    assert engine.weight_version == 0
+    v = pub.publish(_bump(params), step=123)
+    assert v == 1 and engine.weight_version == 1
+    assert engine.occupancy()["weight_version"] == 1
+    assert log.current.version == 1
+    assert log.current.source == "publish" and log.current.step == 123
+    # structure mismatch fails in commit, before any engine state moves
+    with pytest.raises(PublishError):
+        pub.publish({"params": {}})
+    assert engine.weight_version == 1
+
+
+def test_swap_mid_stream_is_token_exact(lm_and_params):
+    """THE hot-swap acceptance: requests in flight when the publish lands
+    drain on the OLD weights (token-exact vs solo generate), requests
+    after the fence run on the NEW weights, each response is stamped
+    with its version, and the jit cache did not grow."""
+    lm, params = lm_and_params
+    new_params = _bump(params)
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                           cache_len=32)
+    tracer = Tracer(sample=1, ring=32)
+    sched = FCFSScheduler(engine, tracer=tracer)
+    pub = WeightPublisher(engine, sched)
+
+    # warm the cache shape set, then freeze the expected counts
+    warm = sched.submit(np.array([1, 2, 3]), 3)
+    sched.run_until_idle()
+    assert warm.finished
+    counts = dict(engine.compile_counts_detailed())
+
+    pre = [sched.submit(np.array([1, 2, 3]), 8),
+           sched.submit(np.array([4, 5]), 8)]
+    for _ in range(3):          # mid-decode, slots occupied
+        sched.step()
+    assert engine.active_slots == 2
+
+    handle = pub.publish_async(new_params, step=7)
+    fenced = sched.submit(np.array([6, 7, 8]), 5)   # queued behind the fence
+    while not handle.done:      # the driving thread drains its own fence
+        sched.step()
+    assert handle.wait(0) == 1
+    assert handle.fence_s is not None and handle.commit_s >= 0
+
+    post = sched.submit(np.array([9, 10]), 5)
+    sched.run_until_idle()
+
+    # pre-swap requests: OLD weights, version 0, token-for-token
+    for r, prompt, n in zip(pre, ([1, 2, 3], [4, 5]), (8, 8)):
+        assert r.finished and r.weight_version == 0
+        np.testing.assert_array_equal(r.output, solo(lm, params, prompt, n))
+    # fenced + post requests: NEW weights, version 1
+    for r, prompt, n in ((fenced, [6, 7, 8], 5), (post, [9, 10], 5)):
+        assert r.finished and r.weight_version == 1
+        np.testing.assert_array_equal(
+            r.output, solo(lm, new_params, prompt, n))
+
+    # zero recompiles: same executables before and after the swap
+    assert dict(engine.compile_counts_detailed()) == counts
+    assert engine.recompiles == {}
+    # the fenced request's trace shows the swap wait
+    trace = next(t for t in tracer.finished(kind="serving")
+                 if t.root.labels["req"] == fenced.id)
+    assert "swap" in [s.name for s in trace.spans]
+
+
+def test_failed_swap_never_leaves_prior_version(lm_and_params):
+    """A bad publish (leaf shape mismatch) surfaces on the handle as the
+    engine's validation error; in-flight work finishes untouched on the
+    old weights and a follow-up good publish still lands."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                           cache_len=32)
+    sched = FCFSScheduler(engine)
+    pub = WeightPublisher(engine, sched)
+
+    r = sched.submit(np.array([1, 2, 3]), 6)
+    sched.step()
+
+    bad = jax.tree_util.tree_map(lambda l: l, params)
+    bad["params"]["lm_head"]["bias"] = jnp.zeros(3, jnp.float32)
+    handle = pub.publish_async(bad)
+    while not handle.done:
+        sched.step()
+    assert isinstance(handle.error, EngineStateError)
+    with pytest.raises(PublishError):
+        handle.wait(0)
+    assert engine.weight_version == 0
+
+    sched.run_until_idle()
+    assert r.finished and r.weight_version == 0
+    np.testing.assert_array_equal(r.output, solo(lm, params, [1, 2, 3], 6))
+
+    v = pub.publish_async(_bump(params))
+    while not v.done:
+        sched.step()
+    assert v.wait(0) == 1 and engine.weight_version == 1
+
+
+def test_single_pending_swap_enforced(lm_and_params):
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=1, prefill_len=6,
+                           cache_len=32)
+    sched = FCFSScheduler(engine)
+    sched.submit(np.array([1, 2, 3]), 4)
+    sched.step()                 # occupy the slot so the fence stays up
+    pub = WeightPublisher(engine, sched)
+    h1 = pub.publish_async(_bump(params))
+    with pytest.raises(RuntimeError, match="already pending"):
+        pub.publish_async(_bump(params, 1.002))
+    while not h1.done:
+        sched.step()
+    assert h1.wait(0) == 1
+
+
+def test_engine_death_fails_the_fenced_ticket(lm_and_params):
+    """fail_inflight during a fence must fail the pending ticket — a
+    blocked publisher hears EngineFailed instead of hanging forever."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=1, prefill_len=6,
+                           cache_len=32)
+    sched = FCFSScheduler(engine)
+    pub = WeightPublisher(engine, sched)
+    sched.submit(np.array([1, 2, 3]), 6)
+    sched.step()                 # in flight -> the fence cannot drain yet
+    handle = pub.publish_async(_bump(params))
+    assert not handle.done
+
+    waiter_err = []
+
+    def waiter():
+        try:
+            handle.wait(30)
+        except PublishError as e:
+            waiter_err.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    sched.fail_inflight(RuntimeError("replica died"))
+    t.join(30)
+    assert not t.is_alive()
+    assert waiter_err and isinstance(waiter_err[0].__cause__, EngineFailed)
+    assert engine.weight_version == 0
+
+
+def test_blocking_publish_on_driving_thread_times_out(lm_and_params):
+    """The documented deadlock guard: a blocking publish from the one
+    thread that steps the scheduler can never drain its own fence — it
+    must time out with actionable advice, leaving the ticket pending."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=1, prefill_len=6,
+                           cache_len=32)
+    sched = FCFSScheduler(engine)
+    pub = WeightPublisher(engine, sched)
+    sched.submit(np.array([1, 2, 3]), 4)
+    sched.step()
+    with pytest.raises(PublishError, match="still fenced"):
+        pub.publish(_bump(params), timeout=0.2)
+    # the fence is still pending; stepping drains it and the swap lands
+    while sched.has_work:
+        sched.step()
+    assert engine.weight_version == 1

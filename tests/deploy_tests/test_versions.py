@@ -1,0 +1,62 @@
+"""VersionLog: the shared versioned-weights ledger both deploy halves
+record into (ISSUE 10). Pure host logic — no jax."""
+
+import threading
+
+from chainermn_tpu.deploy import VersionLog, WeightVersion
+
+
+def test_initial_state_is_version_zero():
+    log = VersionLog()
+    assert len(log) == 1
+    assert log.current.version == 0
+    assert log.current.source == "init"
+    assert log.current.step is None
+
+
+def test_record_appends_and_current_tracks_latest():
+    log = VersionLog()
+    log.record(1, source="publish", step=100)
+    log.record(2, source="restore", step=250)
+    assert log.current == log.history()[-1]
+    assert log.current.version == 2
+    assert log.current.source == "restore"
+    assert log.current.step == 250
+    assert [v.version for v in log.history()] == [0, 1, 2]
+    # wall_time is stamped at record time, monotone within the log
+    times = [v.wall_time for v in log.history()]
+    assert times == sorted(times)
+
+
+def test_history_is_a_snapshot_not_a_view():
+    log = VersionLog()
+    h = log.history()
+    log.record(1, source="publish")
+    assert len(h) == 1 and len(log.history()) == 2
+
+
+def test_weight_version_is_immutable():
+    v = WeightVersion(3, "publish")
+    try:
+        v.version = 4
+        raised = False
+    except AttributeError:
+        raised = True
+    assert raised
+
+
+def test_concurrent_records_all_land():
+    log = VersionLog()
+    n_threads, per = 8, 25
+
+    def worker(base):
+        for i in range(per):
+            log.record(base * per + i, source="publish")
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(log) == 1 + n_threads * per

@@ -198,6 +198,57 @@ def test_serve_lm_fleet():
     assert "affinity_hit_rate" in proc.stdout
 
 
+@pytest.mark.slow  # two more multi-second subprocess runs: full-suite only, to keep tier-1 inside its timeout
+def test_train_lm_publish_to_engine():
+    """ISSUE 10: the online train→serve loop — a live engine comes up
+    before training, the params hot-swap in mid-run through the deploy
+    version fence (a continuation sampled at each version), training
+    continues, and the engine's jit cache never grows."""
+    proc = run_example(
+        "lm/train_lm.py",
+        ["--iterations", "20", "--seq-len", "32", "--d-model", "32",
+         "--n-tokens", "20000", "--publish-to", "engine",
+         "--publish-every", "10"],
+    )
+    assert "serving v0 (initial weights)" in proc.stdout
+    assert "published v1 at iter 10" in proc.stdout
+    assert "published v2 at iter 20" in proc.stdout
+    assert "done: 20 iterations" in proc.stdout
+    assert ("publish-to engine: weight_version=2, zero recompiles "
+            "across swaps") in proc.stdout
+
+
+@pytest.mark.slow  # two more multi-second subprocess runs: full-suite only, to keep tier-1 inside its timeout
+def test_train_lm_snapshot_then_serve_resharded(tmp_path):
+    """ISSUE 10 (examples half of the acceptance): a snapshot saved while
+    training tensor-parallel at degree 2 serves at degree 4 through
+    ``serve_lm.py --reshard-from`` — elastic restore reads the manifest's
+    save-time geometry, permutes the fused-qkv layout, and the resharded
+    engine's outputs are token-exact vs solo generate()."""
+    ckpt = str(tmp_path / "snap")
+    train = run_example(
+        "lm/train_lm.py",
+        ["--iterations", "10", "--tensor-parallel", "--seq-len", "14",
+         "--max-len", "14", "--vocab", "64", "--d-model", "32",
+         "--n-heads", "8", "--n-layers", "1", "--n-tokens", "20000",
+         "--snapshot-to", ckpt],
+    )
+    assert f"snapshot -> {ckpt} (step 10, tp_degree=2)" in train.stdout
+    serve = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "4", "--slots", "2", "--max-new", "6",
+         "--prefill-len", "8", "--vocab", "64", "--d-model", "32",
+         "--layers", "1", "--heads", "8", "--tensor-parallel",
+         "--reshard-from", ckpt, "--verify-parity"],
+        n_devices=4,
+    )
+    assert ("resharded snapshot step 10: save-time tp_degree=2 -> "
+            "serving tp_degree=4") in serve.stdout
+    assert "4/4 requests served" in serve.stdout
+    assert "parity vs solo generate: OK (3 requests)" in serve.stdout
+    assert "zero recompiles" in serve.stdout
+
+
 def test_serve_lm_tensor_parallel():
     proc = run_example(
         "lm/serve_lm.py",

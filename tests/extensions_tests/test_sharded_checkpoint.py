@@ -1,6 +1,14 @@
 """ShardedCheckpointer: jax.Array pytrees round-trip with their shardings
 (ZeRO-sharded optimizer state included) — TPU extension beyond the
-reference checkpointer (SURVEY.md S5)."""
+reference checkpointer (SURVEY.md S5).
+
+Hardening (ISSUE 10): every save writes a CRC32-footered manifest sidecar
+(the ``MultiNodeCheckpointer`` idiom) that elastic restore reads for
+save-time mesh/TP geometry; a corrupt sidecar reads as *absent* (legacy
+path), never trusted; save/load I/O accepts a RetryPolicy and carries
+fault-injection cut-points."""
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -10,6 +18,7 @@ import pytest
 
 import chainermn_tpu
 from chainermn_tpu.extensions import ShardedCheckpointer
+from chainermn_tpu.resilience import FaultInjector, InjectedFault, RetryPolicy
 
 
 @pytest.fixture(scope="module")
@@ -55,3 +64,115 @@ def test_empty_dir_restores_none(comm, tmp_path):
     with ShardedCheckpointer(str(tmp_path / "none")) as cp:
         restored, step = cp.maybe_restore(x)
     assert restored is None and step is None
+
+
+# --------------------------------------------------------------------- #
+# manifest sidecar hardening (ISSUE 10)                                  #
+# --------------------------------------------------------------------- #
+
+
+def test_manifest_roundtrip_and_step_pinning(comm, tmp_path):
+    """The sidecar carries caller meta + the step it was saved at;
+    ``manifest()`` reads the newest, ``manifest(step)`` pins one, and an
+    empty checkpoint dir reports None (no snapshot, no manifest)."""
+    x = jax.device_put({"a": jnp.ones((4,))}, comm.named_sharding())
+    with ShardedCheckpointer(str(tmp_path / "m")) as cp:
+        assert cp.manifest() is None
+        cp.save(3, x, meta={"tp_degree": 2, "mesh_shape": (4, 2)})
+        cp.save(7, x, meta={"tp_degree": 1, "mesh_shape": (8, 1)})
+        assert cp.manifest() == {
+            "tp_degree": 1, "mesh_shape": (8, 1), "step": 7}
+        assert cp.manifest(3) == {
+            "tp_degree": 2, "mesh_shape": (4, 2), "step": 3}
+        # a step that was saved without meta still records its step
+        cp.save(9, x)
+        assert cp.manifest(9) == {"step": 9}
+
+
+def test_corrupt_manifest_reads_as_absent_but_state_survives(
+        comm, tmp_path):
+    """Bit-flip the sidecar payload: the CRC32 footer catches it and
+    ``manifest()`` degrades to None (the legacy same-shape path) instead
+    of returning garbage — while the orbax state itself, untouched,
+    still restores bit-exact."""
+    x = jax.device_put({"a": jnp.arange(4.0)}, comm.named_sharding())
+    path = str(tmp_path / "c")
+    with ShardedCheckpointer(path) as cp:
+        cp.save(1, x, meta={"tp_degree": 4})
+        assert cp.manifest() == {"tp_degree": 4, "step": 1}
+        mpath = os.path.join(path + ".meta", "manifest_1.bin")
+        blob = bytearray(open(mpath, "rb").read())
+        blob[2] ^= 0xFF                       # corrupt the pickled payload
+        with open(mpath, "wb") as f:
+            f.write(bytes(blob))
+        assert cp.manifest() is None
+        restored, step = cp.maybe_restore(x)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                  np.asarray(x["a"]))
+
+
+def test_missing_manifest_is_legacy_not_error(comm, tmp_path):
+    """Deleting the sidecar (a checkpoint written before manifests, or a
+    lost file) is indistinguishable from legacy: manifest() None,
+    restore unaffected."""
+    x = jax.device_put({"a": jnp.ones((2,))}, comm.named_sharding())
+    path = str(tmp_path / "lg")
+    with ShardedCheckpointer(path) as cp:
+        cp.save(1, x, meta={"anything": True})
+        os.remove(os.path.join(path + ".meta", "manifest_1.bin"))
+        assert cp.manifest() is None
+        restored, step = cp.maybe_restore(x)
+    assert step == 1 and restored is not None
+
+
+def test_manifest_gc_follows_orbax_keep(comm, tmp_path):
+    """Sidecars are pruned alongside orbax's own GC: with keep=2, only
+    the newest two manifests survive."""
+    x = jax.device_put({"a": jnp.ones((2,))}, comm.named_sharding())
+    path = str(tmp_path / "gc")
+    with ShardedCheckpointer(path, keep=2) as cp:
+        for s in (1, 2, 3, 4):
+            cp.save(s, x, meta={"s": s})
+        assert cp.all_steps() == [3, 4]
+        names = sorted(n for n in os.listdir(path + ".meta")
+                       if n.startswith("manifest_"))
+        assert names == ["manifest_3.bin", "manifest_4.bin"]
+        assert cp.manifest(3) == {"s": 3, "step": 3}
+
+
+def test_retry_policy_recovers_transient_save_and_load(comm, tmp_path):
+    """A transient fault at the save/load cut-points (times=1) is
+    absorbed by the checkpointer's RetryPolicy: both operations succeed
+    on the second attempt, and the injector's log proves each fault
+    actually fired."""
+    x = jax.device_put({"a": jnp.arange(3.0)}, comm.named_sharding())
+    cp = ShardedCheckpointer(
+        str(tmp_path / "r"),
+        retry=RetryPolicy(max_attempts=3, base_delay_s=0.001, jitter=0))
+    inj = FaultInjector()
+    inj.arm("sharded_checkpoint.save", times=1)
+    inj.arm("sharded_checkpoint.load", times=1)
+    with inj, cp:
+        cp.save(1, x, meta={"ok": 1})
+        restored, step = cp.maybe_restore(x)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                  np.asarray(x["a"]))
+    assert ("sharded_checkpoint.save", "raise") in inj.fired_log
+    assert ("sharded_checkpoint.load", "raise") in inj.fired_log
+    assert cp.manifest() == {"ok": 1, "step": 1}
+
+
+def test_fault_without_retry_policy_propagates(comm, tmp_path):
+    """No retry configured: the injected fault surfaces unchanged (a
+    shape-error-is-not-a-transient guarantee at the checkpointer level
+    too — callers decide their own policy)."""
+    x = jax.device_put({"a": jnp.ones((2,))}, comm.named_sharding())
+    inj = FaultInjector()
+    inj.arm("sharded_checkpoint.save", times=1)
+    with inj, ShardedCheckpointer(str(tmp_path / "nr")) as cp:
+        with pytest.raises(InjectedFault):
+            cp.save(1, x)
+        cp.save(2, x)                      # disarmed after times=1: fine
+        assert 2 in cp.all_steps()

@@ -1,0 +1,146 @@
+"""Fleet weight lifecycle (ISSUE 10): rolling hot-swap across replicas
+with zero lost requests, and elastic scale-up from a checkpoint.
+
+The fleet acceptance: a 2-replica router takes a publish while serving —
+every accepted request completes token-for-token on the weight version
+it was admitted under, both replicas end on the new version with zero
+recompiles, and ``spawn_replica(checkpoint=...)`` brings a third replica
+up from a snapshot (via elastic restore) without pausing the others."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.extensions.sharded_checkpoint import ShardedCheckpointer
+from chainermn_tpu.fleet import FleetRouter
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.serving import RequestState, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=1,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make_engine(lm, params, *, n_slots=2):
+    return ServingEngine(lm, params, n_slots=n_slots, prefill_len=6,
+                         cache_len=32)
+
+
+def solo(lm, params, prompt, n):
+    return np.asarray(generate(lm, params,
+                               jnp.asarray([prompt], jnp.int32), n)[0])
+
+
+def _bump(params, f=1.001):
+    return jax.tree_util.tree_map(lambda l: l * f, params)
+
+
+@pytest.mark.slow  # multi-replica warmups: full-suite only, tier-1 keeps the skip/raise cases
+def test_rolling_publish_zero_lost_requests(lm_and_params):
+    lm, params = lm_and_params
+    new_params = _bump(params)
+    want_old = {tuple(p): solo(lm, params, list(p), 5)
+                for p in [(1, 2, 3), (4, 5), (6, 7, 8), (9, 10)]}
+    want_new = {p: solo(lm, new_params, list(p), 5) for p in want_old}
+
+    with FleetRouter([make_engine(lm, params) for _ in range(2)]) as router:
+        assert router.wait_ready(300)
+        # traffic in flight across both replicas when the roll starts
+        frs = [router.submit(np.array(p, np.int32), 5) for p in want_old]
+        out = router.publish(new_params, step=42, timeout=120.0)
+        assert out["ok"] is True
+        assert set(out["replicas"]) == {"0", "1"}
+        for res in out["replicas"].values():
+            assert res["ok"] and res["version"] == 1
+
+        # nothing dropped: every pre-publish request completed, token-
+        # for-token on the weights its admission version says it ran on
+        for fr in frs:
+            assert fr.wait(timeout=120) and fr.state is RequestState.DONE
+            key = tuple(int(t) for t in fr.prompt)
+            assert fr.weight_version in (0, 1)
+            want = (want_old if fr.weight_version == 0 else want_new)[key]
+            np.testing.assert_array_equal(fr.output, want)
+
+        # post-publish traffic runs on the new weights everywhere
+        for p in want_new:
+            fr = router.submit(np.array(p, np.int32), 5)
+            assert fr.wait(timeout=120) and fr.weight_version == 1
+            np.testing.assert_array_equal(fr.output, want_new[p])
+
+        rep = router.fleet_report()
+        for r in rep["replicas"].values():
+            assert r["weight_version"] == 1
+        for r in router.replicas:
+            assert r.engine.recompiles == {}, r.engine.recompiles
+
+
+def test_publish_skips_dead_replica_and_reports(lm_and_params):
+    """One dead replica must not wedge the roll: it is skipped (reported
+    as such) and the survivor still takes the new version."""
+    lm, params = lm_and_params
+    with FleetRouter([make_engine(lm, params) for _ in range(2)],
+                     max_restarts=0) as router:
+        assert router.wait_ready(300)
+        router.replicas[0].kill(RuntimeError("chaos"))
+        deadline = 30.0
+        import time
+        t0 = time.monotonic()
+        while (router.replicas[0].accepting
+               and time.monotonic() - t0 < deadline):
+            time.sleep(0.05)
+        assert not router.replicas[0].accepting
+        out = router.publish(_bump(params), timeout=120.0)
+        assert out["ok"] is True                  # all ACCEPTING replicas ok
+        assert "skipped" in out["replicas"]["0"]
+        assert out["replicas"]["1"]["ok"]
+        assert router.replicas[1].engine.weight_version == 1
+
+
+@pytest.mark.slow  # multi-replica warmups: full-suite only, tier-1 keeps the skip/raise cases
+def test_spawn_replica_from_checkpoint(lm_and_params, tmp_path):
+    """Elastic scale-up: a snapshot restores (through elastic_restore)
+    into a brand-new replica that joins the fleet and serves parity
+    traffic, while the original replicas never pause."""
+    lm, params = lm_and_params
+    cp = ShardedCheckpointer(str(tmp_path / "ckpt"))
+    cp.save(5, {"params": params})
+
+    with FleetRouter([make_engine(lm, params)]) as router:
+        assert router.wait_ready(300)
+        template = jax.tree_util.tree_map(jnp.zeros_like, params)
+        replica = router.spawn_replica(
+            checkpoint=cp,
+            engine_factory=lambda p: make_engine(lm, p),
+            params_template=template)
+        assert replica.ready.is_set()
+        assert len(router.replicas) == 2
+        assert router.fleet_report()["capacity"] == 2
+
+        # enough traffic to hit both replicas; all token-exact
+        frs = [router.submit(np.array([1, 2, 3], np.int32), 5)
+               for _ in range(6)]
+        for fr in frs:
+            assert fr.wait(timeout=120) and fr.state is RequestState.DONE
+            np.testing.assert_array_equal(
+                fr.output, solo(lm, params, [1, 2, 3], 5))
+        served = [r.metrics.requests_completed for r in router.replicas]
+        assert served[1] > 0, served    # the spawned replica took traffic
+
+
+def test_spawn_replica_without_snapshot_raises(lm_and_params, tmp_path):
+    lm, params = lm_and_params
+    cp = ShardedCheckpointer(str(tmp_path / "empty"))
+    with FleetRouter([make_engine(lm, params)]) as router:
+        assert router.wait_ready(300)
+        with pytest.raises(RuntimeError, match="no snapshot"):
+            router.spawn_replica(
+                checkpoint=cp,
+                engine_factory=lambda p: make_engine(lm, p),
+                params_template=params)

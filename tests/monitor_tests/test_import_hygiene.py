@@ -24,6 +24,7 @@ import os
 import subprocess
 import sys
 
+import chainermn_tpu.deploy as deploy_pkg
 import chainermn_tpu.fleet as fleet_pkg
 import chainermn_tpu.monitor as monitor_pkg
 
@@ -90,3 +91,12 @@ def test_fleet_modules_never_import_extensions_at_module_level():
     serving (which pulls extensions) lazily, never at module level."""
     _run_hygiene(fleet_pkg, "chainermn_tpu.fleet",
                  ("router", "replica", "routing"))
+
+
+def test_deploy_modules_never_import_extensions_at_module_level():
+    """ISSUE 10 satellite: the deploy tier (weight lifecycle) follows the
+    fleet rule — publish/reshard pull jax, serving, and extensions lazily
+    inside functions, so ``import chainermn_tpu.deploy`` stays a pure
+    host-logic import."""
+    _run_hygiene(deploy_pkg, "chainermn_tpu.deploy",
+                 ("publish", "reshard", "versions"))

@@ -492,6 +492,46 @@ def test_fleet_quarantine_shrinks_capacity_sheds_counted(lm_and_params):
         router.close()
 
 
+def test_publish_fault_fails_commit_engine_unharmed(lm_and_params):
+    """Chaos case (ISSUE 10): a fault at the ``deploy.publish`` cut-point
+    kills the commit BEFORE any fence goes up — the publish fails loudly
+    (PublishError caused by the injected fault, counted and event-logged),
+    the engine never leaves version 0, the mid-decode request finishes
+    token-exact on the old weights, and a retried publish lands."""
+    from chainermn_tpu import monitor
+    from chainermn_tpu.deploy import PublishError, WeightPublisher
+
+    lm, params = lm_and_params
+    engine, sched = make(lm, params, n_slots=2)
+    pub = WeightPublisher(engine, sched)
+    r = sched.submit(np.array([1, 2]), 6)
+    sched.step()                             # decoding when the fault hits
+    new = jax.tree_util.tree_map(lambda l: l * 1.001, params)
+    inj = FaultInjector()
+    inj.arm("deploy.publish", kind="raise", times=1)
+    with inj:
+        with pytest.raises(PublishError) as ei:
+            pub.publish_async(new)
+    assert isinstance(ei.value.__cause__, InjectedFault)
+    assert engine.weight_version == 0        # prior version, by construction
+    sched.run_until_idle()                   # no fence was left behind
+    assert r.state is RequestState.DONE and r.weight_version == 0
+    ref = generate(lm, params, jnp.asarray([[1, 2]], jnp.int32), 6)
+    np.testing.assert_array_equal(r.output, np.asarray(ref[0]))
+    # observable through the shared telemetry spine
+    snap = monitor.snapshot()
+    fails = {k: v for k, v in snap["counters"].items()
+             if k.startswith("deploy_swap_failures_total")}
+    assert any(v > 0 for v in fails.values()), fails
+    kinds = [e["kind"] for e in monitor.get_event_log().tail(200)]
+    assert "publish_failed" in kinds
+    # the failure was transient: the disarmed retry goes through
+    h = pub.publish_async(new)
+    while not h.done:
+        sched.step()
+    assert h.wait(0) == 1 and engine.weight_version == 1
+
+
 def test_kv_append_fault_preempts_without_burning_a_restart(lm_and_params):
     """Chaos case (PR 7): an injected fault at the paged engine's lazy
     block append is contained by PREEMPTING only that slot's request —

@@ -121,6 +121,21 @@ def test_bench_serving_mode_smoke():
     assert pg["recompiles_after_warmup"] == 0
     assert pg["preemptions"] == 0
     assert pg["kv_blocks_per_request_mean"] >= 1.0
+    # ---- the ISSUE-10 hot swap (acceptance criterion) ---------------- #
+    hs = rec["hot_swap"]
+    # three publishes landed mid-stream through the version fence: every
+    # request (pre- and post-swap alike) completed, stamped with the
+    # version it was admitted under, and the jit cache never grew
+    assert hs["swaps"] == 3
+    assert hs["requests_done"] == hs["requests"] > 0
+    assert hs["versions_correct"] is True
+    assert hs["weight_version"] == 3
+    assert hs["recompiles_after_warmup"] == 0
+    # the swap cost decomposition travels with the record (commit is the
+    # device_put outside the fence; fence is drain-only)
+    assert hs["swap_total_s_p50"] > 0
+    assert hs["swap_fence_s_p50"] > 0 and hs["swap_commit_s_p50"] > 0
+    assert "throughput_dip_frac" in hs    # CPU timers are too noisy to sign
     # ---- the ISSUE-8 serving fleet (acceptance criterion) ------------ #
     fl = rec["fleet_serving"]
     # N=2 replicas at HALF the solo engine's slots each: equal total KV
@@ -143,6 +158,16 @@ def test_bench_serving_mode_smoke():
     # shared-system-prompt traffic really routed by affinity
     assert fl["affinity_hit_rate"] > 0.3, fl
     assert fl["ttft_p50_ms"] > 0 and fl["ttft_p99_ms"] >= fl["ttft_p50_ms"]
+    # rolling publish after the kill probe (ISSUE 10): the quarantined
+    # replica is skipped-and-reported, every surviving replica takes the
+    # new version, and no survivor recompiled
+    pub = fl["publish"]
+    assert pub["ok"] is True
+    assert "skipped" in pub["outcomes"]["0"]         # the kill-probe victim
+    assert pub["outcomes"]["1"]["ok"] is True
+    assert pub["outcomes"]["1"]["version"] == 1
+    assert pub["weight_versions"]["1"] == 1
+    assert pub["recompiles_after_publish_survivors"] == 0
 
 
 def _run_monitor_mode(extra_env):
