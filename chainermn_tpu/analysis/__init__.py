@@ -1,0 +1,74 @@
+"""graftlint — AST-based repo-invariant analysis.
+
+The distributed-training thesis this repo reproduces is *discipline*:
+every rank doing the same thing in the same order. By PR 10 the tree has
+ten threaded subsystems (scheduler, fleet replica supervisors, prefetcher,
+async checkpoint writer, watchdogs, the HTTP server) whose invariants —
+lock coverage, zero recompiles after warmup, no host syncs in hot loops,
+lazy-import hygiene, cut-point/metric naming — were enforced only by
+runtime tests that must happen to exercise the bad interleaving. This
+package proves them *by construction* instead: a stdlib-``ast`` pass over
+the whole tree, on every PR, with no imports of the code under analysis
+(and no jax/numpy — the analyzer itself stays a pure host-logic import,
+pinned by ``tests/monitor_tests/test_import_hygiene.py``).
+
+Five checkers ride one shared visitor framework (:mod:`.core`):
+
+``lock-discipline``
+    For classes owning a ``threading.Lock/RLock/Condition``, infer which
+    ``self._*`` attributes are ever touched under ``with self._lock`` and
+    flag accesses to the same attribute outside it (escape hatch:
+    ``# graftlint: unguarded-ok`` for single-writer / torn-read-tolerant
+    reads).
+``lock-order``
+    Cross-class lock-acquisition graph (who calls whose locking methods
+    while holding their own lock); cycles — the static shadow of an
+    ABBA deadlock — and nested non-reentrant self-acquires fail the run.
+``host-sync``
+    ``jax.device_get`` / ``.block_until_ready()`` / ``float()/np.asarray``
+    on compiled-program results inside known hot-loop bodies (decode
+    step, admission, replica drive, resilient-fit step) unless routed
+    through ``dataflow.device_fetch`` — every stray sync in the PERF.md
+    dispatch-bound regime is a measurable TPOT hit.
+``recompile-hazard``
+    The static complement to ``RecompileGuard``: ``jax.jit`` evaluated
+    inside loops/hot bodies, jit-then-call-in-one-expression, varying
+    Python scalars (``len``/``.shape``/loop vars) at non-static argument
+    positions, and traced-value branches inside jitted functions.
+``consistency`` / ``import-hygiene``
+    Every fault cut-point and metric/event name must come from the
+    central catalogs (``resilience/cutpoints.py``,
+    ``monitor/catalog.py``), follow the naming convention, and be pinned
+    by tests/docs; the static import graph enforces the lazy-import
+    rules (monitor/fleet/deploy never reach extensions — fleet/deploy
+    never reach jax/serving — at module level) that the subprocess
+    hygiene test checks dynamically.
+
+Run it: ``python -m chainermn_tpu.analysis chainermn_tpu/`` (human or
+``--json`` output, exit-code gating, fingerprint ``--baseline`` file), or
+in-process via :func:`run_analysis`. ``tests/analysis_tests/
+test_repo_clean.py`` runs the full suite over the tree as a tier-1 test,
+so the repo is lint-clean at merge.
+"""
+
+from chainermn_tpu.analysis.core import (
+    AnalysisResult,
+    Checker,
+    Finding,
+    Module,
+    Project,
+    analyze_source,
+    load_baseline,
+    run_analysis,
+)
+
+__all__ = [
+    "AnalysisResult",
+    "Checker",
+    "Finding",
+    "Module",
+    "Project",
+    "analyze_source",
+    "load_baseline",
+    "run_analysis",
+]

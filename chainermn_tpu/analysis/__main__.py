@@ -1,0 +1,79 @@
+"""``python -m chainermn_tpu.analysis`` — run graftlint from the shell.
+
+Exit codes: 0 = no gating findings, 1 = errors (or parse failures),
+2 = usage error. ``--baseline`` accepts previously recorded
+fingerprints; ``--write-baseline`` records the current findings so a
+new checker can ratchet instead of big-banging (the merged tree keeps
+the baseline empty).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from chainermn_tpu.analysis.checkers import all_checkers
+from chainermn_tpu.analysis.core import (
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m chainermn_tpu.analysis",
+        description="graftlint: AST-based repo-invariant analysis")
+    p.add_argument("paths", nargs="+",
+                   help="files or directories to analyze")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="JSON fingerprint file of accepted findings")
+    p.add_argument("--write-baseline", default=None, metavar="FILE",
+                   help="record current findings as the new baseline")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print available rule ids and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+
+    checkers = all_checkers()
+    if args.list_rules:
+        for c in checkers:
+            print(f"{c.rule}  (suppress: # graftlint: {c.suppress_token})")
+        return 0
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {c.rule for c in checkers}
+        if unknown:
+            parser.error(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        checkers = [c for c in checkers if c.rule in wanted]
+
+    baseline = load_baseline(args.baseline)
+    result = run_analysis(args.paths, checkers, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, result)
+
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        counts = result.to_json()["counts"]
+        print(f"graftlint: {counts['errors']} error(s), "
+              f"{counts['warnings']} warning(s), "
+              f"{counts['suppressed']} suppressed, "
+              f"{counts['baselined']} baselined")
+    return 1 if result.errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

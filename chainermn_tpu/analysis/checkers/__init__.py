@@ -1,0 +1,33 @@
+"""Checker registry: the five graftlint rules, in report order."""
+
+from chainermn_tpu.analysis.checkers.locks import (
+    LockDisciplineChecker,
+    LockOrderChecker,
+)
+from chainermn_tpu.analysis.checkers.hotpath import HostSyncChecker
+from chainermn_tpu.analysis.checkers.recompile import RecompileChecker
+from chainermn_tpu.analysis.checkers.imports import ImportHygieneChecker
+from chainermn_tpu.analysis.checkers.names import ConsistencyChecker
+
+
+def all_checkers() -> list:
+    """Fresh instances of every registered checker."""
+    return [
+        LockDisciplineChecker(),
+        LockOrderChecker(),
+        HostSyncChecker(),
+        RecompileChecker(),
+        ImportHygieneChecker(),
+        ConsistencyChecker(),
+    ]
+
+
+__all__ = [
+    "ConsistencyChecker",
+    "HostSyncChecker",
+    "ImportHygieneChecker",
+    "LockDisciplineChecker",
+    "LockOrderChecker",
+    "RecompileChecker",
+    "all_checkers",
+]

@@ -1,0 +1,179 @@
+"""Host-sync-in-hot-path checker.
+
+PERF.md's dispatch-bound regime means every host synchronization inside
+the per-token / per-step loops — ``jax.device_get``,
+``.block_until_ready()``, ``.item()``, ``float()/int()/np.asarray`` on a
+device value — is a measurable TPOT/step-time hit. This checker taints
+names assigned from calls of compiled-program attributes (the repo-wide
+``self._*fn`` / ``self._*fns[...]`` convention for jitted programs) and
+flags sync operations on tainted values inside the *hot set*:
+
+- built-in hot bodies: ``ServingEngine.decode_step`` /
+  ``admit_batch``, ``EngineReplica._loop``, ``ResilientTrainer.fit``;
+- any function whose ``def`` line carries ``# graftlint: hot``.
+
+The sanctioned route is ``chainermn_tpu.dataflow.device_fetch`` — it
+has one documented sync point, counts ``loss_fetch_total``, and its
+results are clean (assigning from it untaints). Escape hatch:
+``# graftlint: hot-sync-ok`` for syncs that are the *point* of the line
+(e.g. a deliberate flush before a timing fence).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from chainermn_tpu.analysis import astutil
+from chainermn_tpu.analysis.core import HOT_MARK, Checker, Finding, Project
+
+# (path suffix, qualname) pairs always treated as hot-loop bodies
+HOT_FUNCTIONS = (
+    ("serving/engine.py", "ServingEngine.decode_step"),
+    ("serving/engine.py", "ServingEngine.admit_batch"),
+    ("fleet/replica.py", "EngineReplica._loop"),
+    ("resilience/trainer.py", "ResilientTrainer.fit"),
+)
+
+# syncs that exist only to block on the device: flagged on any argument
+ALWAYS_SYNC = {"jax.device_get", "jax.block_until_ready"}
+# host coercions: flagged only when the argument is a tainted device value
+COERCIONS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+             "float", "int", "bool"}
+SYNC_METHODS = {"block_until_ready", "item"}
+
+FETCH_NAMES = {"device_fetch", "dataflow.device_fetch"}
+
+
+def _is_hot(module, func: ast.AST) -> bool:
+    qual = astutil.func_qualname(func)
+    for suffix, hot_qual in HOT_FUNCTIONS:
+        if qual == hot_qual and module.path.endswith(suffix):
+            return True
+    return HOT_MARK in module.line_tokens(func.lineno)
+
+
+class HostSyncChecker(Checker):
+    rule = "host-sync"
+    suppress_token = "hot-sync-ok"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and _is_hot(module, node):
+                    yield from self._check_hot(module, node)
+
+    # -- one hot body ---------------------------------------------------- #
+
+    def _check_hot(self, module, func: ast.AST) -> Iterator[Finding]:
+        qual = astutil.func_qualname(func)
+        tainted: set = set()
+        yield from self._walk_stmts(module, qual, func.body, tainted)
+
+    def _walk_stmts(self, module, qual, stmts, tainted
+                    ) -> Iterator[Finding]:
+        for stmt in stmts:
+            # check uses against the taint state *before* this statement's
+            # bindings take effect (x = np.asarray(x) must flag)
+            yield from self._check_exprs(module, qual, stmt, tainted)
+            self._apply_bindings(stmt, tainted)
+            for body in self._nested_bodies(stmt):
+                yield from self._walk_stmts(module, qual, body, tainted)
+
+    @staticmethod
+    def _nested_bodies(stmt) -> list:
+        out = []
+        for attr in ("body", "orelse", "finalbody"):
+            blk = getattr(stmt, attr, None)
+            if blk and isinstance(blk[0], ast.stmt):
+                out.append(blk)
+        for handler in getattr(stmt, "handlers", []) or []:
+            out.append(handler.body)
+        return out
+
+    # -- taint ----------------------------------------------------------- #
+
+    def _value_tainted(self, expr, tainted) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, (ast.Subscript, ast.Attribute, ast.Starred)):
+            return self._value_tainted(expr.value, tainted)
+        if isinstance(expr, ast.Call):
+            return self._is_compiled_call(expr)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._value_tainted(e, tainted) for e in expr.elts)
+        return False
+
+    @staticmethod
+    def _is_compiled_call(call: ast.Call) -> bool:
+        func = call.func
+        if isinstance(func, ast.Subscript):
+            func = func.value
+        attr = astutil.is_self_attr(func)
+        return attr is not None and astutil.COMPILED_ATTR_RE.match(attr) \
+            is not None
+
+    def _apply_bindings(self, stmt, tainted) -> None:
+        if not isinstance(stmt, ast.Assign):
+            return
+        value = stmt.value
+        is_fetch = (isinstance(value, ast.Call)
+                    and astutil.call_name(value.func) in FETCH_NAMES)
+        taints = (not is_fetch) and self._value_tainted(value, tainted)
+        for tgt in stmt.targets:
+            names = [n.id for n in ast.walk(tgt) if isinstance(n, ast.Name)]
+            for n in names:
+                if taints:
+                    tainted.add(n)
+                else:
+                    tainted.discard(n)
+
+    # -- sync detection --------------------------------------------------- #
+
+    def _check_exprs(self, module, qual, stmt, tainted
+                     ) -> Iterator[Finding]:
+        nested = set()
+        for body in self._nested_bodies(stmt):
+            for s in body:
+                nested.update(id(n) for n in ast.walk(s))
+        for sub in ast.walk(stmt):
+            if id(sub) in nested or not isinstance(sub, ast.Call):
+                continue
+            found = self._sync_call(module, qual, sub, tainted)
+            if found is not None:
+                yield found
+
+    def _sync_call(self, module, qual, call: ast.Call, tainted
+                   ) -> Optional[Finding]:
+        dotted = astutil.call_name(call.func)
+        if dotted in FETCH_NAMES:
+            return None
+        if dotted in ALWAYS_SYNC:
+            return self.finding(
+                module, call,
+                f"{dotted}() inside hot body {qual} — route through "
+                f"dataflow.device_fetch (one counted sync point)",
+                symbol=f"{qual}:{dotted}")
+        if dotted in COERCIONS and call.args \
+                and self._value_tainted(call.args[0], tainted):
+            return self.finding(
+                module, call,
+                f"{dotted}() on a compiled-program result inside hot "
+                f"body {qual} forces a host sync — use "
+                f"dataflow.device_fetch",
+                symbol=f"{qual}:{dotted}")
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in SYNC_METHODS \
+                and self._value_tainted(call.func.value, tainted):
+            return self.finding(
+                module, call,
+                f".{call.func.attr}() on a compiled-program result inside "
+                f"hot body {qual} forces a host sync — use "
+                f"dataflow.device_fetch",
+                symbol=f"{qual}:.{call.func.attr}")
+        return None
+
+
+__all__ = ["HOT_FUNCTIONS", "HostSyncChecker"]

@@ -1,0 +1,231 @@
+"""Recompile-hazard checker — the static complement to RecompileGuard.
+
+``RecompileGuard`` counts recompiles at runtime; this checker flags the
+call-site *shapes* that cause them, before any trace runs:
+
+- **jit-in-loop** — ``jax.jit(...)`` evaluated inside a ``for``/``while``
+  body or a hot-loop function: every evaluation makes a fresh callable
+  with an empty cache. Cache-guarded one-time builds (``if fn is None:``
+  at function scope) are fine and not flagged.
+- **jit-then-call** — ``jax.jit(f)(x)`` in one expression: the compiled
+  artifact is dropped on the floor, so every execution retraces.
+- **varying-scalar-arg** — a tracked jitted binding (``X = jax.jit(f,
+  static_argnums=...)``; module global or ``self._x``) called with a
+  Python scalar that varies across calls (``len(...)``, ``.shape`` /
+  ``.ndim`` / ``.size``, or a ``range()`` loop variable) at a position
+  *not* marked static — each distinct value is a new trace.
+- **traced-branch** (warning) — ``if`` on a parameter inside a
+  ``@jax.jit``-decorated function (parameters named in
+  ``static_argnames`` excluded): either it fails under tracing or the
+  author meant ``lax.cond``/``jnp.where``.
+
+Escape hatch: ``# graftlint: recompile-ok`` (e.g. deliberate one-time
+``jax.jit(opt.init)(params)`` at setup).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from chainermn_tpu.analysis import astutil
+from chainermn_tpu.analysis.checkers.hotpath import _is_hot
+from chainermn_tpu.analysis.core import Checker, Finding, Project
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+SHAPE_ATTRS = {"shape", "ndim", "size"}
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and astutil.call_name(node.func) in JIT_NAMES)
+
+
+def _static_positions(call: ast.Call) -> set:
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    out.add(v.value)
+    return out
+
+
+def _static_names(call: ast.Call) -> set:
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.add(v.value)
+    return out
+
+
+class RecompileChecker(Checker):
+    rule = "recompile-hazard"
+    suppress_token = "recompile-ok"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for module in project.modules:
+            yield from self._check_module(module)
+
+    def _check_module(self, module) -> Iterator[Finding]:
+        bindings: dict = {}   # key -> (node, static positions)
+        for node in ast.walk(module.tree):
+            if _is_jit_call(node):
+                yield from self._jit_site(module, node)
+            if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+                statics = _static_positions(node.value)
+                for tgt in node.targets:
+                    key = self._binding_key(tgt)
+                    if key is not None:
+                        bindings[key] = statics
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._traced_branches(module, node)
+        if bindings:
+            yield from self._varying_scalars(module, bindings)
+
+    @staticmethod
+    def _binding_key(tgt: ast.AST) -> Optional[str]:
+        attr = astutil.is_self_attr(tgt)
+        if attr is not None:
+            return f"self.{attr}"
+        if isinstance(tgt, ast.Name):
+            return tgt.id
+        return None
+
+    # -- jit evaluation sites --------------------------------------------- #
+
+    def _jit_site(self, module, node: ast.Call) -> Iterator[Finding]:
+        where = self._loop_context(module, node)
+        qual_fn = astutil.enclosing_function(node)
+        qual = astutil.func_qualname(qual_fn) if qual_fn else "<module>"
+        if where is not None:
+            yield self.finding(
+                module, node,
+                f"jax.jit evaluated inside a {where} in {qual} — every "
+                f"evaluation is a fresh callable with an empty trace "
+                f"cache; hoist it or cache the compiled fn",
+                symbol=f"{qual}:jit-in-loop")
+        elif qual_fn is not None and _is_hot(module, qual_fn):
+            yield self.finding(
+                module, node,
+                f"jax.jit evaluated inside hot body {qual} — hoist to "
+                f"setup/warmup",
+                symbol=f"{qual}:jit-in-hot")
+        parent = getattr(node, "graft_parent", None)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            yield self.finding(
+                module, node,
+                f"jax.jit(f)(...) called in one expression in {qual} — "
+                f"the compiled callable is discarded, so every execution "
+                f"retraces; bind it once",
+                symbol=f"{qual}:jit-then-call")
+
+    @staticmethod
+    def _loop_context(module, node: ast.AST) -> Optional[str]:
+        cur = getattr(node, "graft_parent", None)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if isinstance(cur, (ast.For, ast.AsyncFor)):
+                return "for loop"
+            if isinstance(cur, ast.While):
+                return "while loop"
+            cur = getattr(cur, "graft_parent", None)
+        return None
+
+    # -- varying scalars at call-sites ------------------------------------ #
+
+    def _varying_scalars(self, module, bindings: dict
+                         ) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            key = self._binding_key(node.func)
+            if key is None or key not in bindings:
+                continue
+            statics = bindings[key]
+            for i, arg in enumerate(node.args):
+                if i in statics:
+                    continue
+                why = self._varying_scalar(module, node, arg)
+                if why is None:
+                    continue
+                fn = astutil.enclosing_function(node)
+                qual = astutil.func_qualname(fn) if fn else "<module>"
+                yield self.finding(
+                    module, arg,
+                    f"jitted {key} called with {why} at positional arg "
+                    f"{i} not in static_argnums — each distinct value "
+                    f"retraces; mark it static or pass a device array",
+                    symbol=f"{qual}:{key}:arg{i}")
+
+    def _varying_scalar(self, module, call, arg) -> Optional[str]:
+        if isinstance(arg, ast.Call) \
+                and astutil.call_name(arg.func) == "len":
+            return "len(...)"
+        if isinstance(arg, ast.Attribute) and arg.attr in SHAPE_ATTRS:
+            return f".{arg.attr}"
+        if isinstance(arg, ast.Subscript) \
+                and isinstance(arg.value, ast.Attribute) \
+                and arg.value.attr in SHAPE_ATTRS:
+            return f".{arg.value.attr}[...]"
+        if isinstance(arg, ast.Name) \
+                and arg.id in self._range_vars(call):
+            return f"range-loop variable '{arg.id}'"
+        return None
+
+    @staticmethod
+    def _range_vars(node: ast.AST) -> set:
+        out: set = set()
+        cur = getattr(node, "graft_parent", None)
+        while cur is not None:
+            if isinstance(cur, ast.For) \
+                    and isinstance(cur.iter, ast.Call) \
+                    and astutil.call_name(cur.iter.func) in ("range",
+                                                             "enumerate"):
+                for n in ast.walk(cur.target):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+            cur = getattr(cur, "graft_parent", None)
+        return out
+
+    # -- traced branches inside @jax.jit bodies --------------------------- #
+
+    def _traced_branches(self, module, func) -> Iterator[Finding]:
+        jit_dec = None
+        for dec in func.decorator_list:
+            if _is_jit_call(dec) or astutil.call_name(dec) in JIT_NAMES:
+                jit_dec = dec
+                break
+        if jit_dec is None:
+            return
+        static = _static_names(jit_dec) if isinstance(jit_dec,
+                                                      ast.Call) else set()
+        params = {a.arg for a in func.args.args + func.args.kwonlyargs
+                  if a.arg not in ("self", "cls")} - static
+        if not params:
+            return
+        qual = astutil.func_qualname(func)
+        for sub in ast.walk(func):
+            if not isinstance(sub, ast.If):
+                continue
+            used = {n.id for n in ast.walk(sub.test)
+                    if isinstance(n, ast.Name)} & params
+            if used:
+                name = sorted(used)[0]
+                yield self.finding(
+                    module, sub,
+                    f"branch on traced parameter '{name}' inside jitted "
+                    f"{qual} — shape-/value-dependent control flow "
+                    f"retraces (or fails); use lax.cond/jnp.where or "
+                    f"static_argnames",
+                    symbol=f"{qual}:if-{name}",
+                    severity="warning")
+
+
+__all__ = ["RecompileChecker"]

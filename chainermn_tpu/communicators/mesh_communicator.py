@@ -29,6 +29,7 @@ from chainermn_tpu.communicators import _object_comm
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase, ReduceOp
 from chainermn_tpu.monitor import annotate
 from chainermn_tpu.parallel import mesh as mesh_lib
+from chainermn_tpu.resilience.cutpoints import COMM_ALLGATHER_OBJ, comm_point
 from chainermn_tpu.resilience.faults import inject
 
 
@@ -417,7 +418,7 @@ class MeshCommunicator(CommunicatorBase):
         # (traced collectives fuse into compiled programs and cannot host-
         # inject — a device-program failure is the engine/step boundary's
         # scenario, exercised at serving.*/trainer.step instead)
-        inject(f"comm.{opname}")
+        inject(comm_point(opname))
         leaves, treedef = jax.tree_util.tree_flatten(args)
         gsize = self._global_size
         multiproc = jax.process_count() > 1
@@ -603,7 +604,7 @@ class MeshCommunicator(CommunicatorBase):
     def allgather_obj(self, obj):
         # cut-point: the host object channel the checkpoint agreement and
         # registry aggregation ride (a raise here = a lost DCN peer)
-        inject("comm.allgather_obj")
+        inject(COMM_ALLGATHER_OBJ)
         return self._obj.allgather_obj(obj)
 
     def allreduce_obj(self, obj, reduce_func: Callable | None = None):

@@ -104,7 +104,7 @@ class WeightPublisher:
         labels = {"engine": "serving"}
         self._c_swaps = reg.counter("deploy_swaps_total", labels)
         self._c_failed = reg.counter("deploy_swap_failures_total", labels)
-        self._h_swap = reg.histogram("deploy_swap_seconds", labels)
+        self._h_swap = reg.histogram("deploy_swap_seconds", labels, unit="s")
         self._events = get_event_log()
 
     # ------------------------------------------------------------------ #
@@ -115,9 +115,10 @@ class WeightPublisher:
         thread, BEFORE the fence, so fence time is drain-only."""
         import jax
 
+        from chainermn_tpu.resilience.cutpoints import DEPLOY_PUBLISH
         from chainermn_tpu.resilience.faults import inject
 
-        inject("deploy.publish", version=self.engine.weight_version + 1)
+        inject(DEPLOY_PUBLISH, version=self.engine.weight_version + 1)
         old_leaves = jax.tree_util.tree_leaves(self.engine.params)
         new_leaves, treedef = jax.tree_util.tree_flatten(params)
         if len(old_leaves) != len(new_leaves):

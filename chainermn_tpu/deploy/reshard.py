@@ -99,6 +99,7 @@ def elastic_restore(
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from chainermn_tpu.resilience.cutpoints import DEPLOY_RESHARD
     from chainermn_tpu.resilience.faults import inject
 
     mesh = getattr(comm, "mesh", None) if comm is not None else None
@@ -115,7 +116,7 @@ def elastic_restore(
         heads = int(model.n_heads)
         dh = int(model.d_model) // int(model.n_heads)
 
-    inject("deploy.reshard", old_tp=old_tp, new_tp=new_tp)
+    inject(DEPLOY_RESHARD, old_tp=old_tp, new_tp=new_tp)
 
     if old_tp == new_tp:
         return checkpointer.maybe_restore(template, step=step)

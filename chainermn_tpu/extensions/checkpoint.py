@@ -69,6 +69,11 @@ import numpy as np
 
 from chainermn_tpu.communicators.communicator_base import CommunicatorBase
 from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.resilience.cutpoints import (
+    CHECKPOINT_LOAD,
+    CHECKPOINT_SAVE,
+    CHECKPOINT_WRITE,
+)
 from chainermn_tpu.resilience.faults import inject, torn_fraction
 
 # Footer: | payload ... | MAGIC (8B) | crc32 (4B, LE) | payload_len (8B, LE) |
@@ -198,7 +203,7 @@ class MultiNodeCheckpointer:
     def save(self, state: Any, iteration: int) -> str:
         """Snapshot this rank's ``state`` at ``iteration``; GC old ones."""
         t0 = time.time()
-        inject("checkpoint.save", iteration=int(iteration))
+        inject(CHECKPOINT_SAVE, iteration=int(iteration))
         target = self._write_snapshot(jax.device_get(state), iteration)
         dt = time.time() - t0
         self.stats["save"].append(dt)
@@ -217,7 +222,7 @@ class MultiNodeCheckpointer:
         blob = _add_footer(pickle.dumps(payload, protocol=4))
         # torn-write cut-point: a fired fault silently truncates the bytes
         # that reach disk — the data-loss case only the checksum catches
-        frac = torn_fraction("checkpoint.write", iteration=int(iteration))
+        frac = torn_fraction(CHECKPOINT_WRITE, iteration=int(iteration))
         data = blob if frac is None else blob[: int(len(blob) * frac)]
 
         def write() -> None:
@@ -225,7 +230,7 @@ class MultiNodeCheckpointer:
                 f.write(data[: len(data) // 2])
                 # mid-write cut-point: a raise here leaves a torn .tmp —
                 # the crash the atomic rename + startup sweep absorb
-                inject("checkpoint.write", iteration=int(iteration))
+                inject(CHECKPOINT_WRITE, iteration=int(iteration))
                 f.write(data[len(data) // 2:])
             os.replace(tmp, target)
 
@@ -259,7 +264,7 @@ class MultiNodeCheckpointer:
         so a restore can never race (or trust) a half-written snapshot.
         """
         self.wait_async(raise_errors=True, join=False)
-        inject("checkpoint.save", iteration=int(iteration))
+        inject(CHECKPOINT_SAVE, iteration=int(iteration))
         host_state = jax.tree_util.tree_map(_host_copy, state)
         self._ensure_writer()
         with self._async_cv:
@@ -375,7 +380,7 @@ class MultiNodeCheckpointer:
         # save — a failed one is just a missing/old snapshot to the
         # agreement below, so errors are not re-raised here
         self.wait_async(raise_errors=False)
-        inject("checkpoint.load")
+        inject(CHECKPOINT_LOAD)
         local = set(self._local_iterations())
         while True:
             all_sets = self._comm.allgather_obj(local)

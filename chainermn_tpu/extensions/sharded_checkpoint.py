@@ -41,6 +41,10 @@ from typing import Any, Optional
 import jax
 
 from chainermn_tpu.extensions.checkpoint import _add_footer, _strip_footer
+from chainermn_tpu.resilience.cutpoints import (
+    SHARDED_CHECKPOINT_LOAD,
+    SHARDED_CHECKPOINT_SAVE,
+)
 from chainermn_tpu.resilience.faults import inject
 
 
@@ -87,7 +91,7 @@ class ShardedCheckpointer:
         import orbax.checkpoint as ocp
 
         def write():
-            inject("sharded_checkpoint.save", step=step)
+            inject(SHARDED_CHECKPOINT_SAVE, step=step)
             self._mgr.save(step, args=ocp.args.StandardSave(state))
 
         self._call(write, op="sharded_checkpoint.save")
@@ -191,7 +195,7 @@ class ShardedCheckpointer:
             target = jax.tree_util.tree_map(struct, template, shardings)
 
         def load():
-            inject("sharded_checkpoint.load", step=step)
+            inject(SHARDED_CHECKPOINT_LOAD, step=step)
             return self._mgr.restore(
                 step, args=ocp.args.StandardRestore(target))
 

@@ -238,11 +238,13 @@ class EngineReplica:
             return
         finally:
             self.ready.set()
+        from chainermn_tpu.resilience.cutpoints import FLEET_REPLICA
+
         while not self._stop.is_set():
             try:
                 # the replica-level fault cut-point: a raise here models a
                 # worker-process death (not just one device call failing)
-                _inject("fleet.replica", replica=self.replica_id)
+                _inject(FLEET_REPLICA, replica=self.replica_id)
                 if self._poison is not None:
                     poison, self._poison = self._poison, None
                     raise poison

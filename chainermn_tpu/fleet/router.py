@@ -296,7 +296,8 @@ class FleetRouter:
     def close(self, timeout: float = 10.0) -> None:
         """Stop every replica thread and settle every outstanding request
         (CANCELLED) so no waiter hangs."""
-        self._closed = True
+        with self._lock:
+            self._closed = True
         for r in self.replicas:
             r.stop(timeout)
         from chainermn_tpu.serving.scheduler import RequestState
@@ -326,11 +327,11 @@ class FleetRouter:
         is accepting work."""
         from chainermn_tpu.serving.scheduler import QueueFullError
 
-        if self._closed:
-            raise RuntimeError("fleet router is closed")
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         with self._lock:
+            if self._closed:
+                raise RuntimeError("fleet router is closed")
             snaps = [r.snapshot() for r in self.replicas]
             if not any(s.healthy for s in snaps):
                 raise RuntimeError(
@@ -348,7 +349,7 @@ class FleetRouter:
             fr = FleetRequest(self, fid, prompt, max_new_tokens, rng,
                               stream_cb, deadline_s)
             t0 = time.perf_counter()
-            decision = self._route(fr.prompt, snaps)
+            decision = self._route_locked(fr.prompt, snaps)
             self._bind_locked(fr, decision, t0)
             self._requests[fid] = fr
             self._c_requests.inc()
@@ -383,7 +384,7 @@ class FleetRouter:
     # routing internals                                                   #
     # ------------------------------------------------------------------ #
 
-    def _route(self, prompt, snaps, exclude: Optional[int] = None
+    def _route_locked(self, prompt, snaps, exclude: Optional[int] = None
                ) -> RouteDecision:
         """The two-signal decision, with the ``fleet.route`` fault
         cut-point inside: an injected (or real) routing failure falls
@@ -400,8 +401,10 @@ class FleetRouter:
                           and s.replica_id != exclude]
         if not candidates:
             candidates = [s for s in snaps if s.healthy]
+        from chainermn_tpu.resilience.cutpoints import FLEET_ROUTE
+
         try:
-            _inject("fleet.route", candidates=len(candidates))
+            _inject(FLEET_ROUTE, candidates=len(candidates))
             rid, blocks = ((None, 0) if not self.affinity
                            else self._trie.lookup(prompt))
             decision = self._policy.route(candidates, rid, blocks)
@@ -531,8 +534,8 @@ class FleetRouter:
                 self._finalize_locked(fr, st, err)
                 return
             t0 = time.perf_counter()
-            decision = self._route(fr.prompt, snaps,
-                                   exclude=fr.replica_id)
+            decision = self._route_locked(fr.prompt, snaps,
+                                          exclude=fr.replica_id)
             fr.reroutes += 1
             self._c_reroutes.inc()
             try:
@@ -600,7 +603,8 @@ class FleetRouter:
                 self._finalize_locked(fr, RequestState.ERRORED, failure)
                 return
             t0 = time.perf_counter()
-            decision = self._route(fr.prompt, snaps, exclude=fr.replica_id)
+            decision = self._route_locked(fr.prompt, snaps,
+                                          exclude=fr.replica_id)
             fr.reroutes += 1
             self._c_reroutes.inc()
             try:

@@ -17,9 +17,6 @@ HLO text — ``chainermn.allreduce`` can never collide with ``all-reduce(``.
 
 from __future__ import annotations
 
-import jax
-
-
 class _Annotation:
     """Re-entrant-constructible, single-use context manager pair."""
 
@@ -31,6 +28,12 @@ class _Annotation:
         self._ns = None
 
     def __enter__(self) -> "_Annotation":
+        # lazy: monitor must stay importable without jax (fleet/deploy
+        # ride monitor at module level and are pure host-logic imports);
+        # by the time an annotation is *entered*, jax is already loaded
+        # by whatever produced the work being annotated
+        import jax
+
         try:
             tm = jax.profiler.TraceAnnotation(self._name)
             tm.__enter__()

@@ -85,7 +85,8 @@ class EventLog:
             self._ring.append(ev)
 
     def __len__(self) -> int:
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     def tail(self, n: Optional[int] = None) -> list[dict]:
         with self._lock:
@@ -132,7 +133,7 @@ class EventLog:
         evs = self.tail(last)
         print(
             f"chainermn_tpu.monitor flight recorder: last {len(evs)} "
-            f"event(s) of {len(self._ring)} retained",
+            f"event(s) of {len(self)} retained",
             file=sink,
         )
         for ev in evs:

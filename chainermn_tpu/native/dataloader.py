@@ -150,9 +150,10 @@ class NativeBatchLoader:
 
     def _assemble(self, row_idx: np.ndarray) -> np.ndarray:
         """Gather base rows -> normalized float32 images."""
+        from chainermn_tpu.resilience.cutpoints import DATALOADER_ASSEMBLE
         from chainermn_tpu.resilience.faults import inject
 
-        inject("dataloader.assemble", batch=len(row_idx))
+        inject(DATALOADER_ASSEMBLE, batch=len(row_idx))
         out = np.empty((len(row_idx),) + self._x.shape[1:], np.float32)
         if self._native:
             lib = _load()

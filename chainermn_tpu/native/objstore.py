@@ -147,9 +147,10 @@ class ObjStoreClient:
         return self._put_once(kb, value)
 
     def _put_once(self, kb: bytes, value: bytes) -> None:
+        from chainermn_tpu.resilience.cutpoints import OBJSTORE_PUT
         from chainermn_tpu.resilience.faults import inject
 
-        inject("objstore.put", key=kb.decode(), nbytes=len(value))
+        inject(OBJSTORE_PUT, key=kb.decode(), nbytes=len(value))
         buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) if value else None
         rc = self._lib.objstore_put(self._h, kb, len(kb), buf, len(value))
         if rc != 0:
@@ -163,9 +164,10 @@ class ObjStoreClient:
         return self._get_once(kb, timeout_ms)
 
     def _get_once(self, kb: bytes, timeout_ms: int) -> bytes:
+        from chainermn_tpu.resilience.cutpoints import OBJSTORE_GET
         from chainermn_tpu.resilience.faults import inject
 
-        inject("objstore.get", key=kb.decode())
+        inject(OBJSTORE_GET, key=kb.decode())
         out = ctypes.POINTER(ctypes.c_uint8)()
         n = ctypes.c_uint64(0)
         rc = self._lib.objstore_get(self._h, kb, len(kb), timeout_ms,

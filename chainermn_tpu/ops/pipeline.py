@@ -302,6 +302,7 @@ def pp_lm_opt_init(optimizer, params):
     stacked = jax.vmap(
         lambda sb: optimizer.init({**local_template, "blocks": sb})
     )(params["blocks"])
+    # graftlint: recompile-ok — one-time init trace, never re-entered
     template = jax.jit(optimizer.init)(local_template)
     flat_s = jax.tree_util.tree_flatten_with_path(stacked)[0]
     flat_t = jax.tree_util.tree_flatten_with_path(template)[0]

@@ -1,0 +1,108 @@
+"""Canonical fault-injection cut-point catalog.
+
+Every ``inject()`` / ``torn_fraction()`` site names its cut-point with a
+constant from this module — never a bare string literal. That makes the
+set of places a chaos test can break the system a *closed, greppable
+surface*: graftlint's consistency checker fails the build when a
+call-site uses a string that is not here, when a constant here has no
+call-site, when a point is not referenced by any test, or when the
+README table drifts.
+
+Naming convention: ``subsystem.site`` (lowercase, dot-separated —
+enforced statically). Dynamic families (one point per collective op)
+are built through the helper functions below and declared in
+``DYNAMIC_PREFIXES``.
+
+This module is import-light on purpose (stdlib only, no siblings);
+fleet/deploy call-sites still import it *lazily*, because reaching any
+``chainermn_tpu.resilience`` submodule executes the package
+``__init__`` and with it the jax-heavy trainer stack.
+"""
+
+from __future__ import annotations
+
+# -- checkpointing -------------------------------------------------------- #
+CHECKPOINT_SAVE = "checkpoint.save"
+CHECKPOINT_WRITE = "checkpoint.write"
+CHECKPOINT_LOAD = "checkpoint.load"
+SHARDED_CHECKPOINT_SAVE = "sharded_checkpoint.save"
+SHARDED_CHECKPOINT_LOAD = "sharded_checkpoint.load"
+
+# -- training ------------------------------------------------------------- #
+TRAINER_STEP = "trainer.step"
+DATALOADER_ASSEMBLE = "dataloader.assemble"
+OBJSTORE_PUT = "objstore.put"
+OBJSTORE_GET = "objstore.get"
+
+# -- collectives ---------------------------------------------------------- #
+COMM_ALLGATHER_OBJ = "comm.allgather_obj"
+
+# -- serving -------------------------------------------------------------- #
+SERVING_PREFILL = "serving.prefill"
+SERVING_PREFILL_BATCH = "serving.prefill_batch"
+SERVING_DECODE = "serving.decode"
+SERVING_KV_APPEND = "serving.kv_append"
+SERVING_PREFIX_COPY = "serving.prefix_copy"
+
+# -- fleet / deploy ------------------------------------------------------- #
+FLEET_ROUTE = "fleet.route"
+FLEET_REPLICA = "fleet.replica"
+DEPLOY_PUBLISH = "deploy.publish"
+DEPLOY_RESHARD = "deploy.reshard"
+
+# families of points minted at runtime (``comm.<collective-op>``); a
+# resolved point matching one of these prefixes is catalog-sanctioned
+DYNAMIC_PREFIXES = ("comm.",)
+
+
+def comm_point(op: str) -> str:
+    """Cut-point for one collective op (``comm.allreduce`` ...)."""
+    return f"comm.{op}"
+
+
+ALL_CUTPOINTS = (
+    CHECKPOINT_SAVE,
+    CHECKPOINT_WRITE,
+    CHECKPOINT_LOAD,
+    SHARDED_CHECKPOINT_SAVE,
+    SHARDED_CHECKPOINT_LOAD,
+    TRAINER_STEP,
+    DATALOADER_ASSEMBLE,
+    OBJSTORE_PUT,
+    OBJSTORE_GET,
+    COMM_ALLGATHER_OBJ,
+    SERVING_PREFILL,
+    SERVING_PREFILL_BATCH,
+    SERVING_DECODE,
+    SERVING_KV_APPEND,
+    SERVING_PREFIX_COPY,
+    FLEET_ROUTE,
+    FLEET_REPLICA,
+    DEPLOY_PUBLISH,
+    DEPLOY_RESHARD,
+)
+
+__all__ = [
+    "ALL_CUTPOINTS",
+    "CHECKPOINT_LOAD",
+    "CHECKPOINT_SAVE",
+    "CHECKPOINT_WRITE",
+    "COMM_ALLGATHER_OBJ",
+    "DATALOADER_ASSEMBLE",
+    "DEPLOY_PUBLISH",
+    "DEPLOY_RESHARD",
+    "DYNAMIC_PREFIXES",
+    "FLEET_REPLICA",
+    "FLEET_ROUTE",
+    "OBJSTORE_GET",
+    "OBJSTORE_PUT",
+    "SERVING_DECODE",
+    "SERVING_KV_APPEND",
+    "SERVING_PREFILL",
+    "SERVING_PREFILL_BATCH",
+    "SERVING_PREFIX_COPY",
+    "SHARDED_CHECKPOINT_LOAD",
+    "SHARDED_CHECKPOINT_SAVE",
+    "TRAINER_STEP",
+    "comm_point",
+]

@@ -37,6 +37,7 @@ from typing import Callable, Optional
 
 from chainermn_tpu.monitor._state import get_event_log, get_registry
 from chainermn_tpu.monitor.trace import get_tracer
+from chainermn_tpu.resilience.cutpoints import TRAINER_STEP
 from chainermn_tpu.resilience.faults import inject
 from chainermn_tpu.resilience.retry import RetryPolicy
 
@@ -181,7 +182,7 @@ class ResilientTrainer:
             with self._tracer.trace("train_step", kind="train", step=i,
                                     loop="resilient") as step_tr:
                 try:
-                    inject("trainer.step", step=i)
+                    inject(TRAINER_STEP, step=i)
                     with self._tracer.span("prefetch_wait"):
                         batch = next(iterator)
                     with self._tracer.span("dispatch"):

@@ -97,8 +97,16 @@ from chainermn_tpu.models.transformer import (
     init_kv_caches,
     init_paged_kv_caches,
 )
+from chainermn_tpu.dataflow.dispatch import device_fetch
 from chainermn_tpu.monitor import RecompileGuard, annotate
 from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.resilience.cutpoints import (
+    SERVING_DECODE,
+    SERVING_KV_APPEND,
+    SERVING_PREFILL,
+    SERVING_PREFILL_BATCH,
+    SERVING_PREFIX_COPY,
+)
 from chainermn_tpu.resilience.faults import inject
 from chainermn_tpu.serving.prefix_cache import (
     BlockPool,
@@ -917,11 +925,11 @@ class ServingEngine:
         bucket = self.bucket_for(len(prompt))
         plan = AdmitPlan(prompt=prompt, rng=rng, match=None, start=0,
                          bucket=bucket)
-        return self.admit_batch([plan], point="serving.prefill",
+        return self.admit_batch([plan], point=SERVING_PREFILL,
                                 ctx=ctx)[0]
 
     def admit_batch(self, plans: Sequence[AdmitPlan], *,
-                    point: str = "serving.prefill_batch",
+                    point: str = SERVING_PREFILL_BATCH,
                     ctx: Optional[dict] = None
                     ) -> list[tuple[int, int]]:
         """Admit a same-bucket group in ONE batched prefill call (plus one
@@ -963,7 +971,7 @@ class ServingEngine:
                 with self._watched("serving prefill", **(ctx or {})), \
                         annotate("chainermn.serving_prefill"):
                     if n_cached:
-                        inject("serving.prefix_copy", op="fetch",
+                        inject(SERVING_PREFIX_COPY, op="fetch",
                                hits=n_cached, batch=len(plans))
                     # fault cut-point INSIDE the watchdog window: an
                     # injected hang here exercises exactly the wedge hang
@@ -998,7 +1006,7 @@ class ServingEngine:
                         jnp.asarray(slot_ids), jnp.asarray(starts),
                         jnp.asarray(last), jnp.asarray(active),
                         jnp.stack(keys), *extra)
-                    firsts = np.asarray(firsts)
+                    firsts = device_fetch(firsts)
             except Exception as e:
                 if not self._state_ok():
                     raise EngineStateError(
@@ -1062,6 +1070,7 @@ class ServingEngine:
             -(-(plen + plan.max_new) // bs) - (-(-plen // bs)))
         return ids
 
+    # graftlint: hot — the paged-path body of admit_batch
     def _paged_admit(self, plans: Sequence[AdmitPlan], *, point: str,
                      ctx: Optional[dict] = None) -> list[tuple[int, int]]:
         """Paged twin of the dense ``admit_batch`` body: allocate block
@@ -1082,7 +1091,7 @@ class ServingEngine:
                 with self._watched("serving prefill", **(ctx or {})), \
                         annotate("chainermn.serving_prefill"):
                     if n_cached:
-                        inject("serving.prefix_copy", op="share",
+                        inject(SERVING_PREFIX_COPY, op="share",
                                hits=n_cached, batch=len(plans))
                     inject(point, batch=len(plans), bucket=bucket,
                            slots=slots)
@@ -1107,7 +1116,7 @@ class ServingEngine:
                         jnp.asarray(tokens), jnp.asarray(starts),
                         jnp.asarray(last), jnp.asarray(active),
                         jnp.stack(keys))
-                    firsts = np.asarray(firsts)
+                    firsts = device_fetch(firsts)
             except Exception as e:
                 for slot, ids in alloc_records:   # undo: nothing admitted
                     for block in ids:
@@ -1181,7 +1190,7 @@ class ServingEngine:
         request and retries. Carries the ``serving.kv_append`` fault
         cut-point: an injected failure here is contained by preempting
         ONLY this slot (no engine restart)."""
-        inject("serving.kv_append", slot=slot, pos=int(self._pos[slot]))
+        inject(SERVING_KV_APPEND, slot=slot, pos=int(self._pos[slot]))
         got = self.prefix_cache.alloc_blocks(1)
         if not got:
             return False
@@ -1244,7 +1253,7 @@ class ServingEngine:
         if plan is None:
             return
         try:
-            inject("serving.prefix_copy", op="insert", slot=slot,
+            inject(SERVING_PREFIX_COPY, op="insert", slot=slot,
                    blocks=len(plan.block_ids))
             ids = np.zeros((self._n_prog_blocks,), np.int32)
             ids[: len(plan.block_ids)] = plan.block_ids
@@ -1307,7 +1316,7 @@ class ServingEngine:
         # the serving watchdog exists to turn into a loud abort
         with self._watched("serving decode_step", **(ctx or {})), \
                 annotate("chainermn.serving_decode"):
-            inject("serving.decode", active=int(self._active.sum()))
+            inject(SERVING_DECODE, active=int(self._active.sum()))
             if self.paged:
                 self._store, nxt, self._keys = self._decode_fn(
                     self.params, self._store, jnp.asarray(self._tables),
@@ -1318,7 +1327,7 @@ class ServingEngine:
                     self.params, self.caches, jnp.asarray(self._token),
                     jnp.asarray(self._pos), jnp.asarray(self._active),
                     self._keys)
-            nxt = np.asarray(nxt)
+            nxt = device_fetch(nxt)
         self._c_decode_steps.inc()
         self._events.emit("decode_step", active=int(self._active.sum()))
         self._guard.check()

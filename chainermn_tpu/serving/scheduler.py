@@ -499,7 +499,10 @@ class FCFSScheduler:
             decoded = {}
         t_dec1 = time.perf_counter()
         for slot, tok in decoded.items():
-            req = self._by_slot.get(slot)
+            # dict.get is GIL-atomic and a concurrent cancel() is handled
+            # by the None check — taking _lock per token would serialize
+            # the decode loop against the submit path for nothing
+            req = self._by_slot.get(slot)  # graftlint: unguarded-ok
             if req is None:            # released mid-flight (cancelled)
                 continue
             now = time.perf_counter()
@@ -634,7 +637,8 @@ class FCFSScheduler:
         """Request/trace identity of the in-flight slots — the labels the
         engine threads into its watchdog window so a hang dump names WHO
         was decoding, not just that decode wedged."""
-        reqs = list(self._by_slot.values())
+        # GIL-atomic snapshot; labels-only consumer tolerates staleness
+        reqs = list(self._by_slot.values())  # graftlint: unguarded-ok
         if not reqs:
             return {}
         ctx = {"reqs": [r.id for r in reqs]}
@@ -790,7 +794,9 @@ class FCFSScheduler:
         the same way (only that slot's request preempts — no engine
         restart burned, every other slot keeps decoding)."""
         eng = self.engine
-        for slot in sorted(self._by_slot):
+        # drive-thread read; concurrent release is caught by the .get
+        # None check, same contract as the step() token loop
+        for slot in sorted(self._by_slot):  # graftlint: unguarded-ok
             req = self._by_slot.get(slot)
             if req is None:
                 continue

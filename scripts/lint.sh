@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# graftlint wrapper: human output to the terminal, machine-readable
+# findings recorded to LINT.json (counts per rule + every finding with
+# its fingerprint). Exit code is graftlint's: 0 clean, 1 errors.
+#
+#   scripts/lint.sh                # analyze the package
+#   scripts/lint.sh path/to.py     # analyze specific files/dirs
+#   LINT_OUT=/tmp/l.json scripts/lint.sh
+set -u
+cd "$(dirname "$0")/.."
+
+targets=("$@")
+if [ ${#targets[@]} -eq 0 ]; then
+    targets=(chainermn_tpu/)
+fi
+out="${LINT_OUT:-LINT.json}"
+
+python -m chainermn_tpu.analysis --json "${targets[@]}" > "$out"
+status=$?
+
+python -m chainermn_tpu.analysis "${targets[@]}"
+echo "findings record: $out"
+exit $status

@@ -1,0 +1,118 @@
+"""Cut-point & metric/event consistency fixtures."""
+
+from chainermn_tpu.analysis import analyze_source
+from chainermn_tpu.analysis.checkers.names import ConsistencyChecker
+
+CUTPOINTS_MOD = "chainermn_tpu.resilience.cutpoints"
+CATALOG_MOD = "chainermn_tpu.monitor.catalog"
+
+CUTPOINTS_SRC = """\
+FOO_BAR = "foo.bar"
+DYNAMIC_PREFIXES = ("comm.",)
+
+
+def comm_point(op):
+    return "comm." + op
+"""
+
+CATALOG_SRC = """\
+METRIC_NAMES = frozenset({"widget_total", "widget_seconds"})
+EVENT_KINDS = frozenset({"widget_fired"})
+"""
+
+CLEAN = """\
+from chainermn_tpu.resilience.cutpoints import FOO_BAR, comm_point
+
+def work(reg, events, op):
+    inject(FOO_BAR)
+    inject(comm_point(op))
+    reg.counter("widget_total", {}).inc()
+    reg.histogram("widget_seconds", {}, unit="s").observe(1.0)
+    events.emit("widget_fired", n=1)
+"""
+
+
+def _check(src, *, cutpoints=CUTPOINTS_SRC, catalog=CATALOG_SRC):
+    extra = {}
+    if cutpoints is not None:
+        extra[CUTPOINTS_MOD] = cutpoints
+    if catalog is not None:
+        extra[CATALOG_MOD] = catalog
+    return analyze_source(src, ConsistencyChecker(), extra_modules=extra)
+
+
+def test_consistent_module_is_clean():
+    assert _check(CLEAN) == []
+
+
+def test_bare_literal_point_fires():
+    findings = _check(CLEAN.replace("inject(FOO_BAR)",
+                                    'inject("foo.bar")'))
+    assert [f.symbol for f in findings] == ["literal:snippet:foo.bar"]
+
+
+def test_unknown_constant_fires():
+    findings = _check(CLEAN.replace("inject(FOO_BAR)",
+                                    "inject(OTHER_POINT)"))
+    symbols = [f.symbol for f in findings]
+    assert "unknown-const:snippet:OTHER_POINT" in symbols
+    # FOO_BAR now has no call-site: catalog-side drift fires too
+    assert "cutpoint:FOO_BAR" in symbols
+
+
+def test_uppercase_attribute_resolves():
+    assert _check(CLEAN.replace("inject(FOO_BAR)",
+                                "inject(cutpoints.FOO_BAR)")) == []
+
+
+def test_point_kwarg_checked_anywhere():
+    findings = _check(CLEAN + """\
+
+def admit(engine):
+    engine.admit(point="foo.nope")
+""")
+    assert [f.symbol for f in findings] == ["literal:snippet:foo.nope"]
+
+
+def test_counter_must_end_total():
+    findings = _check(CLEAN.replace('reg.counter("widget_total", {})',
+                                    'reg.counter("widget_seen", {})'))
+    symbols = {f.symbol for f in findings}
+    # convention + not-in-catalog + catalog-side unused, same name anchor
+    assert "metric:snippet:widget_seen" in symbols
+    assert any("_total" in f.message for f in findings)
+
+
+def test_seconds_requires_unit_s_histogram():
+    findings = _check(CLEAN.replace(
+        'reg.histogram("widget_seconds", {}, unit="s")',
+        'reg.histogram("widget_seconds", {})'))
+    assert any("unit='s'" in f.message for f in findings)
+
+
+def test_unknown_event_kind_fires():
+    findings = _check(CLEAN.replace('events.emit("widget_fired", n=1)',
+                                    'events.emit("widget_fired", n=1)\n'
+                                    '    events.emit("surprise", n=1)'))
+    assert [f.symbol for f in findings] == ["event:snippet:surprise"]
+
+
+def test_catalog_side_unused_metric_fires():
+    findings = _check(CLEAN.replace(
+        'reg.counter("widget_total", {}).inc()\n    ', ""))
+    assert [f.symbol for f in findings] == ["metric:widget_total"]
+    assert "never created" in findings[0].message
+
+
+def test_no_catalogs_no_literal_errors():
+    # a project without the catalog modules (e.g. a scratch tree) is not
+    # spammed about literals it has no catalog to migrate to
+    findings = _check('def go():\n    inject("foo.bar")\n',
+                      cutpoints=None, catalog=None)
+    assert findings == []
+
+
+def test_name_ok_escape():
+    src = CLEAN.replace("inject(FOO_BAR)",
+                        'inject("foo.bar")  # graftlint: name-ok')
+    assert _check(src) == []

@@ -1,0 +1,186 @@
+"""Drift pins for the two consistency catalogs.
+
+Every cut-point, metric name, and event kind is spelled out HERE as a
+literal. Adding one to the code without touching this file fails these
+asserts; conversely graftlint's consistency checker requires every
+catalog entry to be referenced by a test — this file is that reference.
+The two together make catalog changes deliberate, reviewed edits.
+"""
+
+from chainermn_tpu.monitor.catalog import EVENT_KINDS, METRIC_NAMES
+from chainermn_tpu.resilience.cutpoints import (
+    ALL_CUTPOINTS,
+    DYNAMIC_PREFIXES,
+    comm_point,
+)
+
+PINNED_CUTPOINTS = (
+    "checkpoint.save",
+    "checkpoint.write",
+    "checkpoint.load",
+    "sharded_checkpoint.save",
+    "sharded_checkpoint.load",
+    "trainer.step",
+    "dataloader.assemble",
+    "objstore.put",
+    "objstore.get",
+    "comm.allgather_obj",
+    "serving.prefill",
+    "serving.prefill_batch",
+    "serving.decode",
+    "serving.kv_append",
+    "serving.prefix_copy",
+    "fleet.route",
+    "fleet.replica",
+    "deploy.publish",
+    "deploy.reshard",
+)
+
+PINNED_METRICS = frozenset({
+    "cached_prefix_frac",
+    "checkpoint_async_errors_total",
+    "checkpoint_async_save_seconds",
+    "checkpoint_corrupt_total",
+    "checkpoint_load_seconds",
+    "checkpoint_save_seconds",
+    "deploy_swap_failures_total",
+    "deploy_swap_seconds",
+    "deploy_swaps_total",
+    "device_bytes_in_use",
+    "device_peak_bytes_in_use",
+    "dispatch_inflight",
+    "dispatch_lag_steps",
+    "faults_injected_total",
+    "fleet_affinity_hits_total",
+    "fleet_affinity_misses_total",
+    "fleet_replica_restarts_total",
+    "fleet_replica_state",
+    "fleet_requests_total",
+    "fleet_reroutes_total",
+    "fleet_route_fallbacks_total",
+    "fleet_shed_total",
+    "kv_block_appends_total",
+    "kv_blocks_free",
+    "kv_blocks_in_use",
+    "kv_blocks_per_request",
+    "kv_preemptions_total",
+    "loss_fetch_seconds",
+    "loss_fetch_total",
+    "prefetch_batches_total",
+    "prefetch_h2d_seconds",
+    "prefetch_queue_depth",
+    "prefetch_stall_seconds",
+    "prefetch_stall_total",
+    "prefill_batch_size",
+    "prefix_cache_evictions_total",
+    "prefix_cache_hits_total",
+    "prefix_cache_inserted_blocks_total",
+    "prefix_cache_misses_total",
+    "recompiles_total",
+    "retries_exhausted_total",
+    "retries_total",
+    "serving_active_slots",
+    "serving_decode_steps_total",
+    "serving_engine_restarts_total",
+    "serving_prefills_total",
+    "serving_queue_depth",
+    "serving_queue_depth_now",
+    "serving_requests_cancelled_total",
+    "serving_requests_completed_total",
+    "serving_requests_errored_total",
+    "serving_requests_rejected_total",
+    "serving_requests_shed_total",
+    "serving_requests_submitted_total",
+    "serving_scheduler_restarts_total",
+    "serving_slot_occupancy",
+    "serving_tokens_total",
+    "serving_tpot_seconds",
+    "serving_ttft_seconds",
+    "serving_weight_version",
+    "slo_breaches_total",
+    "slo_burn_rate",
+    "slo_compliant",
+    "step_time_seconds",
+    "steps_total",
+    "trace_phase_seconds",
+    "trainer_failures_total",
+    "trainer_mttr_seconds",
+    "trainer_restores_total",
+})
+
+PINNED_EVENTS = frozenset({
+    "admission_error",
+    "checkpoint_async_error",
+    "checkpoint_corrupt",
+    "checkpoint_load",
+    "checkpoint_save",
+    "checkpoint_save_async_enqueued",
+    "compile",
+    "decode_step",
+    "engine_error",
+    "engine_restart",
+    "fault_injected",
+    "first_token",
+    "fleet_publish",
+    "fleet_replica_error",
+    "fleet_replica_quarantine",
+    "fleet_route",
+    "fleet_route_fallback",
+    "fleet_shed",
+    "fleet_spawn",
+    "fleet_spawn_restore",
+    "kv_admit_defer",
+    "kv_append",
+    "kv_preempt",
+    "prefill",
+    "prefix_evict",
+    "prefix_insert",
+    "prefix_insert_error",
+    "publish",
+    "publish_failed",
+    "recompile",
+    "reject",
+    "retry",
+    "retry_exhausted",
+    "serving_warmup",
+    "shed",
+    "slo_breach",
+    "slot_admit",
+    "slot_retire",
+    "step_end",
+    "step_start",
+    "submit",
+    "swap_exec",
+    "swap_fence",
+    "trainer_failure",
+    "trainer_giving_up",
+    "trainer_recovered",
+    "trainer_restore",
+    "trainer_resume",
+    "trainer_snapshot",
+    "weight_swap",
+})
+
+
+def test_cutpoint_catalog_pinned():
+    assert ALL_CUTPOINTS == PINNED_CUTPOINTS
+
+
+def test_cutpoints_unique_and_conventional():
+    assert len(set(ALL_CUTPOINTS)) == len(ALL_CUTPOINTS)
+    for point in ALL_CUTPOINTS:
+        subsystem, _, site = point.partition(".")
+        assert subsystem and site, point
+
+
+def test_dynamic_comm_points():
+    assert DYNAMIC_PREFIXES == ("comm.",)
+    assert comm_point("allreduce") == "comm.allreduce"
+
+
+def test_metric_catalog_pinned():
+    assert METRIC_NAMES == PINNED_METRICS
+
+
+def test_event_catalog_pinned():
+    assert EVENT_KINDS == PINNED_EVENTS

@@ -1,0 +1,120 @@
+"""Framework-level behavior: parsing, escapes, baseline, CLI."""
+
+import json
+import subprocess
+import sys
+
+from chainermn_tpu.analysis import analyze_source, run_analysis
+from chainermn_tpu.analysis.checkers.locks import LockDisciplineChecker
+
+RACY = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def peek(self):
+        return self._items[-1]
+"""
+
+
+def test_fixture_fires():
+    findings = analyze_source(RACY, LockDisciplineChecker())
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "lock-discipline"
+    assert "Box._items" in f.message
+    assert f.symbol == "Box._items@peek"
+
+
+def test_inline_escape_suppresses():
+    src = RACY.replace("return self._items[-1]",
+                       "return self._items[-1]  # graftlint: unguarded-ok")
+    assert analyze_source(src, LockDisciplineChecker()) == []
+
+
+def test_escape_on_line_above_suppresses():
+    src = RACY.replace(
+        "        return self._items[-1]",
+        "        # graftlint: unguarded-ok\n        return self._items[-1]")
+    assert analyze_source(src, LockDisciplineChecker()) == []
+
+
+def test_all_ok_escape_suppresses_any_rule():
+    src = RACY.replace("return self._items[-1]",
+                       "return self._items[-1]  # graftlint: all-ok")
+    assert analyze_source(src, LockDisciplineChecker()) == []
+
+
+def test_fingerprint_stable_across_line_shifts(tmp_path):
+    f1 = analyze_source(RACY, LockDisciplineChecker())[0]
+    f2 = analyze_source("# a leading comment\n" + RACY,
+                        LockDisciplineChecker())[0]
+    assert f1.line != f2.line
+    assert f1.fingerprint == f2.fingerprint
+
+
+def test_baseline_waives(tmp_path):
+    target = tmp_path / "box.py"
+    target.write_text(RACY)
+    result = run_analysis([str(target)], [LockDisciplineChecker()])
+    assert len(result.findings) == 1
+    fps = {f.fingerprint for f in result.findings}
+    rebaselined = run_analysis([str(target)], [LockDisciplineChecker()],
+                               baseline=fps)
+    assert rebaselined.findings == []
+    assert len(rebaselined.baselined) == 1
+
+
+def test_parse_errors_always_gate(tmp_path):
+    target = tmp_path / "broken.py"
+    target.write_text("def f(:\n")
+    result = run_analysis([str(target)], [LockDisciplineChecker()])
+    assert result.errors
+    assert result.errors[0].rule == "parse-error"
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    target = tmp_path / "box.py"
+    target.write_text(RACY)
+    proc = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.analysis", "--json",
+         "--rules", "lock-discipline", str(target)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["counts"]["errors"] == 1
+    assert payload["findings"][0]["rule"] == "lock-discipline"
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.analysis", "--json",
+         "--rules", "lock-discipline", str(clean)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0
+
+
+def test_cli_write_baseline_then_clean(tmp_path):
+    target = tmp_path / "box.py"
+    target.write_text(RACY)
+    base = tmp_path / "baseline.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.analysis",
+         "--rules", "lock-discipline",
+         "--write-baseline", str(base), str(target)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1   # recording does not waive this run
+    assert json.loads(base.read_text())["fingerprints"]
+    proc = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.analysis",
+         "--rules", "lock-discipline",
+         "--baseline", str(base), str(target)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0

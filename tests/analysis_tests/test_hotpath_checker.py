@@ -1,0 +1,106 @@
+"""Host-sync-in-hot-path fixtures."""
+
+from chainermn_tpu.analysis import analyze_source
+from chainermn_tpu.analysis.checkers.hotpath import HostSyncChecker
+
+
+def _check(src, **kw):
+    return analyze_source(src, HostSyncChecker(), **kw)
+
+
+HOT_COERCION = """\
+import numpy as np
+
+class Engine:
+    def step(self):  # graftlint: hot
+        out = self._decode_fn(self._state)
+        host = np.asarray(out)
+        return host
+"""
+
+
+def test_coercion_on_compiled_result_fires():
+    findings = _check(HOT_COERCION)
+    assert [f.symbol for f in findings] == ["Engine.step:np.asarray"]
+
+
+def test_device_fetch_is_sanctioned():
+    src = HOT_COERCION.replace("np.asarray(out)", "device_fetch(out)")
+    assert _check(src) == []
+
+
+def test_device_fetch_untaints():
+    findings = _check("""\
+import numpy as np
+
+class Engine:
+    def step(self):  # graftlint: hot
+        out = self._decode_fn(self._state)
+        out = device_fetch(out)
+        host = np.asarray(out)
+        return host
+""")
+    assert findings == []
+
+
+def test_always_sync_fires_without_taint():
+    findings = _check("""\
+import jax
+
+class Engine:
+    def step(self):  # graftlint: hot
+        jax.block_until_ready(self.params)
+""")
+    assert [f.symbol for f in findings] == \
+        ["Engine.step:jax.block_until_ready"]
+
+
+def test_item_method_on_tainted_fires():
+    findings = _check("""\
+class Engine:
+    def step(self):  # graftlint: hot
+        loss = self._train_fn(self.batch)
+        return loss.item()
+""")
+    assert [f.symbol for f in findings] == ["Engine.step:.item"]
+
+
+def test_coercion_on_host_value_is_clean():
+    assert _check("""\
+import numpy as np
+
+class Engine:
+    def step(self):  # graftlint: hot
+        rows = self.queue.pop()
+        return np.asarray(rows)
+""") == []
+
+
+def test_cold_function_never_flagged():
+    src = HOT_COERCION.replace("  # graftlint: hot", "")
+    assert _check(src) == []
+
+
+def test_builtin_hot_set_by_path_and_qualname():
+    src = """\
+import numpy as np
+
+class ServingEngine:
+    def decode_step(self):
+        nxt = self._decode_fns[0](self._state)
+        return np.asarray(nxt)
+"""
+    findings = analyze_source(src, HostSyncChecker(),
+                              path="chainermn_tpu/serving/engine.py",
+                              modname="chainermn_tpu.serving.engine")
+    assert [f.symbol for f in findings] == \
+        ["ServingEngine.decode_step:np.asarray"]
+    # same source under a different path is not in the built-in hot set
+    assert _check(src) == []
+
+
+def test_hot_sync_ok_escape():
+    src = HOT_COERCION.replace(
+        "host = np.asarray(out)",
+        "host = np.asarray(out)  # graftlint: hot-sync-ok")
+    assert _check(src) == []

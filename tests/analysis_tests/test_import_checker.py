@@ -1,0 +1,72 @@
+"""Import-hygiene fixtures."""
+
+from chainermn_tpu.analysis import analyze_source
+from chainermn_tpu.analysis.checkers.imports import ImportHygieneChecker
+
+
+def _check(src, modname, extra=None):
+    return analyze_source(src, ImportHygieneChecker(),
+                          modname=modname, extra_modules=extra)
+
+
+def test_direct_forbidden_import_fires():
+    findings = _check("import jax\n", "chainermn_tpu.fleet.widget")
+    assert [f.symbol for f in findings] == \
+        ["chainermn_tpu.fleet.widget->jax"]
+
+
+def test_lazy_import_is_clean():
+    assert _check("""\
+def go():
+    import jax
+    return jax
+""", "chainermn_tpu.fleet.widget") == []
+
+
+def test_transitive_chain_fires_and_is_named():
+    findings = _check(
+        "from chainermn_tpu.monitor import helper\n",
+        "chainermn_tpu.deploy.widget",
+        extra={"chainermn_tpu.monitor.helper": "import jax\n"})
+    assert [f.symbol for f in findings] == \
+        ["chainermn_tpu.deploy.widget->jax"]
+    assert "chainermn_tpu.monitor.helper -> jax" in findings[0].message
+
+
+def test_monitor_must_not_reach_extensions():
+    findings = _check("from chainermn_tpu.extensions import profiling\n",
+                      "chainermn_tpu.monitor.widget")
+    assert [f.symbol for f in findings] == \
+        ["chainermn_tpu.monitor.widget->chainermn_tpu.extensions"]
+
+
+def test_type_checking_block_ignored():
+    assert _check("""\
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import jax
+""", "chainermn_tpu.fleet.widget") == []
+
+
+def test_analysis_must_stay_stdlib_only():
+    findings = _check("import numpy\n", "chainermn_tpu.analysis.widget")
+    assert [f.symbol for f in findings] == \
+        ["chainermn_tpu.analysis.widget->numpy"]
+    assert _check("from chainermn_tpu.analysis import core\n",
+                  "chainermn_tpu.analysis.widget") == []
+
+
+def test_unrelated_package_unconstrained():
+    assert _check("import jax\n", "chainermn_tpu.serving.widget") == []
+
+
+def test_import_ok_escape():
+    assert _check("import jax  # graftlint: import-ok\n",
+                  "chainermn_tpu.fleet.widget") == []
+
+
+def test_one_finding_per_forbidden_root():
+    findings = _check("import jax\nimport jax.numpy\n",
+                      "chainermn_tpu.fleet.widget")
+    assert len(findings) == 1

@@ -1,0 +1,240 @@
+"""Lock-discipline and lock-order fixtures."""
+
+from chainermn_tpu.analysis import analyze_source
+from chainermn_tpu.analysis.checkers.locks import (
+    LockDisciplineChecker,
+    LockOrderChecker,
+)
+
+
+def _discipline(src, **kw):
+    return analyze_source(src, LockDisciplineChecker(), **kw)
+
+
+def _order(src, **kw):
+    return analyze_source(src, LockOrderChecker(), **kw)
+
+
+# -- lock-discipline ------------------------------------------------------ #
+
+def test_unguarded_read_of_mutated_attr_fires():
+    findings = _discipline("""\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._pending[k] = v
+
+    def size(self):
+        return len(self._pending)
+""")
+    assert [f.symbol for f in findings] == ["Q._pending@size"]
+
+
+def test_unguarded_mutation_fires():
+    findings = _discipline("""\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def reset(self):
+        self._n = 0
+""")
+    assert [f.symbol for f in findings] == ["Q._n@reset"]
+
+
+def test_all_access_under_lock_is_clean():
+    assert _discipline("""\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._pending[k] = v
+
+    def size(self):
+        with self._lock:
+            return len(self._pending)
+""") == []
+
+
+def test_never_mutated_reference_is_not_guarded():
+    # a never-reassigned reference to a thread-safe object may be read
+    # inside AND outside critical sections without a finding
+    assert _discipline("""\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store = SomeThreadSafeThing()
+        self._pending = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._store.record(k)
+            self._pending[k] = v
+
+    def size(self):
+        return self._store.count()
+""") == []
+
+
+def test_locked_suffix_methods_assumed_held():
+    assert _discipline("""\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._put_locked(k, v)
+
+    def _put_locked(self, k, v):
+        self._pending[k] = v
+""") == []
+
+
+def test_mutator_method_call_counts_as_mutation():
+    findings = _discipline("""\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def flush(self):
+        self._items.clear()
+""")
+    assert [f.symbol for f in findings] == ["Q._items@flush"]
+
+
+def test_classes_without_locks_ignored():
+    assert _discipline("""\
+class Plain:
+    def __init__(self):
+        self._items = []
+
+    def put(self, x):
+        self._items.append(x)
+""") == []
+
+
+# -- lock-order ----------------------------------------------------------- #
+
+AB_CYCLE = """\
+import threading
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._b = B()
+
+    def poke(self):
+        with self._lock:
+            self._b.poke()
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._a = A()
+
+    def poke(self):
+        with self._lock:
+            self._a.poke()
+"""
+
+
+def test_abba_cycle_fires():
+    findings = _order(AB_CYCLE)
+    assert len(findings) == 1
+    assert "cycle" in findings[0].symbol
+    assert "A" in findings[0].message and "B" in findings[0].message
+
+
+def test_one_directional_order_is_clean():
+    src = AB_CYCLE.replace("""\
+    def poke(self):
+        with self._lock:
+            self._a.poke()
+""", """\
+    def poke(self):
+        with self._lock:
+            pass
+""")
+    assert _order(src) == []
+
+
+def test_nested_reacquire_of_nonreentrant_lock_fires():
+    findings = _order("""\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def work(self):
+        with self._lock:
+            with self._lock:
+                pass
+""")
+    assert [f.symbol for f in findings] == ["Q.work:self-reacquire"]
+
+
+def test_rlock_reacquire_is_clean():
+    assert _order("""\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def work(self):
+        with self._lock:
+            with self._lock:
+                pass
+""") == []
+
+
+def test_own_locking_method_under_lock_fires():
+    findings = _order("""\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def work(self):
+        with self._lock:
+            return self.size()
+""")
+    assert [f.symbol for f in findings] == ["Q.work->size"]

@@ -1,0 +1,139 @@
+"""Recompile-hazard fixtures."""
+
+from chainermn_tpu.analysis import analyze_source
+from chainermn_tpu.analysis.checkers.recompile import RecompileChecker
+
+
+def _check(src, **kw):
+    return analyze_source(src, RecompileChecker(), **kw)
+
+
+def test_jit_in_loop_fires():
+    findings = _check("""\
+import jax
+
+def run(fns, xs):
+    for f in fns:
+        step = jax.jit(f)
+        step(xs)
+""")
+    assert [f.symbol for f in findings] == ["run:jit-in-loop"]
+
+
+def test_jit_at_setup_is_clean():
+    assert _check("""\
+import jax
+
+def build(f):
+    return jax.jit(f, static_argnums=(1,))
+""") == []
+
+
+def test_jit_then_call_fires():
+    findings = _check("""\
+import jax
+
+def init(opt, params):
+    return jax.jit(opt.init)(params)
+""")
+    assert [f.symbol for f in findings] == ["init:jit-then-call"]
+
+
+def test_jit_then_call_escape():
+    assert _check("""\
+import jax
+
+def init(opt, params):
+    # graftlint: recompile-ok
+    return jax.jit(opt.init)(params)
+""") == []
+
+
+def test_jit_in_hot_body_fires():
+    findings = _check("""\
+import jax
+
+class Engine:
+    def step(self, f, x):  # graftlint: hot
+        g = jax.jit(f)
+        return g(x)
+""")
+    assert [f.symbol for f in findings] == ["Engine.step:jit-in-hot"]
+
+
+def test_varying_len_arg_fires():
+    findings = _check("""\
+import jax
+
+step = jax.jit(_step, static_argnums=(0,))
+
+def run(batch, x):
+    return step(len(batch), x, len(batch))
+""")
+    # position 0 is static; position 2 is not
+    assert [f.symbol for f in findings] == ["run:step:arg2"]
+
+
+def test_varying_shape_arg_fires():
+    findings = _check("""\
+import jax
+
+class Engine:
+    def __init__(self, f):
+        self._fn = jax.jit(f)
+
+    def run(self, x):
+        return self._fn(x.shape)
+""")
+    assert [f.symbol for f in findings] == ["Engine.run:self._fn:arg0"]
+
+
+def test_range_loop_var_arg_fires():
+    findings = _check("""\
+import jax
+
+step = jax.jit(_step)
+
+def run(x):
+    for i in range(8):
+        step(i)
+""")
+    assert [f.symbol for f in findings] == ["run:step:arg0"]
+
+
+def test_static_marked_scalar_is_clean():
+    assert _check("""\
+import jax
+
+step = jax.jit(_step, static_argnums=(0,))
+
+def run(batch, x):
+    return step(len(batch), x)
+""") == []
+
+
+def test_traced_branch_warns():
+    findings = _check("""\
+import jax
+
+@jax.jit
+def f(x, flag):
+    if flag:
+        return x
+    return -x
+""")
+    assert len(findings) == 1
+    assert findings[0].severity == "warning"
+    assert findings[0].symbol == "f:if-flag"
+
+
+def test_static_argnames_branch_is_clean():
+    assert _check("""\
+import jax
+
+@jax.jit(static_argnames=("flag",))
+def f(x, flag):
+    if flag:
+        return x
+    return -x
+""") == []

@@ -1,0 +1,38 @@
+"""The tier-1 gate: graftlint over the real tree must be clean.
+
+Runs every checker across the whole ``chainermn_tpu`` package with an
+EMPTY baseline — new invariant violations fail here, next to the code
+that introduced them, with the same output a local
+``python -m chainermn_tpu.analysis chainermn_tpu/`` run gives.
+"""
+
+import os
+
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.analysis import run_analysis
+from chainermn_tpu.analysis.checkers import all_checkers
+
+PKG_DIR = os.path.dirname(os.path.abspath(chainermn_tpu.__file__))
+
+
+@pytest.fixture(scope="module")
+def result():
+    return run_analysis([PKG_DIR], all_checkers())
+
+
+def test_tree_has_no_errors(result):
+    rendered = "\n".join(f.render() for f in result.errors)
+    assert not result.errors, f"graftlint errors:\n{rendered}"
+
+
+def test_tree_has_no_warnings(result):
+    # warnings don't gate the CLI exit code, but the merged tree keeps
+    # zero of them: every catalog name stays referenced by a test
+    rendered = "\n".join(f.render() for f in result.warnings)
+    assert not result.warnings, f"graftlint warnings:\n{rendered}"
+
+
+def test_parse_clean(result):
+    assert not result.parse_errors

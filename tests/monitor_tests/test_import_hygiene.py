@@ -24,6 +24,7 @@ import os
 import subprocess
 import sys
 
+import chainermn_tpu.analysis as analysis_pkg
 import chainermn_tpu.deploy as deploy_pkg
 import chainermn_tpu.fleet as fleet_pkg
 import chainermn_tpu.monitor as monitor_pkg
@@ -100,3 +101,55 @@ def test_deploy_modules_never_import_extensions_at_module_level():
     host-logic import."""
     _run_hygiene(deploy_pkg, "chainermn_tpu.deploy",
                  ("publish", "reshard", "versions"))
+
+
+_ANALYSIS_SCRIPT = r"""
+import glob
+import importlib
+import os
+import sys
+import types
+
+pkg_dir = sys.argv[1]
+
+stub = types.ModuleType("chainermn_tpu")
+stub.__path__ = [os.path.dirname(pkg_dir)]
+sys.modules["chainermn_tpu"] = stub
+
+modules = ["chainermn_tpu.analysis", "chainermn_tpu.analysis.checkers"]
+for sub in ("", "checkers"):
+    for p in sorted(glob.glob(os.path.join(pkg_dir, sub, "*.py"))):
+        name = os.path.splitext(os.path.basename(p))[0]
+        if name == "__init__":
+            continue
+        prefix = "chainermn_tpu.analysis" + (f".{sub}" if sub else "")
+        modules.append(f"{prefix}.{name}")
+assert any(m.endswith(".core") for m in modules), modules
+for mod in modules:
+    importlib.import_module(mod)
+    offenders = [m for m in sys.modules
+                 if (m.startswith("chainermn_tpu.")
+                     and not m.startswith("chainermn_tpu.analysis"))
+                 or m == "jax" or m == "numpy"]
+    assert not offenders, (
+        f"importing {mod} pulled in {offenders} — the analyzer must "
+        "never import the code it analyzes (stdlib-only)")
+print("clean:", len(modules), "modules")
+"""
+
+
+def test_analysis_imports_nothing_it_analyzes():
+    """ISSUE 11 satellite: graftlint stays stdlib-only — importing any
+    ``chainermn_tpu.analysis`` module (checkers included) must not pull
+    in jax, numpy, or any other chainermn_tpu package. The static
+    import-hygiene checker enforces the same rule on itself; this pins
+    it dynamically, like the monitor/fleet/deploy tests above."""
+    pkg_dir = os.path.dirname(analysis_pkg.__file__)
+    proc = subprocess.run(
+        [sys.executable, "-c", _ANALYSIS_SCRIPT, pkg_dir],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "clean:" in proc.stdout
