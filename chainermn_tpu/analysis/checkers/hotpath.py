@@ -9,7 +9,8 @@ names assigned from calls of compiled-program attributes (the repo-wide
 flags sync operations on tainted values inside the *hot set*:
 
 - built-in hot bodies: ``ServingEngine.decode_step`` /
-  ``admit_batch``, ``EngineReplica._loop``, ``ResilientTrainer.fit``;
+  ``decode_steps`` / ``spec_decode_step`` / ``admit_batch``,
+  ``EngineReplica._loop``, ``ResilientTrainer.fit``;
 - any function whose ``def`` line carries ``# graftlint: hot``.
 
 The sanctioned route is ``chainermn_tpu.dataflow.device_fetch`` — it
@@ -30,6 +31,11 @@ from chainermn_tpu.analysis.core import HOT_MARK, Checker, Finding, Project
 # (path suffix, qualname) pairs always treated as hot-loop bodies
 HOT_FUNCTIONS = (
     ("serving/engine.py", "ServingEngine.decode_step"),
+    # the multi-token rounds: the fori_loop window and the speculative
+    # draft+verify round are dispatched once per WINDOW, but a stray sync
+    # there still serializes every round — same rule as decode_step
+    ("serving/engine.py", "ServingEngine.decode_steps"),
+    ("serving/engine.py", "ServingEngine.spec_decode_step"),
     ("serving/engine.py", "ServingEngine.admit_batch"),
     ("fleet/replica.py", "EngineReplica._loop"),
     ("resilience/trainer.py", "ResilientTrainer.fit"),

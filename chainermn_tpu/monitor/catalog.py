@@ -74,6 +74,9 @@ METRIC_NAMES = frozenset({
     "serving_tpot_seconds",
     "serving_ttft_seconds",
     "serving_weight_version",
+    "spec_accept_length",
+    "spec_tokens_accepted_total",
+    "spec_tokens_proposed_total",
     # fleet / deploy
     "deploy_swap_failures_total",
     "deploy_swap_seconds",
@@ -130,6 +133,7 @@ EVENT_KINDS = frozenset({
     "shed",
     "slot_admit",
     "slot_retire",
+    "spec_rollback",
     "submit",
     "swap_fence",
     # fleet / deploy

@@ -826,6 +826,15 @@ def paged_update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
       f32 — present iff the store is int8-quantized. Each resident row
       carries one symmetric scale per head (``x ≈ x_q * scale``); writes
       quantize, the attention gather dequantizes in-program.
+    - optional ``'valid'``: ``[B]`` int32 — per-row count of *leading*
+      query positions whose K/V rows should actually land in the store.
+      Rows ``j >= valid[b]`` are redirected into the scratch block
+      (block 0) instead: the speculative verify window feeds ``k+1``
+      rows per slot but slots near their cache limit may only have
+      headroom for fewer, and without the redirect the clamped
+      ``pos // bs`` table lookup would silently overwrite a *live* row.
+      The attention itself is unaffected (the position mask already
+      hides rows beyond each query).
 
     Writes scatter the ``S`` new rows through the table
     (``store[table[b, p//bs], p%bs] = kv[b, p]``); the attention gathers
@@ -845,6 +854,13 @@ def paged_update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
     pos = pos_offset[:, None] + jnp.arange(s)[None, :]        # [B, S]
     blk = jnp.take_along_axis(table, pos // bs, axis=1).reshape(-1)
     off = (pos % bs).reshape(-1)
+    valid = kv_cache.get("valid")
+    if valid is not None:
+        # redirect rows past each sequence's valid count into the scratch
+        # block so a clamped table lookup can never clobber a live row
+        rv = (jnp.arange(s)[None, :] < valid[:, None]).reshape(-1)
+        blk = jnp.where(rv, blk, 0)
+        off = jnp.where(rv, off, 0)
 
     def write(store, scales, rows):
         rows = rows.reshape((b * s,) + rows.shape[2:])        # [B*S, H, D]

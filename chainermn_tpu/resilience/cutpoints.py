@@ -43,6 +43,7 @@ SERVING_PREFILL_BATCH = "serving.prefill_batch"
 SERVING_DECODE = "serving.decode"
 SERVING_KV_APPEND = "serving.kv_append"
 SERVING_PREFIX_COPY = "serving.prefix_copy"
+SERVING_SPEC_VERIFY = "serving.spec_verify"
 
 # -- fleet / deploy ------------------------------------------------------- #
 FLEET_ROUTE = "fleet.route"
@@ -76,6 +77,7 @@ ALL_CUTPOINTS = (
     SERVING_DECODE,
     SERVING_KV_APPEND,
     SERVING_PREFIX_COPY,
+    SERVING_SPEC_VERIFY,
     FLEET_ROUTE,
     FLEET_REPLICA,
     DEPLOY_PUBLISH,
@@ -101,6 +103,7 @@ __all__ = [
     "SERVING_PREFILL",
     "SERVING_PREFILL_BATCH",
     "SERVING_PREFIX_COPY",
+    "SERVING_SPEC_VERIFY",
     "SHARDED_CHECKPOINT_LOAD",
     "SHARDED_CHECKPOINT_SAVE",
     "TRAINER_STEP",

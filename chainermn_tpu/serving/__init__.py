@@ -64,6 +64,7 @@ from chainermn_tpu.serving.scheduler import (
     Request,
     RequestState,
 )
+from chainermn_tpu.serving.speculative import SpeculativeConfig
 
 __all__ = [
     "AdmitPlan",
@@ -80,4 +81,5 @@ __all__ = [
     "ServingClient",
     "ServingEngine",
     "ServingMetrics",
+    "SpeculativeConfig",
 ]

@@ -106,6 +106,7 @@ from chainermn_tpu.resilience.cutpoints import (
     SERVING_PREFILL,
     SERVING_PREFILL_BATCH,
     SERVING_PREFIX_COPY,
+    SERVING_SPEC_VERIFY,
 )
 from chainermn_tpu.resilience.faults import inject
 from chainermn_tpu.serving.prefix_cache import (
@@ -113,6 +114,7 @@ from chainermn_tpu.serving.prefix_cache import (
     PrefixCacheIndex,
     PrefixMatch,
 )
+from chainermn_tpu.serving.speculative import SpeculativeConfig, build_drafter
 
 
 @dataclass
@@ -210,6 +212,22 @@ class ServingEngine:
         Per-slot KV capacity (prompt + generated); defaults to
         ``model.max_len``. A request needs ``len(prompt) + max_new <=
         cache_len``.
+    speculative : SpeculativeConfig, optional
+        Paged + greedy only: draft ``k`` tokens per slot per round with
+        the configured drafter (prompt-lookup or a small draft model —
+        see :mod:`chainermn_tpu.serving.speculative`) and verify the
+        whole window in ONE target-model dispatch, committing 1..k+1
+        tokens. Token-for-token identical to the non-speculative greedy
+        stream; block-budget admission reserves ``ceil(k/block_size)``
+        extra headroom per slot for the window's worst-case writes.
+        The scheduler drives this through :meth:`decode_round`.
+    decode_window : int
+        Non-speculative dispatch amortization: ``decode_window=n > 1``
+        compiles the decode step as a ``lax.fori_loop`` over ``n``
+        tokens (ONE dispatch commits ``n`` tokens per active slot —
+        see :meth:`decode_steps`). Mutually exclusive with
+        ``speculative`` (the verify window already amortizes dispatch,
+        adaptively). Default 1, the per-token legacy program.
     temperature / top_k / top_p : sampler configuration shared by every
         request (the compiled programs bake it in, exactly like
         ``generate()``'s lru-cache key).
@@ -239,6 +257,8 @@ class ServingEngine:
                  kv_blocks: Optional[int] = None,
                  kv_block_size: int = 16,
                  kv_quant: str = "none",
+                 speculative: Optional[SpeculativeConfig] = None,
+                 decode_window: int = 1,
                  cache_len: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0, comm=None,
                  watchdog: Optional[Union[Watchdog, float]] = None):
@@ -307,6 +327,26 @@ class ServingEngine:
         self.cache_len = int(cache_len)
         self._comm = comm
         self._sample = _sampler(float(temperature), int(top_k), float(top_p))
+        self.decode_window = int(decode_window)
+        if self.decode_window < 1:
+            raise ValueError(
+                f"decode_window must be >= 1, got {decode_window}")
+        self._spec = speculative
+        if speculative is not None:
+            speculative.validate()
+            if not paged:
+                raise ValueError(
+                    "speculative decode needs paged=True — the verify "
+                    "window scatters through block tables")
+            if float(temperature) != 0.0:
+                raise ValueError(
+                    "speculative decode is greedy-only (temperature=0): "
+                    "the verify step recomputes argmax per position")
+            if self.decode_window != 1:
+                raise ValueError(
+                    "speculative= and decode_window> 1 are mutually "
+                    "exclusive — the verify window already amortizes "
+                    "dispatch (adaptively, by accept length)")
         if watchdog is not None and not isinstance(watchdog, Watchdog):
             watchdog = Watchdog(timeout=float(watchdog))
         self.watchdog = watchdog
@@ -373,6 +413,14 @@ class ServingEngine:
             # what makes block-budget admission preemption-free in the
             # no-fault case
             self._slot_reserved = np.zeros((self.n_slots,), np.int64)
+            # multi-token rounds write up to _write_horizon rows past the
+            # commit frontier (a verify window's k drafts, or a decode
+            # window's n-1 extra steps); admission reserves the matching
+            # extra block headroom so mid-round appends can't run dry
+            self._write_horizon = (speculative.k if speculative is not None
+                                   else self.decode_window - 1)
+            self._spec_headroom = -(-self._write_horizon
+                                    // self.kv_block_size)
         elif prefix_cache_blocks:
             if not 0 < prefix_block_size <= self.prefill_len:
                 raise ValueError(
@@ -427,6 +475,19 @@ class ServingEngine:
         self._guard.watch("serving_decode", self._decode_fn)
         if self.prefix_cache is not None and not self.paged:
             self._guard.watch("serving_prefix_insert", self._insert_fn)
+        if self.decode_window > 1:
+            self._guard.watch("serving_decode_window", self._window_fn)
+        # speculative drafter + its accept accounting (cumulative for
+        # spec_stats(); per-round for the scheduler's metrics drain)
+        self._drafter = None
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
+        self._last_spec_window: Optional[tuple] = None
+        if self._spec is not None:
+            self._drafter = build_drafter(self._spec, self)
+            self._guard.watch("serving_spec_verify", self._spec_fn)
+            for name, fn in self._drafter.watched_fns().items():
+                self._guard.watch(name, fn)
 
     def _fresh_keys(self):
         """Zeroed per-slot sampler keys. Under TP they are committed
@@ -620,6 +681,109 @@ class ServingEngine:
 
         return body
 
+    def _spec_verify_body(self, vocab_gather=None):
+        """Speculative verify trace: score the ``k+1``-token window
+        ``[t0, d1..dk]`` per slot at positions ``[p..p+k]`` in ONE model
+        call, returning every position's greedy (argmax) choice. The
+        host commits the longest draft prefix matching those choices
+        plus one correction token. ``valid`` caps each slot's K/V
+        writes (rows past it land in the scratch block — see
+        ``paged_update_cache_and_attend``): slots near ``cache_len``
+        would otherwise clamp their table lookup onto a LIVE row. The
+        rejected rows this window writes are garbage only until the
+        next window: its span always covers them, and every row is
+        rewritten before any query attends it."""
+        model = self.model
+        window = self._spec.k + 1
+
+        def body(params, store, table, tokens, pos, valid, active):
+            with annotate("chainermn.spec_verify"):
+                caches = [dict(layer, table=table, valid=valid)
+                          for layer in store]
+                posm = pos[:, None] + jnp.arange(window)[None, :]
+                lg, new_store = model.apply(params, tokens, posm,
+                                            kv_caches=caches)
+                if vocab_gather is not None:
+                    lg = vocab_gather(lg)
+                g = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                g = jnp.where(active[:, None], g, jnp.zeros_like(g))
+                return new_store, g
+
+        return body
+
+    def _paged_decode_steps_body(self, n: int, vocab_gather=None):
+        """Multi-token paged decode: ``n`` chained decode steps inside a
+        ``lax.fori_loop`` — ONE dispatch commits ``n`` tokens per active
+        slot (the non-speculative dispatch-amortization program; PERF.md
+        "Dispatch amortization"). Each iteration samples through the
+        same per-slot key splits as ``n`` separate decode steps, so the
+        token stream is identical to the per-token program. ``valid``
+        masks each iteration's single write for slots that crossed
+        ``cache_len`` mid-window (their later rows are discarded by the
+        scheduler's retirement anyway)."""
+        model, sample = self.model, self._sample
+        cache_len = self.cache_len
+
+        def slot_sample(lg, key):
+            nxt, key = sample(lg[None], key)
+            return nxt[0], key
+
+        def body(params, store, table, tokens, pos, active, keys):
+            with annotate("chainermn.decode"):
+                def step(i, carry):
+                    store, tok, keys, out = carry
+                    p = pos + i
+                    valid = (active & (p < cache_len)).astype(jnp.int32)
+                    caches = [dict(layer, table=table, valid=valid)
+                              for layer in store]
+                    lg, store = model.apply(params, tok[:, None],
+                                            p[:, None], kv_caches=caches)
+                    lg = lg[:, 0]
+                    if vocab_gather is not None:
+                        lg = vocab_gather(lg)
+                    nxt, keys = jax.vmap(slot_sample)(lg, keys)
+                    nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+                    return store, nxt, keys, out.at[:, i].set(nxt)
+
+                out0 = jnp.zeros((tokens.shape[0], n), jnp.int32)
+                store, _, keys, out = lax.fori_loop(
+                    0, n, step, (store, tokens, keys, out0))
+                return store, out, keys
+
+        return body
+
+    def _decode_steps_body(self, n: int, vocab_gather=None):
+        """Dense twin of :meth:`_paged_decode_steps_body`: the same
+        fori_loop over the pooled per-slot cache regions. Overshooting
+        writes clamp to a slot's own last row — stale-rows masking
+        covers them exactly like warmup garbage."""
+        model, sample = self.model, self._sample
+
+        def slot_sample(lg, key):
+            nxt, key = sample(lg[None], key)
+            return nxt[0], key
+
+        def body(params, caches, tokens, pos, active, keys):
+            with annotate("chainermn.decode"):
+                def step(i, carry):
+                    caches, tok, keys, out = carry
+                    lg, caches = model.apply(params, tok[:, None],
+                                             (pos + i)[:, None],
+                                             kv_caches=caches)
+                    lg = lg[:, 0]
+                    if vocab_gather is not None:
+                        lg = vocab_gather(lg)
+                    nxt, keys = jax.vmap(slot_sample)(lg, keys)
+                    nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+                    return caches, nxt, keys, out.at[:, i].set(nxt)
+
+                out0 = jnp.zeros((tokens.shape[0], n), jnp.int32)
+                caches, _, keys, out = lax.fori_loop(
+                    0, n, step, (caches, tokens, keys, out0))
+                return caches, out, keys
+
+        return body
+
     def _init_paged_store(self, local_heads: Optional[int] = None):
         return init_paged_kv_caches(self.model, self.kv_blocks,
                                     self.kv_block_size,
@@ -673,12 +837,23 @@ class ServingEngine:
             }
             self._decode_fn = jax.jit(self._paged_decode_body(),
                                       donate_argnums=(1,))
+            if self._spec is not None:
+                self._spec_fn = jax.jit(self._spec_verify_body(),
+                                        donate_argnums=(1,))
+            if self.decode_window > 1:
+                self._window_fn = jax.jit(
+                    self._paged_decode_steps_body(self.decode_window),
+                    donate_argnums=(1,))
             return
         self._prefill_fns = {
             b: jax.jit(self._prefill_body(b), donate_argnums=(1,))
             for b in self.prefill_buckets
         }
         self._decode_fn = jax.jit(self._decode_body(), donate_argnums=(1,))
+        if self.decode_window > 1:
+            self._window_fn = jax.jit(
+                self._decode_steps_body(self.decode_window),
+                donate_argnums=(1,))
         if self.prefix_cache is not None:
             self._insert_fn = jax.jit(self._insert_body(),
                                       donate_argnums=(0,))
@@ -740,6 +915,21 @@ class ServingEngine:
                 out_specs=(store_spec, P(), P()),
                 check_vma=False,
             ), donate_argnums=(1,))
+            if self._spec is not None:
+                self._spec_fn = jax.jit(comm.shard_map(
+                    self._spec_verify_body(gather),
+                    in_specs=(P(), store_spec, P(), P(), P(), P(), P()),
+                    out_specs=(store_spec, P()),
+                    check_vma=False,
+                ), donate_argnums=(1,))
+            if self.decode_window > 1:
+                self._window_fn = jax.jit(comm.shard_map(
+                    self._paged_decode_steps_body(self.decode_window,
+                                                  gather),
+                    in_specs=(P(), store_spec, P(), P(), P(), P(), P()),
+                    out_specs=(store_spec, P(), P()),
+                    check_vma=False,
+                ), donate_argnums=(1,))
             return
 
         cache_spec = [{"k": P(None, None, axis), "v": P(None, None, axis)}
@@ -762,6 +952,13 @@ class ServingEngine:
             out_specs=(cache_spec, P(), P()),
             check_vma=False,
         ), donate_argnums=(1,))
+        if self.decode_window > 1:
+            self._window_fn = jax.jit(comm.shard_map(
+                self._decode_steps_body(self.decode_window, gather),
+                in_specs=(P(), cache_spec, P(), P(), P(), P()),
+                out_specs=(cache_spec, P(), P()),
+                check_vma=False,
+            ), donate_argnums=(1,))
         if self.prefix_cache is not None:
             self._insert_fn = jax.jit(comm.shard_map(
                 self._insert_body(),
@@ -882,6 +1079,28 @@ class ServingEngine:
                     self.params, self._store, jnp.asarray(self._tables),
                     jnp.asarray(self._token), jnp.asarray(self._pos),
                     jnp.asarray(self._active), self._keys)
+            if self.decode_window > 1:
+                with self._watched("serving warmup decode_window"):
+                    self._store, _, _ = self._window_fn(
+                        self.params, self._store,
+                        jnp.asarray(self._tables),
+                        jnp.asarray(self._token), jnp.asarray(self._pos),
+                        jnp.asarray(self._active), self._keys)
+            if self._spec is not None:
+                # all rows inactive + valid=0: every verify-window write
+                # lands in the scratch block — the one compile covers
+                # EVERY accept length (accept is host-side bookkeeping;
+                # the program's shapes never depend on it)
+                with self._watched("serving warmup spec_verify"):
+                    self._store, _ = self._spec_fn(
+                        self.params, self._store,
+                        jnp.asarray(self._tables),
+                        jnp.zeros((self.n_slots, self._spec.k + 1),
+                                  jnp.int32),
+                        jnp.asarray(self._pos),
+                        jnp.zeros((self.n_slots,), jnp.int32),
+                        jnp.asarray(self._active))
+                self._drafter.warmup()
         else:
             extra = ()
             if self.prefix_cache is not None:
@@ -1067,7 +1286,8 @@ class ServingEngine:
         self._tables[slot, :] = 0
         self._tables[slot, : len(ids)] = ids
         self._slot_reserved[slot] = (
-            -(-(plen + plan.max_new) // bs) - (-(-plen // bs)))
+            -(-(plen + plan.max_new) // bs) - (-(-plen // bs))
+            + self._spec_headroom)
         return ids
 
     # graftlint: hot — the paged-path body of admit_batch
@@ -1148,6 +1368,8 @@ class ServingEngine:
                               cached=plan.start, batch=len(plans),
                               blocks=len(ids))
             out.append((slot, first))
+            if self._drafter is not None:
+                self._drafter.on_admit(slot, plan.prompt, first)
             # zero-copy trie insert: the slot's blocks already hold the
             # prompt's KV — adopting them IS the cache insert
             if (self.prefix_cache.missing_blocks(plan.prompt)
@@ -1163,9 +1385,13 @@ class ServingEngine:
         ``[start, prompt_len + max_new)`` (``start`` = cached-prefix
         tokens, whose blocks are shared, not allocated). The scheduler's
         block-budget admission compares this against
-        :meth:`kv_blocks_admittable`."""
+        :meth:`kv_blocks_admittable`. Multi-token rounds add
+        ``ceil(write_horizon / block_size)`` headroom: a verify window
+        writes up to ``k`` draft rows past the commit frontier, and those
+        writes must never find the pool dry mid-round."""
         bs = self.kv_block_size
-        return -(-(prompt_len + max_new) // bs) - start // bs
+        return (-(-(prompt_len + max_new) // bs) - start // bs
+                + self._spec_headroom)
 
     def kv_blocks_admittable(self) -> int:
         """Blocks an admission may claim without ever starving a decode:
@@ -1175,28 +1401,45 @@ class ServingEngine:
                 + self.prefix_cache.evictable_blocks()
                 - int(self._slot_reserved.sum()))
 
+    def _horizon_block_range(self, slot: int) -> range:
+        """Table indices the slot's next round may write: blocks covering
+        ``[pos, pos + write_horizon]`` clipped to ``cache_len``. Horizon
+        0 (the legacy per-token path) is exactly the next write's block."""
+        bs = self.kv_block_size
+        p = int(self._pos[slot])
+        if p >= self.cache_len:
+            return range(0)   # no further real writes (valid masks them)
+        hi = min(p + self._write_horizon, self.cache_len - 1)
+        return range(p // bs, hi // bs + 1)
+
     def slot_needs_block(self, slot: int) -> bool:
-        """True when the slot's NEXT decode write crosses into a block it
-        has not allocated yet (its table entry still points at scratch)."""
+        """True when a write inside the slot's next decode round crosses
+        into a block it has not allocated yet (a table entry in the
+        horizon span still points at scratch). Multi-token rounds
+        (speculative window / decode_window) widen the span checked."""
         if not self.paged or not self._active[slot]:
             return False
-        return self._tables[slot,
-                            int(self._pos[slot]) // self.kv_block_size] == 0
+        return any(self._tables[slot, i] == 0
+                   for i in self._horizon_block_range(slot))
 
     def append_block(self, slot: int) -> bool:
         """Lazily allocate the slot's next block (evicting idle trie
-        prefixes if the free list is dry). Returns False when the pool is
+        prefixes if the free list is dry) — the FIRST unallocated entry
+        in the next round's write span. Returns False when the pool is
         truly exhausted — the scheduler then preempts the lowest-priority
         request and retries. Carries the ``serving.kv_append`` fault
         cut-point: an injected failure here is contained by preempting
         ONLY this slot (no engine restart)."""
         inject(SERVING_KV_APPEND, slot=slot, pos=int(self._pos[slot]))
+        idx = next((i for i in self._horizon_block_range(slot)
+                    if self._tables[slot, i] == 0), None)
+        if idx is None:
+            return True   # span fully allocated — nothing to do
         got = self.prefix_cache.alloc_blocks(1)
         if not got:
             return False
         block = got[0]
-        self._tables[slot, int(self._pos[slot]) // self.kv_block_size] = \
-            block
+        self._tables[slot, idx] = block
         self._slot_blocks[slot].append(block)
         if self._slot_reserved[slot] > 0:
             self._slot_reserved[slot] -= 1
@@ -1340,6 +1583,169 @@ class ServingEngine:
             out[slot] = tok
         return out
 
+    def decode_steps(self, ctx: Optional[dict] = None
+                     ) -> dict[int, list[int]]:
+        """Advance every active slot ``decode_window`` tokens in ONE
+        device dispatch (the fori_loop program — PERF.md "Dispatch
+        amortization"); returns ``{slot: [tokens...]}`` in generation
+        order. The token stream is identical to ``decode_window`` calls
+        of :meth:`decode_step` (same per-slot key splits); the scheduler
+        retires mid-window and discards the tail past EOS/budget."""
+        if self.decode_window < 2:
+            raise RuntimeError(
+                "decode_steps needs ServingEngine(decode_window=n>1)")
+        if not self._active.any():
+            return {}
+        n = self.decode_window
+        with self._watched("serving decode_steps", **(ctx or {})), \
+                annotate("chainermn.serving_decode"):
+            inject(SERVING_DECODE, active=int(self._active.sum()), window=n)
+            if self.paged:
+                self._store, out, self._keys = self._window_fn(
+                    self.params, self._store, jnp.asarray(self._tables),
+                    jnp.asarray(self._token), jnp.asarray(self._pos),
+                    jnp.asarray(self._active), self._keys)
+            else:
+                self.caches, out, self._keys = self._window_fn(
+                    self.params, self.caches, jnp.asarray(self._token),
+                    jnp.asarray(self._pos), jnp.asarray(self._active),
+                    self._keys)
+            out = device_fetch(out)
+        self._c_decode_steps.inc()
+        self._events.emit("decode_step", active=int(self._active.sum()),
+                          window=n)
+        self._guard.check()
+        res = {}
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            toks = [int(t) for t in out[slot]]
+            self._token[slot] = toks[-1]
+            self._pos[slot] += n
+            res[slot] = toks
+        return res
+
+    def spec_decode_step(self, ctx: Optional[dict] = None
+                         ) -> dict[int, list[int]]:
+        """One speculative round for every active slot: draft ``k``
+        tokens per slot (host-side drafter), verify the ``k+1``-token
+        window in ONE target dispatch, and commit each slot's longest
+        matching draft prefix plus the correction token (1..k+1 tokens —
+        exactly the greedy stream, by the module's induction argument).
+        Returns ``{slot: [tokens...]}``; blocks appended for rejected
+        rows are rolled back so a mispredicted window never holds pool
+        capacity."""
+        if self._spec is None:
+            raise RuntimeError(
+                "spec_decode_step needs ServingEngine(speculative=...)")
+        if not self._active.any():
+            return {}
+        k = self._spec.k
+        drafts = self._drafter.propose(k)          # [n_slots, k] host int32
+        tokens = np.concatenate([self._token[:, None], drafts], axis=1)
+        # rows past valid land in the scratch block: a slot nearing
+        # cache_len must not let the clamped table lookup hit a live row
+        valid = np.where(self._active,
+                         np.clip(self.cache_len - self._pos, 0, k + 1),
+                         0).astype(np.int32)
+        with self._watched("serving spec_verify", **(ctx or {})), \
+                annotate("chainermn.serving_spec_verify"):
+            inject(SERVING_SPEC_VERIFY, active=int(self._active.sum()), k=k)
+            self._store, g = self._spec_fn(
+                self.params, self._store, jnp.asarray(self._tables),
+                jnp.asarray(tokens), jnp.asarray(self._pos),
+                jnp.asarray(valid), jnp.asarray(self._active))
+            g = device_fetch(g)
+        self._c_decode_steps.inc()
+        self._events.emit("decode_step", active=int(self._active.sum()),
+                          window=k + 1)
+        self._guard.check()
+        res = {}
+        proposed = accepted = 0
+        lengths = []
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            kd = min(k, int(valid[slot]) - 1)   # drafts that fit the slot
+            a = 0
+            while a < kd and int(drafts[slot, a]) == int(g[slot, a]):
+                a += 1
+            toks = [int(t) for t in drafts[slot, :a]] + [int(g[slot, a])]
+            self._token[slot] = toks[-1]
+            self._pos[slot] += len(toks)
+            self._drafter.on_commit(slot, toks)
+            self._rollback_spec_blocks(slot)
+            proposed += kd
+            accepted += a
+            lengths.append(a)
+            res[slot] = toks
+        self._spec_proposed_total += proposed
+        self._spec_accepted_total += accepted
+        self._last_spec_window = (proposed, accepted, lengths)
+        return res
+
+    def _rollback_spec_blocks(self, slot: int) -> None:
+        """Free blocks the verify window appended for rows that got
+        rejected: keep the block the slot's NEXT write lands in, free
+        every allocated entry strictly beyond it (back into the slot's
+        reserved headroom, keeping ``reserved = worst-case remaining −
+        held``). Shared prefix blocks are out of reach by construction —
+        they cover only rows ``< len(prompt) <= pos``."""
+        keep = min(int(self._pos[slot]) // self.kv_block_size + 1,
+                   self._n_max)
+        freed = 0
+        for idx in range(keep, self._n_max):
+            block = int(self._tables[slot, idx])
+            if block == 0:
+                continue
+            self._pool.decref(block)
+            self._slot_blocks[slot].remove(block)
+            self._tables[slot, idx] = 0
+            self._slot_reserved[slot] += 1
+            freed += 1
+        if freed:
+            self._events.emit("spec_rollback", slot=slot, blocks=freed,
+                              pos=int(self._pos[slot]))
+
+    def decode_round(self, ctx: Optional[dict] = None
+                     ) -> dict[int, list[int]]:
+        """One decode dispatch under whatever mode the engine was built
+        with — the scheduler's single entry point. Speculative engines
+        verify a draft window, ``decode_window`` engines run the
+        fori_loop program, and the legacy engine wraps its single token
+        in a one-element list."""
+        if self._spec is not None:
+            return self.spec_decode_step(ctx=ctx)
+        if self.decode_window > 1:
+            return self.decode_steps(ctx=ctx)
+        return {slot: [tok]
+                for slot, tok in self.decode_step(ctx=ctx).items()}
+
+    @property
+    def spec_enabled(self) -> bool:
+        return self._spec is not None
+
+    def pop_spec_window(self) -> Optional[tuple]:
+        """``(proposed, accepted, accept_lengths)`` of the last verify
+        round, cleared on read — the scheduler drains it into
+        :class:`~chainermn_tpu.serving.metrics.ServingMetrics` right
+        after delivering the round's tokens."""
+        win, self._last_spec_window = self._last_spec_window, None
+        return win
+
+    def spec_stats(self) -> dict:
+        """Cumulative speculative counters for the bench record (empty
+        dict when speculation is off)."""
+        if self._spec is None:
+            return {}
+        prop = self._spec_proposed_total
+        acc = self._spec_accepted_total
+        return {
+            "drafter": self._spec.drafter,
+            "spec_k": self._spec.k,
+            "spec_tokens_proposed": prop,
+            "spec_tokens_accepted": acc,
+            "accept_rate": (acc / prop) if prop else 0.0,
+        }
+
     def slot_tokens_used(self, slot: int) -> int:
         """Current sequence depth of a slot (prompt + generated so far)."""
         return int(self._pos[slot]) + 1 if self._active[slot] else 0
@@ -1360,6 +1766,8 @@ class ServingEngine:
             self._slot_blocks[slot] = []
             self._slot_reserved[slot] = 0
             self._tables[slot, :] = 0
+        if self._drafter is not None:
+            self._drafter.on_release(slot)
         self._active[slot] = False
         self.free_slots.add(slot)
 
@@ -1401,6 +1809,8 @@ class ServingEngine:
         self._active[:] = False
         self._keys = self._fresh_keys()
         self.free_slots = set(range(self.n_slots))
+        if self._drafter is not None:
+            self._drafter.reset()
         self._c_restarts.inc()
         self._events.emit("engine_restart")
 
@@ -1477,6 +1887,11 @@ class ServingEngine:
         out["decode"] = int(self._decode_fn._cache_size())
         if self.prefix_cache is not None and not self.paged:
             out["prefix_insert"] = int(self._insert_fn._cache_size())
+        if self._spec is not None:
+            out["spec_verify"] = int(self._spec_fn._cache_size())
+            out.update(self._drafter.compile_counts())
+        if self.decode_window > 1:
+            out["decode_window"] = int(self._window_fn._cache_size())
         return out
 
     @property

@@ -99,6 +99,14 @@ class ServingMetrics:
         self._g_kv_free = reg.gauge("kv_blocks_free", labels)
         self._c_preempt = reg.counter("kv_preemptions_total", labels)
         self._h_req_blocks = reg.histogram("kv_blocks_per_request", labels)
+        # speculative decode (PR 12): per-round accept-length histogram
+        # plus draft-economy counters — accepted/proposed IS the live
+        # accept rate the drafter choice is judged by
+        self._c_spec_proposed = reg.counter(
+            "spec_tokens_proposed_total", labels)
+        self._c_spec_accepted = reg.counter(
+            "spec_tokens_accepted_total", labels)
+        self._h_spec_accept = reg.histogram("spec_accept_length", labels)
         self._t_first_token: Optional[float] = None
         self._t_last_token: Optional[float] = None
         # EWMA TTFT (alpha=0.2): the routing layer's cheap "how slow is
@@ -175,6 +183,17 @@ class ServingMetrics:
     def record_request_blocks(self, n_blocks: int) -> None:
         """Store blocks a retiring request's table referenced."""
         self._h_req_blocks.observe(n_blocks)
+
+    def record_spec_window(self, proposed: int, accepted: int,
+                           lengths: list) -> None:
+        """One speculative verify round's accounting, drained from
+        :meth:`~chainermn_tpu.serving.engine.ServingEngine
+        .pop_spec_window`: totals feed the draft-economy counters, each
+        slot's accept length feeds the histogram."""
+        self._c_spec_proposed.inc(proposed)
+        self._c_spec_accepted.inc(accepted)
+        for a in lengths:
+            self._h_spec_accept.observe(a)
 
     def record_trace(self, req_id: int, breakdown: dict) -> None:
         """One retired request's span-tree breakdown (built by
@@ -329,6 +348,16 @@ class ServingMetrics:
             out["kv_preemptions"] = int(self._c_preempt.value)
             out["kv_blocks_in_use"] = int(self._g_kv_used.value)
             out["kv_blocks_free"] = int(self._g_kv_free.value)
+        spec_prop = int(self._c_spec_proposed.value)
+        if spec_prop:   # speculative engines only
+            spec_acc = int(self._c_spec_accepted.value)
+            out["spec_tokens_proposed"] = spec_prop
+            out["spec_tokens_accepted"] = spec_acc
+            out["spec_accept_rate"] = round(spec_acc / spec_prop, 4)
+            accept = self._h_spec_accept.samples
+            if accept:
+                t = np.asarray(accept, np.float64)
+                out["spec_accept_length_mean"] = round(float(t.mean()), 3)
         if self._worst_trace is not None:
             # the slowest traced request's full phase attribution — the
             # compact "where the p99 TTFT went" answer, per trace

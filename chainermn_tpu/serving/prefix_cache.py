@@ -278,6 +278,45 @@ class PrefixCacheIndex:
             node, i = child, i + 1
         return total - i
 
+    def ngram_continuation(self, tokens, k: int) -> Optional[list]:
+        """Model-free continuation probe for the speculative n-gram
+        drafter: if ``tokens`` walks the trie cleanly — every full block
+        present, and the ragged tail a prefix of exactly ONE child key —
+        propose up to ``k`` of the tokens a cached prompt says come next
+        (the tail key's remainder, then deeper blocks while the path
+        stays unambiguous). Returns ``None`` when the trie has no
+        unambiguous opinion.
+
+        Read-only on purpose: no pins, no LRU touch, no hit/miss
+        counting — a probe must never change eviction order or skew the
+        admission-path hit rate. Staleness is harmless: the result is a
+        *draft*, and the target-model verify step rejects anything the
+        real distribution disagrees with."""
+        if k <= 0:
+            return None
+        tokens = np.asarray(tokens, np.int64).reshape(-1)
+        bs = self.block_size
+        node = self._root
+        for i in range(len(tokens) // bs):
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                return None
+            node = child
+        tail = tuple(int(t) for t in tokens[(len(tokens) // bs) * bs:])
+        out: list = []
+        if tail:
+            matches = [key for key in node.children
+                       if key[: len(tail)] == tail]
+            if len(matches) != 1:
+                return None
+            key = matches[0]
+            out.extend(key[len(tail):])
+            node = node.children[key]
+        while len(out) < k and len(node.children) == 1:
+            (key, node), = node.children.items()
+            out.extend(key)
+        return out[:k] if out else None
+
     def release(self, match: PrefixMatch) -> None:
         """Unpin a match (idempotent) — its blocks become evictable again
         once no other holder pins them."""

@@ -489,31 +489,41 @@ class FCFSScheduler:
         # priority request when the pool runs dry
         if getattr(self.engine, "paged", False):
             self._ensure_decode_blocks()
-        # 2. decode: every active slot, one token, one compiled call
+        # 2. decode: every active slot, one compiled call — one token per
+        # slot on the legacy path, up to k+1 (speculative) / decode_window
+        # tokens per slot on the multi-token rounds
         t_dec0 = time.perf_counter()
         try:
-            decoded = self.engine.decode_step(ctx=self._flight_ctx())
+            decoded = self.engine.decode_round(ctx=self._flight_ctx())
         except Exception as e:  # noqa: BLE001 — degradation boundary
             if not self._engine_failure(e):
                 raise
             decoded = {}
         t_dec1 = time.perf_counter()
-        for slot, tok in decoded.items():
-            # dict.get is GIL-atomic and a concurrent cancel() is handled
-            # by the None check — taking _lock per token would serialize
-            # the decode loop against the submit path for nothing
-            req = self._by_slot.get(slot)  # graftlint: unguarded-ok
-            if req is None:            # released mid-flight (cancelled)
-                continue
-            now = time.perf_counter()
-            self.metrics.record_token(req.t_last_token, now)
-            # the shared decode call, attributed to every participant:
-            # one decode_step span per request per step (token index in
-            # the labels), bounded by the trace's span cap
-            req.trace.add_span("decode_step", t_dec0, t_dec1,
-                               token=len(req.tokens))
-            self._deliver(req, tok, now)
-            emitted += 1
+        for slot, toks in decoded.items():
+            for tok in toks:
+                # dict.get is GIL-atomic and a concurrent cancel() is
+                # handled by the None check — taking _lock per token would
+                # serialize the decode loop against the submit path for
+                # nothing. Re-fetched per token: EOS/length retirement can
+                # fire MID-window, and the window's tail past it must be
+                # dropped, not delivered to the next slot tenant.
+                req = self._by_slot.get(slot)  # graftlint: unguarded-ok
+                if req is None or req.finished:
+                    break              # released / retired mid-window
+                now = time.perf_counter()
+                self.metrics.record_token(req.t_last_token, now)
+                # the shared decode call, attributed to every participant:
+                # one decode_step span per request per step (token index in
+                # the labels), bounded by the trace's span cap
+                req.trace.add_span("decode_step", t_dec0, t_dec1,
+                                   token=len(req.tokens))
+                self._deliver(req, tok, now)
+                emitted += 1
+        if getattr(self.engine, "spec_enabled", False):
+            window = self.engine.pop_spec_window()
+            if window is not None:
+                self.metrics.record_spec_window(*window)
         # deferred prefix-cache inserts run AFTER this step's tokens were
         # delivered (off the TTFT path) and before the next step can
         # reuse a donor slot
@@ -808,7 +818,9 @@ class FCFSScheduler:
                                               f"{type(e).__name__}")
                     break
                 if appended:
-                    break
+                    # re-check: a multi-token round (speculative window /
+                    # decode_window) can span MORE than one new block
+                    continue
                 victim = max(self._by_slot.values(), key=lambda r: r.id)
                 self._preempt(victim, reason="kv_pool_dry")
                 if victim is req:

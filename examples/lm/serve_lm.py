@@ -49,6 +49,15 @@ states, affinity hit rate, fleet-pooled TTFT percentiles) prints at the
 end, and ``--verify-parity`` checks the first few outputs token-for-token
 against solo ``generate()``.
 
+And speculative decode (PR 12): ``--speculate ngram`` drafts k tokens per
+round from the request's own prefix (prompt-lookup n-grams — no second
+model) and verifies them in ONE target call, committing every leading
+match plus the free correction token; ``--speculate draft`` drafts with a
+small TransformerLM instead. Greedy-only (``--temperature 0``) and paged
+(``--paged-kv``): accepted tokens commit straight into shared block-store
+blocks, rejected rows roll back. ``--spec-k`` sets the draft window; the
+accept rate and proposed/accepted totals print at the end.
+
 And the weight lifecycle (ISSUE 10): ``--reshard-from <dir>`` restores
 the serving params from a ``ShardedCheckpointer`` snapshot directory
 through ``deploy.elastic_restore`` — a snapshot saved while training at
@@ -76,6 +85,11 @@ Run (CPU mesh; any accelerator works the same)::
     # tensor-parallel decode through the same scheduler:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/lm/serve_lm.py --tensor-parallel
+
+    # speculative decode on the paged store (prompt-lookup drafting):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/lm/serve_lm.py --paged-kv --temperature 0 \
+        --speculate ngram --spec-k 4
 """
 
 from __future__ import annotations
@@ -145,6 +159,18 @@ def main() -> None:
                     help="paged: int8-quantize resident blocks (per-row "
                          "per-head scales, ~2x less KV memory; small "
                          "tested logit perturbation)")
+    ap.add_argument("--speculate", choices=("off", "ngram", "draft"),
+                    default="off",
+                    help="speculative decode on the paged store: draft k "
+                         "tokens per round (ngram: prompt-lookup from the "
+                         "request's own prefix, no second model; draft: a "
+                         "small draft TransformerLM), verify them in ONE "
+                         "target call, commit every leading match + the "
+                         "correction token. Needs --paged-kv and "
+                         "--temperature 0 (greedy-only)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculative draft window: tokens proposed per "
+                         "verify round")
     ap.add_argument("--replicas", type=int, default=1,
                     help="run this many engine replicas behind the fleet "
                          "router (1: the plain single-engine client)")
@@ -266,7 +292,31 @@ def main() -> None:
             raise SystemExit("--paged-kv unifies the prefix cache onto the "
                              "shared block store; drop --prefix-blocks and "
                              "size it with --kv-blocks/--kv-block-size")
+    spec_cfg = None
+    if args.speculate != "off":
+        from chainermn_tpu.serving import SpeculativeConfig
+
+        if not args.paged_kv:
+            raise SystemExit("--speculate commits accepted tokens into "
+                             "shared block-store blocks; add --paged-kv")
+        if args.temperature != 0.0:
+            raise SystemExit("--speculate verifies drafts against the "
+                             "greedy argmax; pass --temperature 0")
+        if args.speculate == "draft":
+            draft_model = TransformerLM(
+                vocab_size=args.vocab, d_model=max(16, args.d_model // 2),
+                n_heads=max(1, args.heads // 2), n_layers=1,
+                max_len=args.prefill_len + args.max_new,
+            )
+            draft_params = draft_model.init(jax.random.PRNGKey(2),
+                                            init_tok)
+            spec_cfg = SpeculativeConfig(k=args.spec_k, drafter="draft",
+                                         draft_model=draft_model,
+                                         draft_params=draft_params)
+        else:
+            spec_cfg = SpeculativeConfig(k=args.spec_k)
     engine_kw = dict(
+        speculative=spec_cfg,
         n_slots=args.slots, prefill_len=args.prefill_len,
         prefill_buckets=buckets, prefill_batch=args.prefill_batch,
         prefix_cache_blocks=args.prefix_blocks,
@@ -405,6 +455,9 @@ def main() -> None:
         if engine.paged:
             print("paged KV: " + ", ".join(
                 f"{k}={v}" for k, v in engine.kv_stats().items()))
+        if engine.spec_enabled:
+            print("speculative: " + ", ".join(
+                f"{k}={v}" for k, v in engine.spec_stats().items()))
         print(f"engine executables: {engine.compile_counts_detailed()} "
               "(zero recompiles after warmup)")
     if slo_engine is not None:

@@ -30,6 +30,7 @@ PINNED_CUTPOINTS = (
     "serving.decode",
     "serving.kv_append",
     "serving.prefix_copy",
+    "serving.spec_verify",
     "fleet.route",
     "fleet.replica",
     "deploy.publish",
@@ -100,6 +101,9 @@ PINNED_METRICS = frozenset({
     "slo_breaches_total",
     "slo_burn_rate",
     "slo_compliant",
+    "spec_accept_length",
+    "spec_tokens_accepted_total",
+    "spec_tokens_proposed_total",
     "step_time_seconds",
     "steps_total",
     "trace_phase_seconds",
@@ -147,6 +151,7 @@ PINNED_EVENTS = frozenset({
     "slo_breach",
     "slot_admit",
     "slot_retire",
+    "spec_rollback",
     "step_end",
     "step_start",
     "submit",

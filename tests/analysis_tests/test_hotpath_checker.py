@@ -99,6 +99,29 @@ class ServingEngine:
     assert _check(src) == []
 
 
+def test_multi_token_rounds_in_builtin_hot_set():
+    # the round-12 multi-token bodies are hot: a stray sync there
+    # serializes every decode window / speculative round
+    from chainermn_tpu.analysis.checkers.hotpath import HOT_FUNCTIONS
+    hot = {qual for _, qual in HOT_FUNCTIONS}
+    assert "ServingEngine.decode_steps" in hot
+    assert "ServingEngine.spec_decode_step" in hot
+
+    src = """\
+import numpy as np
+
+class ServingEngine:
+    def spec_decode_step(self):
+        verdict = self._spec_verify_fn(self._state)
+        return np.asarray(verdict)
+"""
+    findings = analyze_source(src, HostSyncChecker(),
+                              path="chainermn_tpu/serving/engine.py",
+                              modname="chainermn_tpu.serving.engine")
+    assert [f.symbol for f in findings] == \
+        ["ServingEngine.spec_decode_step:np.asarray"]
+
+
 def test_hot_sync_ok_escape():
     src = HOT_COERCION.replace(
         "host = np.asarray(out)",

@@ -1,0 +1,66 @@
+"""Tier-1 wrapper around ``scripts/lint.sh``.
+
+``test_repo_clean.py`` runs the checkers in-process; this test runs the
+actual CI entrypoint, so a drift in the script itself (bad flag, stale
+module path, broken JSON record) fails tier-1 instead of silently
+skipping the sweep gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+LINT_SH = os.path.join(REPO_ROOT, "scripts", "lint.sh")
+
+
+def test_lint_script_exits_clean(tmp_path):
+    # full-tree target: the consistency rules are tree-global (catalog +
+    # test references), so any subset produces spurious findings
+    out = tmp_path / "lint.json"
+    env = dict(os.environ)
+    env["LINT_OUT"] = str(out)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        ["bash", LINT_SH], cwd=REPO_ROOT, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        f"lint.sh failed:\n{proc.stdout}\n{proc.stderr}"
+    # the machine-readable record must exist and agree: zero errors
+    rec = json.loads(out.read_text())
+    assert rec["counts"]["errors"] == 0, rec["counts"]
+    assert rec["counts"]["parse_errors"] == 0, rec["counts"]
+    assert str(out) in proc.stdout
+
+
+def test_lint_script_fails_on_violation(tmp_path):
+    # a synthetic hot-body sync must drive the script's exit code to 1:
+    # the wrapper propagates graftlint's status, it does not swallow it
+    bad = tmp_path / "bad_hot.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "\n"
+        "class Engine:\n"
+        "    def step(self):  # graftlint: hot\n"
+        "        out = self._decode_fn(self._state)\n"
+        "        return np.asarray(out)\n")
+    env = dict(os.environ)
+    env["LINT_OUT"] = str(tmp_path / "lint.json")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        ["bash", LINT_SH, str(bad)], cwd=REPO_ROOT, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout
+    assert "host-sync" in proc.stdout
+
+
+def test_lint_script_uses_this_interpreter_module():
+    # the script calls ``python -m chainermn_tpu.analysis`` — keep the
+    # module runnable so the entrypoint cannot rot
+    proc = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.analysis", "--help"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert "--json" in proc.stdout

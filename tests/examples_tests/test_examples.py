@@ -173,6 +173,41 @@ def test_serve_lm_paged_kv():
     assert "zero recompiles" in proc.stdout
 
 
+@pytest.mark.slow  # another multi-second subprocess run: full-suite only, to keep tier-1 inside its timeout
+def test_serve_lm_speculative():
+    """PR 12: prompt-lookup speculative decode through the demo — greedy
+    paged serving with `--speculate ngram`, verify-window accounting in
+    the end-of-run report, token parity vs solo generate(), and the
+    compiled-program family (incl. the ONE `spec_verify` executable)
+    pinned at zero recompiles."""
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "6", "--slots", "2", "--max-new", "8",
+         "--prefill-len", "8", "--d-model", "32", "--layers", "1",
+         "--heads", "4", "--paged-kv", "--kv-block-size", "4",
+         "--temperature", "0", "--speculate", "ngram", "--spec-k", "3",
+         "--verify-parity"],
+    )
+    assert "6/6 requests served" in proc.stdout
+    assert "parity vs solo generate: OK (3 requests)" in proc.stdout
+    assert "speculative: drafter=ngram, spec_k=3" in proc.stdout
+    assert "spec_tokens_proposed=" in proc.stdout
+    assert "'spec_verify': 1" in proc.stdout
+    assert "zero recompiles" in proc.stdout
+
+
+@pytest.mark.slow  # another multi-second subprocess run: full-suite only, to keep tier-1 inside its timeout
+def test_serve_lm_speculate_needs_greedy():
+    """The demo refuses a sampled-temperature speculative run loudly
+    instead of silently diverging from the greedy verify contract."""
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--paged-kv", "--speculate", "ngram"],
+        expect_rc=1,
+    )
+    assert "--temperature 0" in proc.stderr
+
+
 def test_serve_lm_fleet():
     """ISSUE 8: two replicas behind the FleetRouter serve interleaved
     shared-prefix traffic with token parity vs solo generate() — both
@@ -260,6 +295,7 @@ def test_serve_lm_tensor_parallel():
     assert "4/4 requests served" in proc.stdout
 
 
+@pytest.mark.slow  # heavy imagenet subprocess runs (~50s combined): full-suite only, to keep tier-1 inside its timeout
 def test_train_imagenet():
     proc = run_example(
         "imagenet/train_imagenet.py",
@@ -287,6 +323,7 @@ def test_train_imagenet_recipe():
     assert "input pipeline: native C++ prefetch" in proc.stdout
 
 
+@pytest.mark.slow  # heavy imagenet subprocess runs (~50s combined): full-suite only, to keep tier-1 inside its timeout
 def test_train_imagenet_mnbn_double_buffering():
     proc = run_example(
         "imagenet/train_imagenet.py",
@@ -297,6 +334,7 @@ def test_train_imagenet_mnbn_double_buffering():
     assert "done: 2 iterations" in proc.stdout
 
 
+@pytest.mark.slow  # heavy imagenet subprocess runs (~50s combined): full-suite only, to keep tier-1 inside its timeout
 def test_train_imagenet_fsdp():
     """ZeRO-3 layout end-to-end: scattered params/moments, recipe eval path
     (global-program eval forward on the scattered variables)."""

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from chainermn_tpu.models import GoogLeNet, VGG16
 
@@ -33,6 +34,7 @@ def test_vgg16_param_count():
     assert 135_000_000 < n < 140_000_000, n
 
 
+@pytest.mark.slow  # the single heaviest model compile (~40s): full-suite only, to keep tier-1 inside its timeout
 def test_googlenet_forward_backward_small():
     model = GoogLeNet(num_classes=7, compute_dtype=jnp.float32)
     x = jnp.ones((2, 64, 64, 3))

@@ -146,6 +146,41 @@ def test_engine_raise_errors_in_flight_and_restarts(lm_and_params):
     assert m["requests_errored"] == 2 and m["engine_restarts"] == 1
 
 
+def test_spec_verify_raise_errors_in_flight_and_restarts(lm_and_params):
+    """The speculative target-verify call is an engine-failure boundary
+    like ``serving.decode``: a raise inside ``serving.spec_verify``
+    fails every in-flight request loudly, the warm restart resets the
+    drafter alongside the slots, and post-restart speculative traffic
+    decodes to parity with zero recompiles."""
+    from chainermn_tpu.serving import SpeculativeConfig
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                           cache_len=32, paged=True, kv_block_size=2,
+                           speculative=SpeculativeConfig(k=2))
+    engine.warmup()
+    sched = FCFSScheduler(engine)
+    compiles_before = engine.compile_counts()
+    inj = FaultInjector()
+    inj.arm("serving.spec_verify", kind="raise", after=1, times=1)
+    with inj:
+        r1 = sched.submit(np.array([1, 2]), 6)
+        r2 = sched.submit(np.array([3, 4]), 6)
+        sched.run_until_idle()
+        for r in (r1, r2):
+            assert r.state is RequestState.ERRORED
+            with pytest.raises(EngineFailed) as ei:
+                r.wait(timeout=1)
+            assert isinstance(ei.value.__cause__, InjectedFault)
+        assert sched.engine_restarts == 1
+        assert engine.free_slots == {0, 1}
+        r3 = sched.submit(np.array([5, 6]), 4)
+        sched.run_until_idle()
+    assert r3.state is RequestState.DONE
+    assert engine.compile_counts() == compiles_before
+    ref = generate(lm, params, jnp.asarray([[5, 6]], jnp.int32), 4)
+    np.testing.assert_array_equal(r3.output, np.asarray(ref[0]))
+
+
 def test_prefill_raise_errors_admitting_request(lm_and_params):
     lm, params = lm_and_params
     engine, sched = make(lm, params, n_slots=1)

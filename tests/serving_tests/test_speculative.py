@@ -1,0 +1,490 @@
+"""Speculative decode on the paged KV store (PR 12).
+
+The load-bearing properties: token-for-token parity of speculative
+greedy decode vs the non-speculative path on staggered ragged traffic
+(both drafters — prompt-lookup and a small draft model), with zero
+recompiles across every accept length; the accept-length edge cases
+(0 accepted, all-k accepted, EOS inside the verify window) pinned by
+scripted drafters; block rollback of rejected rows under shared
+prefixes (``spec_rollback`` events, no pool leaks, shared blocks
+untouched); the int8 and tensor-parallel variants; and the
+``decode_window`` fori_loop twin the non-speculative path amortizes
+dispatch with."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.monitor import get_event_log
+from chainermn_tpu.serving import (
+    FCFSScheduler,
+    ServingEngine,
+    SpeculativeConfig,
+)
+from chainermn_tpu.serving.prefix_cache import PrefixCacheIndex
+from chainermn_tpu.serving.speculative import NgramDrafter
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=2,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+@pytest.fixture(scope="module")
+def draft_lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=8, n_heads=2, n_layers=1,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(1),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def solo(lm, params, prompt, n, **kw):
+    out = generate(lm, params, jnp.asarray(prompt, jnp.int32)[None], n, **kw)
+    return np.asarray(out[0])
+
+
+def spec_engine(lm, params, spec, *, warmup=True, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("prefill_buckets", (4, 8))
+    kw.setdefault("prefill_batch", 2)
+    kw.setdefault("kv_block_size", 2)
+    kw.setdefault("cache_len", 32)
+    engine = ServingEngine(lm, params, paged=True, speculative=spec, **kw)
+    if warmup:
+        engine.warmup()
+    return engine
+
+
+@pytest.fixture(scope="module")
+def ngram_engine(lm_and_params):
+    """ONE warm k=3 ngram engine shared by the parity / edge-case /
+    rollback / headroom tests below — compiled once, and the module
+    itself then pins zero recompiles across every accept length the
+    whole battery produces (the cross-test state is the point: slot
+    reuse, trie retention, cumulative spec counters)."""
+    lm, params = lm_and_params
+    return spec_engine(lm, params, SpeculativeConfig(k=3))
+
+
+def spec_delta(engine, fn):
+    """Run ``fn()`` and return the engine's (proposed, accepted) spec
+    counter deltas — the shared-engine substitute for fresh counters."""
+    before = engine.spec_stats()
+    out = fn()
+    after = engine.spec_stats()
+    return out, (after["spec_tokens_proposed"] - before["spec_tokens_proposed"],
+                 after["spec_tokens_accepted"] - before["spec_tokens_accepted"])
+
+
+JOBS = [(np.array([1, 2, 3]), 6), (np.array([4, 5, 6, 7, 8]), 4),
+        (np.array([9, 10]), 7), (np.array([11, 12, 13, 14]), 5),
+        (np.array([2, 4, 6, 8, 10, 12, 14, 16]), 3), (np.array([5]), 8)]
+
+
+def run_jobs(engine, jobs, **sched_kw):
+    sched = FCFSScheduler(engine, **sched_kw)
+    reqs = [sched.submit(p, n) for p, n in jobs]
+    sched.run_until_idle()
+    assert all(r.finished for r in reqs)
+    return reqs, sched
+
+
+# --------------------------------------------------------------------- #
+# config validation                                                      #
+# --------------------------------------------------------------------- #
+
+
+def test_speculative_config_validation(lm_and_params):
+    lm, params = lm_and_params
+    with pytest.raises(ValueError, match="k must be"):
+        SpeculativeConfig(k=0).validate()
+    with pytest.raises(ValueError, match="drafter must be"):
+        SpeculativeConfig(drafter="oracle").validate()
+    with pytest.raises(ValueError, match="draft_model"):
+        SpeculativeConfig(drafter="draft").validate()
+    with pytest.raises(ValueError, match="ngram_min"):
+        SpeculativeConfig(ngram_min=3, ngram_max=2).validate()
+    spec = SpeculativeConfig(k=2)
+    with pytest.raises(ValueError, match="paged"):
+        ServingEngine(lm, params, n_slots=1, prefill_len=4,
+                      speculative=spec)
+    with pytest.raises(ValueError, match="greedy-only"):
+        ServingEngine(lm, params, n_slots=1, prefill_len=4, paged=True,
+                      speculative=spec, temperature=0.7)
+    with pytest.raises(ValueError, match="mutually"):
+        ServingEngine(lm, params, n_slots=1, prefill_len=4, paged=True,
+                      speculative=spec, decode_window=3)
+    with pytest.raises(ValueError, match="decode_window"):
+        ServingEngine(lm, params, n_slots=1, prefill_len=4,
+                      decode_window=0)
+
+
+# --------------------------------------------------------------------- #
+# drafter mechanics (host-only, no device programs)                      #
+# --------------------------------------------------------------------- #
+
+
+def test_ngram_lookup_prefers_longest_and_most_recent():
+    class _Eng:
+        n_slots = 1
+    d = NgramDrafter(SpeculativeConfig(k=4, ngram_max=3), _Eng())
+    # trailing [2, 3] occurred twice; the most recent earlier occurrence
+    # (index 4) wins, proposing what followed it there
+    assert d._lookup([2, 3, 9, 9, 2, 3, 7, 2, 3], 2) == [7, 2]
+    # longest n first: trailing [3, 7, 2] (n=3) beats the bigram match
+    assert d._lookup([3, 7, 2, 5, 7, 2, 3, 7, 2], 1) == [5]
+    assert d._lookup([1, 2, 3], 2) == []          # no earlier occurrence
+
+
+def test_trie_ngram_continuation_reads_without_pinning():
+    trie = PrefixCacheIndex(16, 2)
+    trie.insert_shared(np.array([1, 2, 3, 4, 5, 6]), [1, 2, 3])
+    hits0, miss0 = trie.hits, trie.misses
+    # full-block walk + unique-child descent from a ragged tail
+    assert trie.ngram_continuation([1, 2, 3], 2) == [4, 5]
+    assert trie.ngram_continuation([1, 2], 3) == [3, 4, 5]
+    assert trie.ngram_continuation([7, 8], 2) is None     # diverges
+    # a pure read: no hit/miss accounting, nothing pinned, all evictable
+    assert (trie.hits, trie.misses) == (hits0, miss0)
+    assert trie.evictable_blocks() == 3
+
+
+# --------------------------------------------------------------------- #
+# parity: ON vs OFF token-identical, zero recompiles                     #
+# --------------------------------------------------------------------- #
+
+
+def test_spec_ngram_staggered_ragged_parity_and_zero_recompiles(
+        lm_and_params, ngram_engine):
+    """THE speculative acceptance test: mixed ragged prompts, staggered
+    admission, slots retired and reused — the n-gram-drafted stream is
+    token-for-token the solo greedy generate() (accept lengths vary per
+    round; only ONE verify program exists), and the executable counts
+    never grow."""
+    lm, params = lm_and_params
+    engine = ngram_engine
+    counts = engine.compile_counts_detailed()
+    assert counts["spec_verify"] == 1
+    assert set(counts.values()) == {1}
+    (reqs, sched), (d_prop, d_acc) = spec_delta(
+        engine, lambda: run_jobs(engine, JOBS))
+    for (p, n), r in zip(JOBS, reqs):
+        np.testing.assert_array_equal(r.output, solo(lm, params, p, n))
+    assert engine.compile_counts_detailed() == counts
+    assert engine.recompiles == {}
+    assert engine.active_slots == 0
+    assert engine.kv_stats()["blocks_reserved"] == 0
+    assert d_prop > 0
+    # the scheduler's per-run metrics equal the engine counter deltas
+    m = sched.metrics.report()
+    assert m["spec_tokens_proposed"] == d_prop
+    assert m["spec_tokens_accepted"] == d_acc
+    assert 0.0 <= m["spec_accept_rate"] <= 1.0
+    assert "spec_accept_length_mean" in m
+
+
+def test_spec_draft_model_parity(lm_and_params, draft_lm_and_params):
+    """The draft-TransformerLM drafter: same parity bar, plus its two
+    extra compiled programs pinned at one executable each (partial
+    acceptance reuses them — never recompiles them)."""
+    lm, params = lm_and_params
+    dlm, dparams = draft_lm_and_params
+    spec = SpeculativeConfig(k=3, drafter="draft", draft_model=dlm,
+                             draft_params=dparams)
+    engine = spec_engine(lm, params, spec)
+    counts = engine.compile_counts_detailed()
+    assert counts["draft_prefill"] == 1 and counts["draft_decode"] == 1
+    reqs, _ = run_jobs(engine, JOBS)
+    for (p, n), r in zip(JOBS, reqs):
+        np.testing.assert_array_equal(r.output, solo(lm, params, p, n))
+    assert engine.compile_counts_detailed() == counts
+    assert engine.recompiles == {}
+
+
+# --------------------------------------------------------------------- #
+# accept-length edge cases (scripted drafters)                           #
+# --------------------------------------------------------------------- #
+
+
+class _ScriptedDrafter:
+    """Test drafter proposing a fixed per-request continuation — the
+    greedy oracle (every window fully accepted) or its corruption
+    (every draft rejected). Engine-API complete, no device programs."""
+
+    def __init__(self, engine, refs, wrong=False):
+        self.engine = engine
+        self.wrong = wrong
+        # prompt tuple -> the request's full solo output (prompt + gen)
+        self.refs = {tuple(int(t) for t in r[:lp]): [int(t) for t in r]
+                     for r, lp in refs}
+        self._seq = {}
+        self._done = {}
+
+    def on_admit(self, slot, prompt, first_token):
+        ref = self.refs[tuple(int(t) for t in prompt)]
+        assert first_token == ref[len(prompt)]
+        self._seq[slot] = ref[len(prompt):]
+        self._done[slot] = 1
+
+    def on_commit(self, slot, tokens):
+        self._done[slot] += len(tokens)
+
+    def on_release(self, slot):
+        self._seq.pop(slot, None)
+        self._done.pop(slot, None)
+
+    def reset(self):
+        self._seq.clear()
+        self._done.clear()
+
+    def propose(self, k):
+        eng = self.engine
+        out = np.zeros((eng.n_slots, k), np.int32)
+        for slot, seq in self._seq.items():
+            nxt = seq[self._done[slot]: self._done[slot] + k]
+            nxt = nxt + [0] * (k - len(nxt))
+            if self.wrong:
+                nxt = [(t + 1) % eng.model.vocab_size for t in nxt]
+            out[slot, :] = nxt
+        return out
+
+    def warmup(self):
+        pass
+
+    def watched_fns(self):
+        return {}
+
+    def compile_counts(self):
+        return {}
+
+
+class _scripted:
+    """Context manager swapping the shared engine's drafter for a
+    scripted one, restored on exit so the next test sees the real
+    NgramDrafter again."""
+
+    def __init__(self, lm, params, engine, jobs, wrong):
+        refs = [(solo(lm, params, p, n), len(p)) for p, n in jobs]
+        self.engine = engine
+        self.drafter = _ScriptedDrafter(engine, refs, wrong=wrong)
+
+    def __enter__(self):
+        self._real = self.engine._drafter
+        self.engine._drafter = self.drafter
+        return self.engine
+
+    def __exit__(self, *exc):
+        self.engine._drafter = self._real
+        return False
+
+
+def test_all_k_accepted_oracle_drafter(lm_and_params, ngram_engine):
+    """A perfect drafter: every window commits k+1 tokens (accept rate
+    exactly 1.0), stream unchanged. max_new = 9 = 2 windows of k+1 + 1,
+    so no round ever drafts past the reference."""
+    lm, params = lm_and_params
+    jobs = [(np.array([1, 2, 3]), 9), (np.array([4, 5, 6, 7]), 9)]
+    with _scripted(lm, params, ngram_engine, jobs, wrong=False) as engine:
+        (reqs, _), (d_prop, d_acc) = spec_delta(
+            engine, lambda: run_jobs(engine, jobs))
+    for (p, n), r in zip(jobs, reqs):
+        np.testing.assert_array_equal(r.output, solo(lm, params, p, n))
+    assert d_prop > 0
+    assert d_acc == d_prop                      # accept rate exactly 1.0
+    assert engine.recompiles == {}
+
+
+def test_zero_accepted_wrong_drafter(lm_and_params, ngram_engine):
+    """An always-wrong drafter: every draft rejected (accept rate 0.0),
+    one token per dispatch like the plain path — and STILL the exact
+    greedy stream (a bad drafter costs speed, never correctness)."""
+    lm, params = lm_and_params
+    jobs = [(np.array([1, 2, 3]), 6), (np.array([9, 10]), 5)]
+    with _scripted(lm, params, ngram_engine, jobs, wrong=True) as engine:
+        (reqs, _), (d_prop, d_acc) = spec_delta(
+            engine, lambda: run_jobs(engine, jobs))
+    for (p, n), r in zip(jobs, reqs):
+        np.testing.assert_array_equal(r.output, solo(lm, params, p, n))
+    assert d_prop > 0
+    assert d_acc == 0                           # accept rate exactly 0.0
+    assert engine.recompiles == {}
+
+
+def test_eos_inside_verify_window_retires_and_discards_tail(
+        lm_and_params, ngram_engine):
+    """EOS lands mid-window: the request retires with EOS as its last
+    token (matching generate(eos_id=...)) and the window's tail past it
+    is discarded, not delivered."""
+    lm, params = lm_and_params
+    prompt = np.array([1, 2, 3])
+    ref = solo(lm, params, prompt, 8)
+    gen = [int(t) for t in ref[len(prompt):]]
+    eos = gen[1]                    # second generated token
+    expect = gen[: gen.index(eos) + 1]
+    sched = FCFSScheduler(ngram_engine, eos_id=eos)
+    req = sched.submit(prompt, 8)
+    sched.run_until_idle()
+    assert req.tokens == expect
+    assert ngram_engine.active_slots == 0
+
+
+# --------------------------------------------------------------------- #
+# rollback under shared prefixes                                         #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow  # rollback machinery already runs under the wrong-drafter test; the detailed pool asserts are full-suite only
+def test_rejected_rows_roll_back_and_shared_prefix_survives(
+        lm_and_params, ngram_engine):
+    """An always-wrong drafter maximizes rejected writes: every round
+    appends blocks for the draft window and rolls the unused ones back
+    (``spec_rollback`` events, reserved-headroom invariant restored) —
+    while trie-shared prefix blocks stay resident and byte-valid: a
+    follower admitted AFTER the rollback storm still matches solo."""
+    lm, params = lm_and_params
+    shared = [1, 2, 3, 4, 5, 6]
+    jobs = [(np.array(shared + [7]), 8), (np.array(shared + [9]), 8)]
+    events0 = len([e for e in get_event_log().tail(512)
+                   if e["kind"] == "spec_rollback"])
+    with _scripted(lm, params, ngram_engine,
+                   jobs + [(np.array(shared + [8]), 6)],
+                   wrong=True) as engine:
+        reqs, sched = run_jobs(engine, jobs)
+        rollbacks = [e for e in get_event_log().tail(512)
+                     if e["kind"] == "spec_rollback"]
+        assert len(rollbacks) > events0, \
+            "wrong-drafter windows must roll blocks back"
+        for (p, n), r in zip(jobs, reqs):
+            np.testing.assert_array_equal(r.output, solo(lm, params, p, n))
+        # nothing leaked: only trie-retained prefix blocks stay resident
+        assert engine.kv_stats()["blocks_reserved"] == 0
+        used_after = engine._pool.used_blocks
+        assert used_after <= engine.prefix_cache.evictable_blocks() + 1
+        # the shared blocks the rollbacks worked around are still the
+        # real prefix KV: a follower hits the trie and decodes to parity
+        hits0 = engine.prefix_cache.hits
+        follower = sched.submit(np.array(shared + [8]), 6)
+        sched.run_until_idle()
+        np.testing.assert_array_equal(
+            follower.output, solo(lm, params, shared + [8], 6))
+        assert engine.prefix_cache.hits > hits0
+
+
+def test_spec_headroom_reserved_and_returned(lm_and_params, ngram_engine):
+    """Block-budget admission reserves ceil(k/block_size) extra blocks
+    per slot so mid-window appends can't run dry; retirement returns
+    every reservation."""
+    lm, params = lm_and_params
+    # a cold (never warmed) plain engine is enough for blocks_needed —
+    # the budget math is host-side and needs no compiled programs
+    plain = spec_engine(lm, params, None, warmup=False)
+    spec = ngram_engine
+    assert spec._spec_headroom == 2          # ceil(3/2)
+    assert (spec.blocks_needed(5, 4)
+            == plain.blocks_needed(5, 4) + spec._spec_headroom)
+    sched = FCFSScheduler(spec)
+    req = sched.submit(np.array([1, 2, 3]), 4)
+    sched.step()
+    assert req.slot >= 0
+    assert int(spec._slot_reserved[req.slot]) >= spec._spec_headroom
+    sched.run_until_idle()
+    assert spec.kv_stats()["blocks_reserved"] == 0
+
+
+# --------------------------------------------------------------------- #
+# int8 + tensor-parallel variants                                        #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow  # heavy variant builds: full-suite only, to keep tier-1 inside its timeout
+def test_spec_int8_matches_plain_int8(lm_and_params):
+    """Speculation composes with int8 resident blocks: both paths read
+    the SAME quantized stores, so spec-ON must equal spec-OFF exactly
+    (the int8-vs-f32 tolerance question is test_paged_kv's, not ours)."""
+    lm, params = lm_and_params
+    jobs = JOBS[:4]
+    plain = spec_engine(lm, params, None, kv_quant="int8")
+    ref_reqs, _ = run_jobs(plain, jobs)
+    spec = spec_engine(lm, params, SpeculativeConfig(k=3),
+                       kv_quant="int8")
+    reqs, _ = run_jobs(spec, jobs)
+    for ref, r in zip(ref_reqs, reqs):
+        np.testing.assert_array_equal(r.output, ref.output)
+    assert spec.recompiles == {}
+
+
+@pytest.mark.slow  # heavy variant builds: full-suite only, to keep tier-1 inside its timeout
+def test_tp_spec_matches_solo_tp_generate():
+    """The verify program inside comm.shard_map (head-sharded store,
+    vocab-parallel head all-gathered before the argmax): same parity
+    bar as the single-device path."""
+    comm = chainermn_tpu.create_communicator("tpu")
+    lm = TransformerLM(vocab_size=32, d_model=16, n_heads=8, n_layers=2,
+                       max_len=32, tensor_axis=comm.axis_name,
+                       compute_dtype=jnp.float32)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    params = jax.jit(comm.shard_map(
+        lambda t: lm.init(jax.random.PRNGKey(1), t),
+        in_specs=P(), out_specs=P(),
+    ))(prompt)
+    engine = ServingEngine(lm, params, n_slots=2, prefill_len=8,
+                           cache_len=16, comm=comm, paged=True,
+                           kv_block_size=2,
+                           speculative=SpeculativeConfig(k=2))
+    engine.warmup()
+    sched = FCFSScheduler(engine)
+    r1 = sched.submit(np.array([1, 2, 3]), 5)
+    r2 = sched.submit(np.array([4, 5, 6, 7]), 4)
+    sched.run_until_idle()
+    ref1 = generate(lm, params, prompt, 5, comm=comm)
+    ref2 = generate(lm, params, jnp.asarray([[4, 5, 6, 7]], jnp.int32),
+                    4, comm=comm)
+    np.testing.assert_array_equal(r1.output, np.asarray(ref1[0]))
+    np.testing.assert_array_equal(r2.output, np.asarray(ref2[0]))
+    assert engine.recompiles == {}
+
+
+# --------------------------------------------------------------------- #
+# decode_window: the non-speculative fori_loop twin                      #
+# --------------------------------------------------------------------- #
+
+
+def test_decode_window_paged_parity(lm_and_params):
+    """decode_window=n commits n tokens per dispatch through the SAME
+    per-slot key splits — stream identical to the per-token program,
+    one compiled window program, zero recompiles."""
+    lm, params = lm_and_params
+    engine = spec_engine(lm, params, None, decode_window=4)
+    counts = engine.compile_counts_detailed()
+    assert counts["decode_window"] == 1
+    reqs, _ = run_jobs(engine, JOBS)
+    for (p, n), r in zip(JOBS, reqs):
+        np.testing.assert_array_equal(r.output, solo(lm, params, p, n))
+    assert engine.compile_counts_detailed() == counts
+    assert engine.recompiles == {}
+    assert engine.kv_stats()["blocks_reserved"] == 0
+
+
+@pytest.mark.slow  # heavy variant builds: full-suite only, to keep tier-1 inside its timeout
+def test_decode_window_dense_parity(lm_and_params):
+    """The dense twin (no block tables): same window program shape over
+    the pooled cache regions."""
+    lm, params = lm_and_params
+    engine = ServingEngine(lm, params, n_slots=3,
+                           prefill_buckets=(4, 8), prefill_batch=2,
+                           cache_len=32, decode_window=3)
+    engine.warmup()
+    jobs = JOBS[:4]
+    reqs, _ = run_jobs(engine, jobs)
+    for (p, n), r in zip(jobs, reqs):
+        np.testing.assert_array_equal(r.output, solo(lm, params, p, n))
+    assert engine.recompiles == {}
