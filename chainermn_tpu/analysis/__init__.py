@@ -12,7 +12,7 @@ the whole tree, on every PR, with no imports of the code under analysis
 (and no jax/numpy — the analyzer itself stays a pure host-logic import,
 pinned by ``tests/monitor_tests/test_import_hygiene.py``).
 
-Five checkers ride one shared visitor framework (:mod:`.core`):
+The checkers ride one shared visitor framework (:mod:`.core`):
 
 ``lock-discipline``
     For classes owning a ``threading.Lock/RLock/Condition``, infer which
@@ -35,6 +35,16 @@ Five checkers ride one shared visitor framework (:mod:`.core`):
     inside loops/hot bodies, jit-then-call-in-one-expression, varying
     Python scalars (``len``/``.shape``/loop vars) at non-static argument
     positions, and traced-value branches inside jitted functions.
+``blocking-under-lock``
+    Blocking work inside lock-held regions (``time.sleep``, file/socket
+    I/O, thread ``.join``, blocking queue ops, device fetches) — one
+    call level expanded through local helpers and same-class methods;
+    a lock held across a disk write serializes every other path
+    through that lock behind the disk.
+``thread-lifecycle``
+    Every ``threading.Thread(...)`` is ``daemon=True`` or joined inside
+    a stop/close/shutdown-named function — no thread outlives the
+    intent of its owner.
 ``consistency`` / ``import-hygiene``
     Every fault cut-point and metric/event name must come from the
     central catalogs (``resilience/cutpoints.py``,
@@ -49,6 +59,14 @@ Run it: ``python -m chainermn_tpu.analysis chainermn_tpu/`` (human or
 in-process via :func:`run_analysis`. ``tests/analysis_tests/
 test_repo_clean.py`` runs the full suite over the tree as a tier-1 test,
 so the repo is lint-clean at merge.
+
+The static model is cross-checked against real schedules by the
+opt-in runtime concurrency sanitizer (:mod:`.sanitizer`): instrumented
+locks build the *observed* lock-order graph (cycles and
+static-graph-absent edges raise with both acquisition stacks),
+``guarded()`` proxies enforce lock-discipline dynamically, and
+``--runtime-report`` asserts observed ⊆ static off the tier-1
+``SANITIZER.json`` artifact.
 """
 
 from chainermn_tpu.analysis.core import (

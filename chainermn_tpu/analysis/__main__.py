@@ -16,6 +16,7 @@ import sys
 from chainermn_tpu.analysis.checkers import all_checkers
 from chainermn_tpu.analysis.core import (
     load_baseline,
+    load_project,
     run_analysis,
     write_baseline,
 )
@@ -37,7 +38,54 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--list-rules", action="store_true",
                    help="print available rule ids and exit")
+    p.add_argument("--runtime-report", default=None, metavar="FILE",
+                   help="sanitizer artifact (JSON) to merge with the "
+                        "static lock-order graph; exits 1 on observed "
+                        "edges absent from the static graph")
     return p
+
+
+def _runtime_report(artifact_path: str, paths: list) -> int:
+    """Merge the sanitizer's observed lock-order graph into the static
+    one and assert observed ⊆ static (leaf-lock edges are terminal
+    telemetry edges, reported but never gating)."""
+    from chainermn_tpu.analysis.checkers.locks import static_lock_graph
+    from chainermn_tpu.analysis.sanitizer import (
+        artifact_class_edges,
+        load_artifact,
+    )
+
+    artifact = load_artifact(artifact_path)
+    observed = artifact_class_edges(artifact)
+    project, parse_errors = load_project(paths)
+    if parse_errors:
+        for f in parse_errors:
+            print(f.render())
+        return 1
+    static = static_lock_graph(project)
+
+    both = sorted(observed & static)
+    static_only = sorted(static - observed)
+    observed_only = sorted(observed - static)
+    leaf = sorted(tuple(e) for e in artifact.get("leaf_edges", ()))
+
+    print("runtime lock-order report "
+          f"({len(observed)} observed / {len(static)} static class edges)")
+    for a, b in both:
+        print(f"  both      {a} -> {b}")
+    for a, b in static_only:
+        print(f"  static    {a} -> {b}  (not exercised at runtime)")
+    for a, b in leaf:
+        print(f"  leaf      {a} -> {b}  (terminal telemetry lock)")
+    for a, b in observed_only:
+        print(f"  OBSERVED-ONLY  {a} -> {b}  — runtime took a lock "
+              f"ordering the static graph does not know about")
+    if observed_only:
+        print("runtime-report: FAIL (observed graph is not a subgraph "
+              "of the static graph)")
+        return 1
+    print("runtime-report: OK (observed ⊆ static)")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -55,6 +103,9 @@ def main(argv=None) -> int:
         if unknown:
             parser.error(f"unknown rule(s): {', '.join(sorted(unknown))}")
         checkers = [c for c in checkers if c.rule in wanted]
+
+    if args.runtime_report:
+        return _runtime_report(args.runtime_report, args.paths)
 
     baseline = load_baseline(args.baseline)
     result = run_analysis(args.paths, checkers, baseline=baseline)

@@ -18,7 +18,10 @@ import ast
 import re
 from typing import Optional
 
-LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+# make_lock / make_rlock are analysis.sanitizer's instrumented
+# constructors — production code swapping threading.Lock() for them must
+# keep full static lock coverage, so they count as lock factories here
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "make_lock", "make_rlock"}
 EVENT_FACTORIES = {"Event", "Semaphore", "BoundedSemaphore", "Barrier"}
 
 # compiled-program attribute naming convention (ServingEngine._decode_fn,
@@ -88,7 +91,9 @@ class ClassModel:
                         continue
                     if _threading_factory(sub.value, LOCK_FACTORIES):
                         self.lock_attrs.add(attr)
-                        if call_name(sub.value.func).endswith("RLock"):
+                        factory = call_name(sub.value.func)
+                        if factory.endswith("RLock") \
+                                or factory.endswith("make_rlock"):
                             self.reentrant.add(attr)
                     elif _threading_factory(sub.value, EVENT_FACTORIES):
                         self.event_attrs.add(attr)

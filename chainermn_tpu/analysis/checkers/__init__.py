@@ -1,4 +1,4 @@
-"""Checker registry: the five graftlint rules, in report order."""
+"""Checker registry: the eight graftlint rules, in report order."""
 
 from chainermn_tpu.analysis.checkers.locks import (
     LockDisciplineChecker,
@@ -8,6 +8,8 @@ from chainermn_tpu.analysis.checkers.hotpath import HostSyncChecker
 from chainermn_tpu.analysis.checkers.recompile import RecompileChecker
 from chainermn_tpu.analysis.checkers.imports import ImportHygieneChecker
 from chainermn_tpu.analysis.checkers.names import ConsistencyChecker
+from chainermn_tpu.analysis.checkers.blocking import BlockingUnderLockChecker
+from chainermn_tpu.analysis.checkers.threads import ThreadLifecycleChecker
 
 
 def all_checkers() -> list:
@@ -15,6 +17,8 @@ def all_checkers() -> list:
     return [
         LockDisciplineChecker(),
         LockOrderChecker(),
+        BlockingUnderLockChecker(),
+        ThreadLifecycleChecker(),
         HostSyncChecker(),
         RecompileChecker(),
         ImportHygieneChecker(),
@@ -23,11 +27,13 @@ def all_checkers() -> list:
 
 
 __all__ = [
+    "BlockingUnderLockChecker",
     "ConsistencyChecker",
     "HostSyncChecker",
     "ImportHygieneChecker",
     "LockDisciplineChecker",
     "LockOrderChecker",
     "RecompileChecker",
+    "ThreadLifecycleChecker",
     "all_checkers",
 ]

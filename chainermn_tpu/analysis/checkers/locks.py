@@ -9,14 +9,23 @@ a guarded attribute outside the lock (in any method except
 Escape hatch ``# graftlint: unguarded-ok`` for single-writer or
 torn-read-tolerant sites.
 
-**lock-order** — an edge ``A → B`` means "some method of A calls a
-locking method of B while holding A's own lock". Cycles in that graph
+**lock-order** — an edge ``A → B`` means "some method of A may acquire
+B's lock while holding A's own lock". Lock acquisition is tracked
+*transitively across classes*: every (class, method) gets a fixpoint
+set of lock **sinks** — the classes whose locks the call may end up
+acquiring through any chain of typed calls (``FleetRouter._bind_locked
+→ replica.submit → FCFSScheduler.submit`` sinks to ``FCFSScheduler``
+even though ``EngineReplica`` owns no lock). Cycles in the edge graph
 are the static shadow of an ABBA deadlock and gate the run, as does
 re-acquiring a non-reentrant own lock (nested ``with self._lock`` or
 calling one of the class's own locking methods under it). Receivers are
 typed with :class:`~chainermn_tpu.analysis.astutil.TypeWorld`
 (constructor / factory / list-element inference); untypeable receivers
 create no edge. Escape hatch ``# graftlint: lock-order-ok``.
+
+:func:`static_lock_graph` exposes the same edge set as data — the
+runtime sanitizer (:mod:`chainermn_tpu.analysis.sanitizer`) and the
+``--runtime-report`` CLI mode assert every *observed* edge is in it.
 """
 
 from __future__ import annotations
@@ -124,6 +133,12 @@ class LockOrderChecker(Checker):
     suppress_token = "lock-order-ok"
 
     def check(self, project: Project) -> Iterator[Finding]:
+        yield from self._run(project, {})
+
+    def _run(self, project: Project, edges: dict) -> Iterator[Finding]:
+        """Full pass; ``edges[(A, B)] = (module, node, caller, callee)``
+        is filled as a side effect (:func:`static_lock_graph` reads it
+        back without caring about the findings)."""
         models: list = []
         per_module: dict = {}
         for module in project.modules:
@@ -135,21 +150,67 @@ class LockOrderChecker(Checker):
             world.learn_factories(module)
         for cm in models:
             world.learn_attr_types(cm)
+        sinks = self._lock_sinks(models, world)
 
-        # edges[(A, B)] = (module, node) of one representative site
-        edges: dict = {}
         for module in project.modules:
             for cm in per_module[module.modname]:
                 if not cm.lock_attrs:
                     continue
-                yield from self._scan_class(module, cm, world, edges)
+                yield from self._scan_class(module, cm, world, sinks,
+                                            edges)
 
         yield from self._cycles(edges)
+
+    # -- transitive lock sinks ------------------------------------------- #
+
+    def _lock_sinks(self, models: list, world: astutil.TypeWorld) -> dict:
+        """``(class name, method name) → frozenset of class names``
+        whose locks the method may acquire — directly or through any
+        chain of typed intra-/cross-class calls, to fixpoint."""
+        canon = [cm for cm in models
+                 if world.classes.get(cm.name) is cm]
+        callees: dict = {}
+        sinks: dict = {}
+        for cm in canon:
+            for name, meth in cm.methods.items():
+                key = (cm.name, name)
+                callees[key] = self._method_callees(cm, world, meth)
+                sinks[key] = (frozenset({cm.name})
+                              if cm.method_locks_directly(meth)
+                              else frozenset())
+        changed = True
+        while changed:
+            changed = False
+            for key, calls in callees.items():
+                cur = sinks[key]
+                acc = set(cur)
+                for c in calls:
+                    acc |= sinks.get(c, frozenset())
+                if acc != cur:
+                    sinks[key] = frozenset(acc)
+                    changed = True
+        return sinks
+
+    @staticmethod
+    def _method_callees(cm, world, meth) -> list:
+        locals_ = world.local_types(cm, meth)
+        out: list = []
+        for sub in ast.walk(meth):
+            if not isinstance(sub, ast.Call) \
+                    or not isinstance(sub.func, ast.Attribute):
+                continue
+            if astutil.is_self_attr(sub.func) is not None:
+                out.append((cm.name, sub.func.attr))
+                continue
+            cls_name = world.receiver_class(cm, locals_, sub.func.value)
+            if cls_name:
+                out.append((cls_name, sub.func.attr))
+        return out
 
     # -- per-class scan -------------------------------------------------- #
 
     def _scan_class(self, module, cm: astutil.ClassModel,
-                    world: astutil.TypeWorld, edges: dict
+                    world: astutil.TypeWorld, sinks: dict, edges: dict
                     ) -> Iterator[Finding]:
         for name, meth in cm.methods.items():
             locals_ = world.local_types(cm, meth)
@@ -157,12 +218,12 @@ class LockOrderChecker(Checker):
                 if not cm.under_own_lock(sub):
                     continue
                 found = self._finding_at(module, cm, world, locals_,
-                                         name, sub, edges)
+                                         sinks, name, sub, edges)
                 if found is not None:
                     yield found
 
-    def _finding_at(self, module, cm, world, locals_, meth_name, sub,
-                    edges):
+    def _finding_at(self, module, cm, world, locals_, sinks, meth_name,
+                    sub, edges):
         # nested re-acquire of a non-reentrant own lock
         if isinstance(sub, (ast.With, ast.AsyncWith)):
             for item in sub.items:
@@ -176,44 +237,44 @@ class LockOrderChecker(Checker):
                         symbol=f"{cm.name}.{meth_name}:self-reacquire")
             return None
 
-        callee_cls, callee = self._locking_callee(cm, world, locals_, sub)
+        callee_cls, callee = self._typed_callee(cm, world, locals_, sub)
         if callee_cls is None:
             return None
-        if callee_cls is cm:
-            if not cm.reentrant:
-                return self.finding(
-                    module, sub,
-                    f"{cm.name}.{meth_name} calls own locking method "
-                    f"{callee}() while already holding the (non-reentrant)"
-                    f" lock — use an _unlocked variant",
-                    symbol=f"{cm.name}.{meth_name}->{callee}")
-            return None
-        edges.setdefault((cm.name, callee_cls.name),
-                         (module, sub, f"{cm.name}.{meth_name}",
-                          f"{callee_cls.name}.{callee}"))
+        if callee_cls is cm and callee in cm.locking_methods \
+                and not cm.reentrant:
+            return self.finding(
+                module, sub,
+                f"{cm.name}.{meth_name} calls own locking method "
+                f"{callee}() while already holding the (non-reentrant)"
+                f" lock — use an _unlocked variant",
+                symbol=f"{cm.name}.{meth_name}->{callee}")
+        for sink in sorted(sinks.get((callee_cls.name, callee), ())):
+            if sink == cm.name:
+                continue
+            edges.setdefault((cm.name, sink),
+                             (module, sub, f"{cm.name}.{meth_name}",
+                              f"{callee_cls.name}.{callee}"))
         return None
 
-    def _locking_callee(self, cm, world, locals_, sub):
-        """(ClassModel, method_name) when ``sub`` invokes a locking
-        method/property of a typed receiver, else (None, None)."""
+    def _typed_callee(self, cm, world, locals_, sub):
+        """(ClassModel, method/property name) when ``sub`` invokes a
+        method or property of a typed receiver, else (None, None)."""
         if isinstance(sub, ast.Call) and isinstance(sub.func,
                                                     ast.Attribute):
             recv, meth = sub.func.value, sub.func.attr
             if astutil.is_self_attr(sub.func) is not None:
-                if meth in cm.locking_methods:
-                    return cm, meth
-                return None, None
+                return cm, meth
             cls_name = world.receiver_class(cm, locals_, recv)
             target = world.classes.get(cls_name) if cls_name else None
-            if target is not None and meth in target.locking_methods:
+            if target is not None:
                 return target, meth
         elif isinstance(sub, ast.Attribute) and getattr(
                 getattr(sub, "graft_parent", None), "func", None) is not sub:
-            # locking @property access (receiver.prop) — skip when the
+            # @property access (receiver.prop) — skip when the
             # attribute is itself the callee of a Call (handled above)
             cls_name = world.receiver_class(cm, locals_, sub.value)
             target = world.classes.get(cls_name) if cls_name else None
-            if target is not None and sub.attr in target.locking_properties:
+            if target is not None and sub.attr in target.properties:
                 return target, sub.attr
         return None, None
 
@@ -259,4 +320,15 @@ class LockOrderChecker(Checker):
                     symbol=f"cycle:{'->'.join(sorted(key))}")
 
 
-__all__ = ["LockDisciplineChecker", "LockOrderChecker"]
+def static_lock_graph(project: Project) -> set:
+    """The static lock-order edge set as ``{(holder_class,
+    acquired_class)}`` — the reference graph the runtime sanitizer's
+    *observed* edges must be a subset of (``--runtime-report``)."""
+    edges: dict = {}
+    for _ in LockOrderChecker()._run(project, edges):
+        pass
+    return set(edges)
+
+
+__all__ = ["LockDisciplineChecker", "LockOrderChecker",
+           "static_lock_graph"]

@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from chainermn_tpu.analysis import sanitizer
 from chainermn_tpu.monitor._state import get_registry
 from chainermn_tpu.monitor.trace import span as _trace_span
 
@@ -122,6 +123,9 @@ class DevicePrefetcher:
     def _offer(self, item) -> bool:
         """Blocking put that stays interruptible by :meth:`close`."""
         while not self._stop.is_set():
+            # interleaving point: the fuzzer stretches the gap between
+            # the stop check and the put — the close()/producer race
+            sanitizer.sync_point("prefetch:offer")
             try:
                 self._q.put(item, timeout=0.05)
                 return True
@@ -174,6 +178,8 @@ class DevicePrefetcher:
         if self._finished:
             raise StopIteration
         self._ensure_started()
+        # interleaving point: empty-check vs producer-put race window
+        sanitizer.sync_point("prefetch:next")
         if self._q.empty():
             # the producer is behind: the input pipeline, not the step, is
             # the bottleneck right now — count it, time the wait, and put

@@ -226,12 +226,18 @@ class MultiNodeCheckpointer:
         data = blob if frac is None else blob[: int(len(blob) * frac)]
 
         def write() -> None:
+            # _io_lock IS the I/O serializer: sync and async savers must
+            # not interleave writes, so disk work under it is the
+            # invariant, not a bug (PR 4 design)
+            # graftlint: blocking-ok
             with open(tmp, "wb") as f:
                 f.write(data[: len(data) // 2])
                 # mid-write cut-point: a raise here leaves a torn .tmp —
                 # the crash the atomic rename + startup sweep absorb
                 inject(CHECKPOINT_WRITE, iteration=int(iteration))
                 f.write(data[len(data) // 2:])
+            # atomic publish belongs inside the same _io_lock hold as
+            # the bytes it publishes  # graftlint: blocking-ok
             os.replace(tmp, target)
 
         with self._io_lock:
@@ -333,6 +339,9 @@ class MultiNodeCheckpointer:
         its = self._local_iterations()
         for it in its[: max(0, len(its) - self._n_retains)]:
             try:
+                # GC-under-write-lock is deliberate (PR 4): a snapshot
+                # must never be deleted while its successor is still a
+                # torn .tmp  # graftlint: blocking-ok
                 os.remove(self.filename(it))
             except OSError:
                 pass  # already gone; never fail training over GC

@@ -45,6 +45,7 @@ import sys
 import threading
 from typing import Callable, Optional
 
+from chainermn_tpu.analysis import sanitizer
 from chainermn_tpu.monitor._state import get_event_log, get_registry
 from chainermn_tpu.fleet.routing import ReplicaSnapshot
 
@@ -249,6 +250,10 @@ class EngineReplica:
                     poison, self._poison = self._poison, None
                     raise poison
                 if self.scheduler.has_work:
+                    # interleaving point: the fuzzer stretches the gap
+                    # between the has_work check and the step — the
+                    # submit/step race window the router exercises
+                    sanitizer.sync_point("replica:step")
                     self.scheduler.step()
                     self._check_watchdog()
                 else:

@@ -69,6 +69,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from chainermn_tpu.analysis import sanitizer
 from chainermn_tpu.fleet.replica import EngineReplica, ReplicaState
 from chainermn_tpu.fleet.routing import (
     FleetTrie,
@@ -231,9 +232,12 @@ class FleetRouter:
         self._policy = policy if policy is not None else RoutingPolicy(
             affinity=self.affinity)
         self._trie = FleetTrie(affinity_block_size)
-        self._lock = threading.RLock()
+        self._lock = sanitizer.make_rlock("FleetRouter._lock")
         self._ids = itertools.count()
-        self._requests: dict[int, FleetRequest] = {}
+        # sanitizer-guarded: mutation without _lock held raises when the
+        # runtime sanitizer is on
+        self._requests: dict[int, FleetRequest] = sanitizer.guarded(
+            {}, lock=self._lock, name="FleetRouter._requests")
         self._closed = False
         self._events = get_event_log()
         reg = get_registry()
@@ -256,7 +260,8 @@ class FleetRouter:
         self._labels = labels
         # replicas currently inside a publish fence: routing steers new
         # work away from them (unless nothing else is healthy)
-        self._publishing: set[int] = set()
+        self._publishing: set[int] = sanitizer.guarded(
+            set(), lock=self._lock, name="FleetRouter._publishing")
         self.replicas = [
             EngineReplica(i, eng, on_failure=self._on_replica_failure,
                           labels=labels, autostart=autostart,

@@ -93,6 +93,8 @@ METRIC_NAMES = frozenset({
     "slo_breaches_total",
     "slo_burn_rate",
     "slo_compliant",
+    # concurrency sanitizer
+    "lock_hold_seconds",
 })
 
 EVENT_KINDS = frozenset({
@@ -151,6 +153,8 @@ EVENT_KINDS = frozenset({
     "weight_swap",
     # SLO
     "slo_breach",
+    # concurrency sanitizer
+    "lock_contended",
 })
 
 __all__ = ["EVENT_KINDS", "METRIC_NAMES"]

@@ -25,6 +25,8 @@ from typing import Mapping, Optional
 
 import numpy as np
 
+from chainermn_tpu.analysis import sanitizer
+
 # module import, not the package facade: chainermn_tpu.extensions/__init__
 # may be mid-initialization when the communicator layer pulls monitor in
 # NOTE: `latency_report` is imported lazily inside Histogram.stats().
@@ -54,7 +56,10 @@ class _Instrument:
     def __init__(self, name: str, labels_key: tuple) -> None:
         self.name = name
         self.labels_key = labels_key
-        self._lock = threading.Lock()
+        # leaf: instruments are updated under arbitrary subsystem locks
+        # (scheduler, router), so this lock must stay terminal — the
+        # sanitizer enforces that nothing is acquired while it is held
+        self._lock = sanitizer.make_lock("_Instrument._lock", leaf=True)
 
     @property
     def key(self) -> str:
@@ -190,8 +195,9 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._instruments: dict[tuple, _Instrument] = {}
+        self._lock = sanitizer.make_lock("MetricsRegistry._lock")
+        self._instruments: dict[tuple, _Instrument] = sanitizer.guarded(
+            {}, lock=self._lock, name="MetricsRegistry._instruments")
 
     # ------------------------------------------------------------------ #
     # instrument creation                                                 #

@@ -26,6 +26,7 @@ from typing import Optional
 
 import numpy as np
 
+from chainermn_tpu.analysis import sanitizer
 from chainermn_tpu.extensions import latency_report
 from chainermn_tpu.monitor import EventLog, MetricsRegistry
 from chainermn_tpu.monitor._state import get_event_log, get_registry
@@ -362,6 +363,20 @@ class ServingMetrics:
             # the slowest traced request's full phase attribution — the
             # compact "where the p99 TTFT went" answer, per trace
             out["critical_path"] = self._worst_trace
+        if sanitizer.enabled():
+            # lock-hold / contention accounting (sanitizer runs only):
+            # which lock the serving path actually spends its time in
+            holds = sanitizer.hold_stats()
+            if holds:
+                out["lock_hold_seconds"] = {
+                    name: {"count": s["count"],
+                           "total_s": round(s["total_s"], 6),
+                           "max_s": round(s["max_s"], 6)}
+                    for name, s in holds.items()
+                }
+            contended = sanitizer.contention_counts()
+            if contended:
+                out["lock_contended"] = contended
         return out
 
 

@@ -61,6 +61,7 @@ from typing import Optional
 
 import numpy as np
 
+from chainermn_tpu.analysis import sanitizer
 from chainermn_tpu.monitor._state import get_event_log, get_registry
 
 
@@ -89,6 +90,9 @@ class BlockPool:
         self._lo = lo
         self._free = list(range(self.n_blocks - 1, lo - 1, -1))
         self._refs = np.zeros(self.n_blocks, np.int64)
+        # single-writer contract, enforced at runtime: two threads
+        # observed inside a mutator concurrently raise GuardViolation
+        self._mut = sanitizer.mutation_guard("BlockPool")
 
     @property
     def capacity(self) -> int:
@@ -109,28 +113,32 @@ class BlockPool:
     def alloc(self) -> Optional[int]:
         """One free block at refcount 1, or ``None`` when the pool is dry
         (the caller may then evict trie leaves and retry)."""
-        if not self._free:
-            return None
-        block = self._free.pop()
-        self._refs[block] = 1
-        return block
+        with self._mut:
+            if not self._free:
+                return None
+            block = self._free.pop()
+            self._refs[block] = 1
+            return block
 
     def incref(self, block: int) -> None:
-        self._refs[block] += 1
+        with self._mut:
+            self._refs[block] += 1
 
     def decref(self, block: int) -> None:
-        self._refs[block] -= 1
-        if self._refs[block] == 0:
-            self._free.append(block)
-        elif self._refs[block] < 0:
-            raise RuntimeError(
-                f"block {block} over-released (refcount went negative)")
+        with self._mut:
+            self._refs[block] -= 1
+            if self._refs[block] == 0:
+                self._free.append(block)
+            elif self._refs[block] < 0:
+                raise RuntimeError(
+                    f"block {block} over-released (refcount went negative)")
 
     def reset(self) -> None:
         """Everything free, all refcounts dropped — the engine's warm
         ``restart()`` path (device store is rebuilt alongside)."""
-        self._free = list(range(self.n_blocks - 1, self._lo - 1, -1))
-        self._refs[:] = 0
+        with self._mut:
+            self._free = list(range(self.n_blocks - 1, self._lo - 1, -1))
+            self._refs[:] = 0
 
 
 class _Node:
@@ -208,6 +216,9 @@ class PrefixCacheIndex:
         self.block_size = int(block_size)
         self._root = _Node(None, -1, None)
         self._clock = itertools.count(1)
+        # single-writer contract (same as BlockPool): the scheduler
+        # thread owns all trie mutation; enforced when the sanitizer is on
+        self._mut = sanitizer.mutation_guard("PrefixCacheIndex")
         self._events = get_event_log()
         reg = get_registry()
         self._c_hits = reg.counter("prefix_cache_hits_total")
@@ -243,26 +254,27 @@ class PrefixCacheIndex:
         cap = (len(tokens) - 1) // self.block_size
         if max_blocks is not None:
             cap = min(cap, max_blocks)
-        node, nodes = self._root, []
-        for i in range(cap):
-            child = node.children.get(self._key(tokens, i))
-            if child is None:
-                break
-            nodes.append(child)
-            node = child
-        if not nodes:
-            self.misses += 1
-            self._c_misses.inc()
-            return None
-        nodes[-1].refs += 1
-        t = next(self._clock)
-        for nd in nodes:
-            nd.last_use = t
-        self.hits += 1
-        self._c_hits.inc()
-        return PrefixMatch(nodes=nodes,
-                           length=len(nodes) * self.block_size,
-                           block_ids=[nd.block for nd in nodes])
+        with self._mut:
+            node, nodes = self._root, []
+            for i in range(cap):
+                child = node.children.get(self._key(tokens, i))
+                if child is None:
+                    break
+                nodes.append(child)
+                node = child
+            if not nodes:
+                self.misses += 1
+                self._c_misses.inc()
+                return None
+            nodes[-1].refs += 1
+            t = next(self._clock)
+            for nd in nodes:
+                nd.last_use = t
+            self.hits += 1
+            self._c_hits.inc()
+            return PrefixMatch(nodes=nodes,
+                               length=len(nodes) * self.block_size,
+                               block_ids=[nd.block for nd in nodes])
 
     def missing_blocks(self, tokens) -> int:
         """How many of ``tokens``' full blocks are NOT yet cached — the
@@ -322,8 +334,9 @@ class PrefixCacheIndex:
         once no other holder pins them."""
         if match is None or match.released:
             return
-        match.released = True
-        match.nodes[-1].refs -= 1
+        with self._mut:
+            match.released = True
+            match.nodes[-1].refs -= 1
 
     # ------------------------------------------------------------------ #
     # insertion                                                           #
@@ -339,21 +352,22 @@ class PrefixCacheIndex:
         tokens = np.asarray(tokens).reshape(-1)
         bs = self.block_size
         total = len(tokens) // bs
-        node, i = self._root, 0
-        t = next(self._clock)
-        while i < total:
-            child = node.children.get(self._key(tokens, i))
-            if child is None:
-                break
-            child.last_use = t
-            node, i = child, i + 1
-        if i >= total:
-            return None
-        node.refs += 1                    # pin the attachment point
-        blocks = self.alloc_blocks(total - i)
-        if not blocks:
-            node.refs -= 1
-            return None
+        with self._mut:
+            node, i = self._root, 0
+            t = next(self._clock)
+            while i < total:
+                child = node.children.get(self._key(tokens, i))
+                if child is None:
+                    break
+                child.last_use = t
+                node, i = child, i + 1
+            if i >= total:
+                return None
+            node.refs += 1                # pin the attachment point
+            blocks = self.alloc_blocks(total - i)
+            if not blocks:
+                node.refs -= 1
+                return None
         return InsertPlan(
             parent=node,
             keys=[self._key(tokens, i + j) for j in range(len(blocks))],
@@ -364,17 +378,18 @@ class PrefixCacheIndex:
     def commit_insert(self, plan: InsertPlan) -> None:
         if plan.closed:
             return
-        plan.closed = True
-        node = plan.parent
-        node.refs -= 1
-        t = next(self._clock)
-        for key, block in zip(plan.keys, plan.block_ids):
-            child = _Node(key, block, node)
-            child.last_use = t
-            node.children[key] = child
-            node = child
-        n = len(plan.block_ids)
-        self.inserted_blocks += n
+        with self._mut:
+            plan.closed = True
+            node = plan.parent
+            node.refs -= 1
+            t = next(self._clock)
+            for key, block in zip(plan.keys, plan.block_ids):
+                child = _Node(key, block, node)
+                child.last_use = t
+                node.children[key] = child
+                node = child
+            n = len(plan.block_ids)
+            self.inserted_blocks += n
         self._c_inserted.inc(n)
         self._events.emit("prefix_insert", blocks=n,
                           depth=plan.start_block + n,
@@ -383,10 +398,11 @@ class PrefixCacheIndex:
     def abort_insert(self, plan: InsertPlan) -> None:
         if plan.closed:
             return
-        plan.closed = True
-        plan.parent.refs -= 1
-        for block in plan.block_ids:
-            self.pool.decref(block)
+        with self._mut:
+            plan.closed = True
+            plan.parent.refs -= 1
+            for block in plan.block_ids:
+                self.pool.decref(block)
 
     def insert_shared(self, tokens, block_ids) -> int:
         """Paged-mode zero-copy insert: **adopt** already-resident blocks.
@@ -400,23 +416,24 @@ class PrefixCacheIndex:
         tokens = np.asarray(tokens).reshape(-1)
         bs = self.block_size
         total = min(len(tokens) // bs, len(block_ids))
-        node, i = self._root, 0
-        t = next(self._clock)
-        while i < total:
-            child = node.children.get(self._key(tokens, i))
-            if child is None:
-                break
-            child.last_use = t
-            node, i = child, i + 1
-        adopted = 0
-        for j in range(i, total):
-            block = int(block_ids[j])
-            self.pool.incref(block)
-            child = _Node(self._key(tokens, j), block, node)
-            child.last_use = t
-            node.children[child.key] = child
-            node = child
-            adopted += 1
+        with self._mut:
+            node, i = self._root, 0
+            t = next(self._clock)
+            while i < total:
+                child = node.children.get(self._key(tokens, i))
+                if child is None:
+                    break
+                child.last_use = t
+                node, i = child, i + 1
+            adopted = 0
+            for j in range(i, total):
+                block = int(block_ids[j])
+                self.pool.incref(block)
+                child = _Node(self._key(tokens, j), block, node)
+                child.last_use = t
+                node.children[child.key] = child
+                node = child
+                adopted += 1
         if adopted:
             self.inserted_blocks += adopted
             self._c_inserted.inc(adopted)
@@ -444,23 +461,25 @@ class PrefixCacheIndex:
         trie inserts and — paged mode — the engine's slot admissions and
         lazy block appends, so both compete under the same LRU policy."""
         out = []
-        while len(out) < n:
-            block = self.pool.alloc()
-            if block is not None:
-                out.append(block)
-                continue
-            victims = self._evictable()
-            if not victims:
-                break                      # partial allocation is fine
-            victim = min(victims, key=lambda nd: nd.last_use)
-            del victim.parent.children[victim.key]
-            # may not free the block immediately: a paged decode slot
-            # still referencing it keeps it alive until that slot retires
-            self.pool.decref(victim.block)
-            self.evictions += 1
-            self._c_evictions.inc()
-            self._events.emit("prefix_evict", block=victim.block,
-                              age=victim.last_use)
+        with self._mut:
+            while len(out) < n:
+                block = self.pool.alloc()
+                if block is not None:
+                    out.append(block)
+                    continue
+                victims = self._evictable()
+                if not victims:
+                    break                  # partial allocation is fine
+                victim = min(victims, key=lambda nd: nd.last_use)
+                del victim.parent.children[victim.key]
+                # may not free the block immediately: a paged decode slot
+                # still referencing it keeps it alive until that slot
+                # retires
+                self.pool.decref(victim.block)
+                self.evictions += 1
+                self._c_evictions.inc()
+                self._events.emit("prefix_evict", block=victim.block,
+                                  age=victim.last_use)
         return out
 
     # kept as the historical internal name (engine/test callers predate
@@ -498,9 +517,10 @@ class PrefixCacheIndex:
         reclaimed too); a shared pool only gives back the trie's own
         references (the engine resets the pool itself after dropping the
         slot tables)."""
-        self._root = _Node(None, -1, None)
-        if self._pool_private:
-            self.pool.reset()
+        with self._mut:
+            self._root = _Node(None, -1, None)
+            if self._pool_private:
+                self.pool.reset()
 
     # ------------------------------------------------------------------ #
     # stats                                                               #

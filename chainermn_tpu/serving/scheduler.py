@@ -93,6 +93,7 @@ from typing import Callable, Iterator, Optional
 import jax
 import numpy as np
 
+from chainermn_tpu.analysis import sanitizer
 from chainermn_tpu.monitor import annotate
 from chainermn_tpu.monitor._state import get_event_log
 from chainermn_tpu.monitor.trace import NULL_TRACE, get_tracer
@@ -284,9 +285,13 @@ class FCFSScheduler:
         # sampling (and forced retention on shed/error) decides what the
         # ring keeps. NULL_TRACE when tracing is disabled.
         self._tracer = tracer if tracer is not None else get_tracer()
-        self._queue: deque[Request] = deque()
-        self._by_slot: dict[int, Request] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("FCFSScheduler._lock")
+        # sanitizer-guarded: mutating either without _lock held raises
+        # when the runtime sanitizer is on (lock-discipline, enforced)
+        self._queue: deque[Request] = sanitizer.guarded(
+            deque(), lock=self._lock, name="FCFSScheduler._queue")
+        self._by_slot: dict[int, Request] = sanitizer.guarded(
+            {}, lock=self._lock, name="FCFSScheduler._by_slot")
         self._ids = itertools.count()
         self._pending_swap: Optional[SwapTicket] = None
 
@@ -885,7 +890,8 @@ class FCFSScheduler:
                     expired.append(req)
                 else:
                     keep.append(req)
-            self._queue = keep
+            self._queue = sanitizer.guarded(
+                keep, lock=self._lock, name="FCFSScheduler._queue")
         for req in expired:
             # deadline-missed traces are retained regardless of sampling
             # (always-sample-on-deadline-miss): exactly the requests an

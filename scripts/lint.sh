@@ -10,8 +10,10 @@ set -u
 cd "$(dirname "$0")/.."
 
 targets=("$@")
+default_scope=0
 if [ ${#targets[@]} -eq 0 ]; then
     targets=(chainermn_tpu/)
+    default_scope=1
 fi
 out="${LINT_OUT:-LINT.json}"
 
@@ -20,4 +22,13 @@ status=$?
 
 python -m chainermn_tpu.analysis "${targets[@]}"
 echo "findings record: $out"
+
+# cross-check the runtime sanitizer's observed lock-order graph against
+# the static one (observed must be a subset). SANITIZER.json is dumped
+# by the serving/fleet/dataflow tier-1 suites; only meaningful against
+# the default full-package scope.
+if [ "$default_scope" -eq 1 ] && [ -f SANITIZER.json ]; then
+    python -m chainermn_tpu.analysis chainermn_tpu/ \
+        --runtime-report SANITIZER.json || status=1
+fi
 exit $status

@@ -1,0 +1,180 @@
+"""blocking-under-lock fixtures: blocking work inside lock-held regions."""
+
+from chainermn_tpu.analysis import analyze_source
+from chainermn_tpu.analysis.checkers.blocking import BlockingUnderLockChecker
+
+
+def _run(src, **kw):
+    return analyze_source(src, BlockingUnderLockChecker(), **kw)
+
+
+def test_sleep_under_lock_fires():
+    findings = _run("""\
+import threading, time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poll(self):
+        with self._lock:
+            time.sleep(0.1)
+""")
+    assert len(findings) == 1
+    assert "time.sleep" in findings[0].message
+    assert findings[0].rule == "blocking-under-lock"
+
+
+def test_file_io_and_join_under_lock_fire():
+    findings = _run("""\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=print, daemon=True)
+
+    def flush(self):
+        with self._lock:
+            with open("/tmp/x", "w") as f:
+                f.write("x")
+            self._t.join()
+""")
+    assert {f.symbol for f in findings} == {"C.flush:open", "C.flush:.join"}
+
+
+def test_locked_suffix_method_is_a_lock_region():
+    findings = _run("""\
+import threading, time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _drain_locked(self):
+        time.sleep(0.5)
+""")
+    assert len(findings) == 1
+    assert "_drain_locked" in findings[0].message
+
+
+def test_string_join_and_cv_wait_are_sanctioned():
+    findings = _run("""\
+import threading
+
+class C:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._parts = []
+
+    def render(self):
+        with self._cv:
+            self._cv.wait()
+            return ", ".join(self._parts)
+""")
+    assert findings == []
+
+
+def test_blocking_queue_get_under_lock_fires_nowait_ok():
+    findings = _run("""\
+import queue, threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._d = {}
+
+    def bad(self):
+        with self._lock:
+            return self._q.get()
+
+    def fine(self):
+        with self._lock:
+            self._q.get_nowait()
+            return self._d.get("k")   # plain dict .get: untouched
+""")
+    assert [f.symbol for f in findings] == ["C.bad:queue.get"]
+
+
+def test_local_helper_called_under_lock_is_expanded():
+    findings = _run("""\
+import os, threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def save(self):
+        def write():
+            os.replace("a", "b")
+        with self._lock:
+            write()
+""")
+    assert len(findings) == 1
+    assert "os.replace" in findings[0].message
+
+
+def test_intra_class_callee_under_lock_is_expanded():
+    findings = _run("""\
+import os, threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _gc(self):
+        os.remove("x")
+
+    def save(self):
+        with self._lock:
+            self._gc()
+""")
+    assert len(findings) == 1
+    assert "C._gc" in findings[0].message
+
+
+def test_module_level_lock_region_checked():
+    findings = _run("""\
+import threading, time
+
+_LOCK = threading.Lock()
+
+def refresh():
+    with _LOCK:
+        time.sleep(0.2)
+""")
+    assert len(findings) == 1
+    assert "refresh" in findings[0].message
+
+
+def test_escape_token_suppresses():
+    findings = _run("""\
+import threading, time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poll(self):
+        with self._lock:
+            time.sleep(0.1)  # graftlint: blocking-ok
+""")
+    assert findings == []
+
+
+def test_device_fetch_under_lock_fires():
+    findings = _run("""\
+import threading, jax
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._out = None
+
+    def fetch(self):
+        with self._lock:
+            return jax.device_get(self._out)
+""")
+    assert len(findings) == 1
+    assert "jax.device_get" in findings[0].message

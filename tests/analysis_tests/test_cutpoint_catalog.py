@@ -65,6 +65,7 @@ PINNED_METRICS = frozenset({
     "kv_blocks_in_use",
     "kv_blocks_per_request",
     "kv_preemptions_total",
+    "lock_hold_seconds",
     "loss_fetch_seconds",
     "loss_fetch_total",
     "prefetch_batches_total",
@@ -136,6 +137,7 @@ PINNED_EVENTS = frozenset({
     "kv_admit_defer",
     "kv_append",
     "kv_preempt",
+    "lock_contended",
     "prefill",
     "prefix_evict",
     "prefix_insert",

@@ -1,0 +1,114 @@
+"""thread-lifecycle fixtures: every Thread is daemon or reaped."""
+
+from chainermn_tpu.analysis import analyze_source
+from chainermn_tpu.analysis.checkers.threads import ThreadLifecycleChecker
+
+
+def _run(src, **kw):
+    return analyze_source(src, ThreadLifecycleChecker(), **kw)
+
+
+def test_unjoined_nondaemon_thread_fires():
+    findings = _run("""\
+import threading
+
+class C:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def _loop(self):
+        pass
+""")
+    assert len(findings) == 1
+    assert "._t" in findings[0].message
+    assert findings[0].rule == "thread-lifecycle"
+
+
+def test_daemon_kwarg_is_compliant():
+    findings = _run("""\
+import threading
+
+class C:
+    def start(self):
+        self._t = threading.Thread(target=print, daemon=True)
+        self._t.start()
+""")
+    assert findings == []
+
+
+def test_daemon_attribute_assignment_is_compliant():
+    findings = _run("""\
+import threading
+
+class C:
+    def start(self):
+        self._t = threading.Thread(target=print)
+        self._t.daemon = True
+        self._t.start()
+""")
+    assert findings == []
+
+
+def test_join_on_lifecycle_path_is_compliant():
+    findings = _run("""\
+import threading
+
+class C:
+    def start(self):
+        self._t = threading.Thread(target=print)
+        self._t.start()
+
+    def close(self):
+        self._t.join()
+""")
+    assert findings == []
+
+
+def test_join_outside_lifecycle_path_still_fires():
+    findings = _run("""\
+import threading
+
+class C:
+    def start(self):
+        self._t = threading.Thread(target=print)
+        self._t.start()
+
+    def poll(self):
+        self._t.join(0.1)
+""")
+    assert len(findings) == 1
+
+
+def test_unbound_thread_fires():
+    findings = _run("""\
+import threading
+
+def kick():
+    threading.Thread(target=print).start()
+""")
+    assert len(findings) == 1
+    assert "unbound" in findings[0].message
+
+
+def test_module_level_local_thread_joined_on_shutdown():
+    findings = _run("""\
+import threading
+
+worker = threading.Thread(target=print)
+
+def shutdown():
+    worker.join()
+""")
+    assert findings == []
+
+
+def test_escape_token_suppresses():
+    findings = _run("""\
+import threading
+
+def kick():
+    # reaped by the pool's reaper loop  # graftlint: thread-ok
+    threading.Thread(target=print).start()
+""")
+    assert findings == []

@@ -1,0 +1,23 @@
+"""Dataflow suite runs under the runtime concurrency sanitizer.
+
+See ``tests/serving_tests/conftest.py`` — same contract: instrumented
+locks for every module here, observed edges merged into the repo-root
+``SANITIZER.json`` for the ``--runtime-report`` cross-check.
+"""
+
+import pathlib
+
+import pytest
+
+from chainermn_tpu.analysis import sanitizer
+
+_ARTIFACT = str(pathlib.Path(__file__).resolve().parents[2]
+                / "SANITIZER.json")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _concurrency_sanitizer():
+    sanitizer.enable()
+    yield
+    sanitizer.dump_artifact(_ARTIFACT)
+    sanitizer.disable()

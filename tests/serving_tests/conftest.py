@@ -1,0 +1,26 @@
+"""Serving suite runs under the runtime concurrency sanitizer.
+
+Every lock built through :func:`chainermn_tpu.analysis.sanitizer.
+make_lock` becomes an instrumented :class:`SanLock` for these modules:
+cycles and guard violations raise inside the offending test, and the
+observed lock-order graph is merged into the repo-root
+``SANITIZER.json`` artifact that ``scripts/lint.sh`` cross-checks
+against the static graph (``--runtime-report``).
+"""
+
+import pathlib
+
+import pytest
+
+from chainermn_tpu.analysis import sanitizer
+
+_ARTIFACT = str(pathlib.Path(__file__).resolve().parents[2]
+                / "SANITIZER.json")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _concurrency_sanitizer():
+    sanitizer.enable()
+    yield
+    sanitizer.dump_artifact(_ARTIFACT)
+    sanitizer.disable()
