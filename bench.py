@@ -851,6 +851,81 @@ def serving_main() -> None:
             f"({p['concurrency_gain']}x) at {budget_rows} KV rows, "
             f"preemptions={p['preemptions']}, parity={pg_parity}")
 
+        # ---- fused paged-decode kernel: ON vs OFF ---------------------- #
+        # ISSUE 14: two paged engines differing ONLY in paged_kernel= run
+        # the identical workload. Off TPU the kernel executes in Pallas
+        # interpret mode, so the tokens/s pair is parity/recompile
+        # EVIDENCE there, not a performance claim — the speedup number is
+        # only meaningful on real hardware (the smoke test gates on
+        # device_kind the same way). The bytes-read model rides along:
+        # it is the analytical XLA-dense-view vs streamed-blocks cost,
+        # computed from the workload's final lengths, chip-free.
+        from chainermn_tpu.parallel.paged_kernel import (
+            bytes_read_model,
+            kernel_supported,
+        )
+
+        def run_kernel_workload():
+            eng = ServingEngine(model, params, prefill_buckets=(pg_prefill,),
+                                prefill_batch=pg_batch, cache_len=pg_cache,
+                                paged=True, kv_blocks=pg_blocks,
+                                kv_block_size=pg_bs, kv_quant=pg_quant,
+                                n_slots=paged_slots, paged_kernel=True)
+            eng.warmup()
+            counts = eng.compile_counts_detailed()
+            s = FCFSScheduler(eng)
+            t0 = time.time()
+            reqs = [s.submit(p_, n_) for p_, n_ in pg_jobs]
+            s.run_until_idle()
+            wall = time.time() - t0
+            assert eng.compile_counts_detailed() == counts, "recompiled!"
+            return eng, s.metrics.report(), reqs, wall
+
+        eng_kn, m_kn, reqs_kn, wall_kn = run_kernel_workload()
+        # the OFF side IS the paged section's engine — identical config
+        # down to paged_kernel=False, same jobs — so its run is reused
+        # rather than rebuilt (the tier-1 bench smoke rides this)
+        eng_kf, m_kf, reqs_kf, wall_kf = eng_pg, m_pg, reqs_pg, wall_pg
+        kn_parity = all(
+            bool(np.array_equal(a.output, b.output))
+            for a, b in zip(reqs_kn, reqs_kf))
+        for i in (0, 1):
+            prompt, n = pg_jobs[i]
+            ref = np.asarray(generate(model, params,
+                                      jnp.asarray(prompt)[None], n)[0])
+            kn_parity = (kn_parity
+                         and bool(np.array_equal(reqs_kn[i].output, ref)))
+        final_lengths = [len(p_) + n_ for p_, n_ in pg_jobs]
+        supported, why = kernel_supported()
+        record["paged_kernel_serving"] = {
+            "kernel_used": bool(eng_kn.paged_kernel),
+            "kernel_supported": supported,
+            "fallback_reason": why,
+            "interpret_mode": jax.default_backend() != "tpu",
+            "device_kind": jax.devices()[0].device_kind,
+            "kv_quant": pg_quant,
+            "kv_block_size": pg_bs,
+            "tokens_per_sec": m_kn["tokens_per_sec"],
+            "tokens_per_sec_off": m_kf["tokens_per_sec"],
+            "wall_s": round(wall_kn, 3),
+            "wall_s_off": round(wall_kf, 3),
+            "parity_vs_xla_and_solo": kn_parity,
+            "recompiles_after_warmup":
+                sum(eng_kn.recompiles.values())
+                + sum(eng_kf.recompiles.values()),
+            "bytes_read_model": bytes_read_model(
+                final_lengths, block_size=pg_bs,
+                max_blocks=-(-pg_cache // pg_bs),
+                n_heads=model.n_heads,
+                head_dim=model.d_model // model.n_heads,
+                n_layers=model.n_layers, kv_quant=pg_quant),
+        }
+        kn = record["paged_kernel_serving"]
+        log(f"paged kernel: used={kn['kernel_used']} "
+            f"(interpret={kn['interpret_mode']}), parity={kn_parity}, "
+            f"read_amp={kn['bytes_read_model']['read_amplification']}x "
+            f"modelled")
+
         # ---- speculative decode: prompt-lookup drafting ON vs OFF ----- #
         # ISSUE 12: a shared-system-prompt workload with LONG greedy
         # generations (the regime speculation targets) through two paged

@@ -39,6 +39,11 @@ HOT_FUNCTIONS = (
     ("serving/engine.py", "ServingEngine.admit_batch"),
     ("fleet/replica.py", "EngineReplica._loop"),
     ("resilience/trainer.py", "ResilientTrainer.fit"),
+    # the paged-decode read side: traced per decode step on every paged
+    # path (kernel AND XLA fallback) — a host sync here would serialize
+    # each token of every slot
+    ("parallel/sequence.py", "paged_update_cache_and_attend"),
+    ("parallel/paged_kernel.py", "paged_attend"),
 )
 
 # syncs that exist only to block on the device: flagged on any argument

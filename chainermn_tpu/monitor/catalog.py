@@ -126,6 +126,7 @@ EVENT_KINDS = frozenset({
     "kv_admit_defer",
     "kv_append",
     "kv_preempt",
+    "paged_kernel_fallback",
     "prefill",
     "prefix_evict",
     "prefix_insert",

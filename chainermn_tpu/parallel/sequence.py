@@ -804,6 +804,41 @@ def cached_attention(q, kbuf, vbuf, pos_offset, *, scale: Optional[float] = None
     return out.astype(q.dtype)
 
 
+def _dequant_cached_attention(q, k8, k_sc, v8, v_sc, pos_offset, *,
+                              scale: Optional[float] = None):
+    """:func:`cached_attention` over an int8 K/V view with the dequant
+    scales FOLDED into the contractions instead of materialized: the QK
+    product runs on the raw int8 rows and its f32 scores are multiplied
+    by ``k_sc`` per key column; the probabilities are multiplied by
+    ``v_sc`` per key row before the PV product. Same math by linearity
+    (the scales are per-row constants along the contracted dims), but
+    the ``[B, T, H, D]`` dequantized f32 view never exists — the read
+    path moves int8 rows plus the f32 scale vectors, preserving the
+    ``kv_quant='int8'`` bandwidth win at read time (PERF.md "Paged-decode
+    kernel"). ``k8``/``v8`` are ``[B, T, H, D]`` int8, ``k_sc``/``v_sc``
+    their ``[B, T, H]`` f32 scales; masking is identical to
+    :func:`cached_attention`."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k8.astype(q.dtype),
+                   preferred_element_type=jnp.float32) * scale
+    s = s * jnp.moveaxis(k_sc, 2, 1)[:, :, None, :]           # [B,H,1,T]
+    k_pos = jnp.arange(k8.shape[1])
+    if jnp.ndim(pos_offset) == 0:
+        q_pos = pos_offset + jnp.arange(q.shape[1])
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+    else:
+        q_pos = pos_offset[:, None] + jnp.arange(q.shape[1])[None]
+        mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]
+    s = jnp.where(mask, s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    p = p * jnp.moveaxis(v_sc, 2, 1)[:, :, None, :]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v8.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def paged_update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
                                   scale: Optional[float] = None):
     """The paged twin of :func:`update_cache_and_attend`: K/V live in a
@@ -838,12 +873,34 @@ def paged_update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
 
     Writes scatter the ``S`` new rows through the table
     (``store[table[b, p//bs], p%bs] = kv[b, p]``); the attention gathers
-    each row's full table span back into a ``[B, max_blocks*bs]``
-    per-sequence view and runs the same position-masked
-    :func:`cached_attention`. Static shapes throughout — table contents
-    change, programs never recompile. Returns ``(out, new_cache)`` where
-    ``new_cache`` carries the updated store (and scales) WITHOUT the
-    table: the table is host-managed state threaded in per call."""
+    each row's table span back into a per-sequence view and runs the same
+    position-masked :func:`cached_attention`. The gathered span is the
+    full ``max_blocks`` when positions are traced (the serving engine's
+    compiled bodies — shapes must not depend on values), but callers with
+    CONCRETE positions get the span tightened to the batch-max active
+    block count ``ceil(max(lengths)/bs)``: fully-masked table tail
+    entries are provably never read, so they are not gathered either.
+    Per-row valid lengths are ``pos_offset + S`` (post-write); a
+    ``'lengths'`` entry in ``kv_cache`` overrides them.
+
+    An int8 store's dequant scales fold into the attention contractions
+    per-block (scores scaled after the QK product, probabilities before
+    the PV product) — the dequantized f32 dense view is never
+    materialized, read bytes stay int8-sized.
+
+    A truthy ``'use_kernel'`` entry routes the read side through the
+    fused Pallas kernel (:func:`chainermn_tpu.parallel.paged_kernel.
+    paged_attend`): table-indexed block gather, in-register dequant and
+    online-softmax attention in one pass, streaming only each row's
+    ``ceil(len/bs)`` active blocks. The scatter (write side) is XLA on
+    every path — it moves ``S`` rows, the kernel owns the O(length)
+    read. ``'use_kernel'`` must be a static Python bool (it selects a
+    trace, it is not an operand).
+
+    Static shapes throughout — table contents change, programs never
+    recompile. Returns ``(out, new_cache)`` where ``new_cache`` carries
+    the updated store (and scales) WITHOUT the table: the table is
+    host-managed state threaded in per call."""
     store_k, store_v = kv_cache["k"], kv_cache["v"]
     table = kv_cache["table"]
     quant = "k_scale" in kv_cache
@@ -877,18 +934,38 @@ def paged_update_cache_and_attend(kv_cache, q, k, v, pos_offset, *,
     new_k, new_ks = write(store_k, kv_cache.get("k_scale"), k)
     new_v, new_vs = write(store_v, kv_cache.get("v_scale"), v)
 
-    flat = table.reshape(-1)                                  # [B*M]
+    lengths = kv_cache.get("lengths")
+    if lengths is None:
+        lengths = pos_offset + s                              # post-write
+    m_used = table.shape[1]
+    if not isinstance(lengths, jax.core.Tracer):
+        # concrete positions: tighten the span to the batch-max active
+        # block count — the masked tail is provably never read
+        m_used = max(1, min(m_used, -(-int(jnp.max(lengths)) // bs)))
 
-    def gather(store, scales):
-        rows = jnp.take(store, flat, axis=0)       # [B*M, bs, H, D]
+    if kv_cache.get("use_kernel"):
+        from chainermn_tpu.parallel.paged_kernel import paged_attend
+        out = paged_attend(q, new_k, new_v, table, lengths,
+                           k_scale=new_ks, v_scale=new_vs, scale=scale,
+                           max_blocks=m_used)
+    else:
+        flat = table[:, :m_used].reshape(-1)                  # [B*m]
+
+        def gather(store, scales):
+            rows = jnp.take(store, flat, axis=0)   # [B*m, bs, H, D]
+            rows = rows.reshape((b, -1) + rows.shape[2:])
+            if not quant:
+                return rows.astype(q.dtype), None
+            sc = jnp.take(scales, flat, axis=0)    # [B*m, bs, H]
+            return rows, sc.reshape((b, -1) + sc.shape[2:])
+
+        kbuf, ksc = gather(new_k, new_ks)
+        vbuf, vsc = gather(new_v, new_vs)
         if quant:
-            sc = jnp.take(scales, flat, axis=0)    # [B*M, bs, H]
-            rows = rows.astype(jnp.float32) * sc[..., None]
-        rows = rows.reshape((b, -1) + rows.shape[2:])
-        return rows.astype(q.dtype)
-
-    out = cached_attention(q, gather(new_k, new_ks), gather(new_v, new_vs),
-                           pos_offset, scale=scale)
+            out = _dequant_cached_attention(q, kbuf, ksc, vbuf, vsc,
+                                            pos_offset, scale=scale)
+        else:
+            out = cached_attention(q, kbuf, vbuf, pos_offset, scale=scale)
     new_cache = {"k": new_k, "v": new_v}
     if quant:
         new_cache["k_scale"] = new_ks

@@ -100,6 +100,7 @@ from chainermn_tpu.models.transformer import (
 from chainermn_tpu.dataflow.dispatch import device_fetch
 from chainermn_tpu.monitor import RecompileGuard, annotate
 from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.parallel.paged_kernel import kernel_supported
 from chainermn_tpu.resilience.cutpoints import (
     SERVING_DECODE,
     SERVING_KV_APPEND,
@@ -257,6 +258,7 @@ class ServingEngine:
                  kv_blocks: Optional[int] = None,
                  kv_block_size: int = 16,
                  kv_quant: str = "none",
+                 paged_kernel: bool = False,
                  speculative: Optional[SpeculativeConfig] = None,
                  decode_window: int = 1,
                  cache_len: Optional[int] = None, temperature: float = 0.0,
@@ -359,8 +361,9 @@ class ServingEngine:
                            dict(labels, prefill_bucket=str(b)))
             for b in buckets
         }
-        self._c_decode_steps = reg.counter("serving_decode_steps_total",
-                                           labels)
+        # serving_decode_steps_total is created AFTER paged parsing below:
+        # in paged mode it carries the paged_kernel="on"/"off" label so
+        # kernel ON-vs-OFF A/Bs fork the time series instead of mixing
         self._c_restarts = reg.counter("serving_engine_restarts_total",
                                        labels)
         self._c_appends = reg.counter("kv_block_appends_total", labels)
@@ -381,6 +384,26 @@ class ServingEngine:
         if not self.paged and self.kv_quant != "none":
             raise ValueError("kv_quant needs paged=True (the dense cache "
                              "regions are not quantized)")
+        # fused Pallas paged-decode kernel (parallel/paged_kernel.py): an
+        # OPT-IN replacement for the decode read side only — prefill and
+        # every write stay XLA, and paged_kernel=False (the default) is
+        # the byte-for-byte XLA trace. Unavailability degrades to the XLA
+        # path with an event, never to a construction failure.
+        self.paged_kernel = bool(paged_kernel)
+        if self.paged_kernel and not self.paged:
+            raise ValueError("paged_kernel=True needs paged=True (the "
+                             "fused kernel reads the shared block store)")
+        if self.paged_kernel:
+            ok, why = kernel_supported()
+            if not ok:
+                self._events.emit("paged_kernel_fallback", reason=why)
+                self.paged_kernel = False
+        decode_labels = dict(labels)
+        if self.paged:
+            decode_labels["paged_kernel"] = (
+                "on" if self.paged_kernel else "off")
+        self._c_decode_steps = reg.counter("serving_decode_steps_total",
+                                           decode_labels)
         self.peak_active = 0
         self.prefix_cache: Optional[PrefixCacheIndex] = None
         if self.paged:
@@ -660,8 +683,12 @@ class ServingEngine:
         ``[n_slots, max_blocks]`` table — per-slot positions and sampler
         keys exactly like the dense body; free/retired slots carry
         all-scratch table rows, so their masked ride-along writes land in
-        the scratch block."""
+        the scratch block. ``paged_kernel=True`` rides into the cache
+        dicts as the static ``use_kernel`` flag — a different trace, not
+        a different operand; with the flag off this body is byte-for-byte
+        the pre-kernel trace (``**{}`` adds nothing)."""
         model, sample = self.model, self._sample
+        extra = {"use_kernel": True} if self.paged_kernel else {}
 
         def slot_sample(lg, key):
             nxt, key = sample(lg[None], key)
@@ -669,7 +696,8 @@ class ServingEngine:
 
         def body(params, store, table, tokens, pos, active, keys):
             with annotate("chainermn.decode"):
-                caches = [dict(layer, table=table) for layer in store]
+                caches = [dict(layer, table=table, **extra)
+                          for layer in store]
                 lg, new_store = model.apply(params, tokens[:, None],
                                             pos[:, None], kv_caches=caches)
                 lg = lg[:, 0]
@@ -695,10 +723,11 @@ class ServingEngine:
         rewritten before any query attends it."""
         model = self.model
         window = self._spec.k + 1
+        extra = {"use_kernel": True} if self.paged_kernel else {}
 
         def body(params, store, table, tokens, pos, valid, active):
             with annotate("chainermn.spec_verify"):
-                caches = [dict(layer, table=table, valid=valid)
+                caches = [dict(layer, table=table, valid=valid, **extra)
                           for layer in store]
                 posm = pos[:, None] + jnp.arange(window)[None, :]
                 lg, new_store = model.apply(params, tokens, posm,
@@ -723,6 +752,7 @@ class ServingEngine:
         scheduler's retirement anyway)."""
         model, sample = self.model, self._sample
         cache_len = self.cache_len
+        extra = {"use_kernel": True} if self.paged_kernel else {}
 
         def slot_sample(lg, key):
             nxt, key = sample(lg[None], key)
@@ -734,7 +764,8 @@ class ServingEngine:
                     store, tok, keys, out = carry
                     p = pos + i
                     valid = (active & (p < cache_len)).astype(jnp.int32)
-                    caches = [dict(layer, table=table, valid=valid)
+                    caches = [dict(layer, table=table, valid=valid,
+                                   **extra)
                               for layer in store]
                     lg, store = model.apply(params, tok[:, None],
                                             p[:, None], kv_caches=caches)

@@ -138,6 +138,7 @@ PINNED_EVENTS = frozenset({
     "kv_append",
     "kv_preempt",
     "lock_contended",
+    "paged_kernel_fallback",
     "prefill",
     "prefix_evict",
     "prefix_insert",

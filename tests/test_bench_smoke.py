@@ -121,6 +121,22 @@ def test_bench_serving_mode_smoke():
     assert pg["recompiles_after_warmup"] == 0
     assert pg["preemptions"] == 0
     assert pg["kv_blocks_per_request_mean"] >= 1.0
+    # ---- the PR-14 fused paged-decode kernel (acceptance criterion) -- #
+    kn = rec["paged_kernel_serving"]
+    # on the CPU mesh the kernel runs in Pallas interpret mode, so the
+    # record is parity/recompile EVIDENCE; the tokens/s pair is only a
+    # performance claim on real hardware (asserted by the driver there)
+    assert kn["kernel_used"] is True
+    assert kn["kernel_supported"] is True
+    assert kn["interpret_mode"] is True        # this suite runs on CPU
+    assert kn["parity_vs_xla_and_solo"] is True
+    assert kn["recompiles_after_warmup"] == 0
+    assert kn["tokens_per_sec"] > 0 and kn["tokens_per_sec_off"] > 0
+    brm = kn["bytes_read_model"]
+    # the analytical read model must show the kernel streaming strictly
+    # fewer bytes than the XLA dense-view gather on this ragged workload
+    assert brm["kernel_bytes"] < brm["xla_bytes"]
+    assert brm["read_amplification"] > 1.0
     # ---- the PR-12 speculative decode (acceptance criterion) --------- #
     sp = rec["speculative_serving"]
     assert sp["drafter"] == "ngram"
