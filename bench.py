@@ -674,6 +674,82 @@ def serving_main() -> None:
             "recompiles": engine.compile_counts(),
         }
 
+        # ---- continuous telemetry: collector ON vs OFF, warm engine --- #
+        # ISSUE 15 acceptance: the background collector + detector graph
+        # must cost <2% of serving throughput. The SAME job list runs
+        # twice through fresh schedulers on the already-warm engine — OFF
+        # first, then ON with a Collector sampling every registry
+        # instrument at ts_cadence plus the standard per-instance sensor
+        # set and a HealthMonitor — and the record carries the overhead
+        # fraction, ON-vs-OFF token parity, the zero-recompile invariant,
+        # and the health verdict the run ended on.
+        from chainermn_tpu.monitor.health import (
+            HealthMonitor,
+            standard_replica_sensors,
+        )
+        from chainermn_tpu.monitor.timeseries import Collector
+
+        ts_cadence = float(e("CHAINERMN_TPU_SERVE_TS_CADENCE", "0.05"))
+        ts_jobs = [
+            (rng.randint(1, vocab,
+                         rng.randint(1, prefill_len + 1)).astype(np.int32),
+             int(rng.randint(1, max_new + 1)))
+            for _ in range(n_requests)
+        ]
+        ts_counts = engine.compile_counts_detailed()
+
+        def run_ts_workload(ts_on):
+            s = FCFSScheduler(engine)
+            col = mon = None
+            if ts_on:
+                col = Collector(cadence_s=ts_cadence)
+                sigs, dets = standard_replica_sensors(
+                    s.metrics.instance, stall_timeout_s=60.0, tag="bench")
+                for sg in sigs:
+                    col.add_signal(sg)
+                for dt in dets:
+                    col.add_detector(dt)
+                mon = HealthMonitor(store=col.store)
+                mon.watch(s.metrics.instance, detectors=dets)
+                col.attach_health(mon)
+                s.metrics.attach_health(
+                    lambda m=mon, k=s.metrics.instance: m.score_json(k))
+                col.start()
+            t0 = time.time()
+            reqs = [s.submit(p, n) for p, n in ts_jobs]
+            s.run_until_idle()
+            wall = time.time() - t0
+            if col is not None:
+                col.stop()
+            return s, reqs, wall, col, mon
+
+        s_off, reqs_off, wall_ts_off, _, _ = run_ts_workload(False)
+        s_ts, reqs_ts, wall_ts_on, ts_col, ts_mon = run_ts_workload(True)
+        ts_parity = all(
+            bool(np.array_equal(a.output, b.output))
+            for a, b in zip(reqs_ts, reqs_off))
+        assert engine.compile_counts_detailed() == ts_counts, "recompiled!"
+        m_ts = s_ts.metrics.report()
+        record["telemetry_serving"] = {
+            "cadence_s": ts_cadence,
+            "wall_s_on": round(wall_ts_on, 3),
+            "wall_s_off": round(wall_ts_off, 3),
+            "overhead_frac": round(
+                wall_ts_on / max(wall_ts_off, 1e-9) - 1.0, 4),
+            "tokens_per_sec_on": s_ts.metrics.report()["tokens_per_sec"],
+            "tokens_per_sec_off": s_off.metrics.report()["tokens_per_sec"],
+            "parity_on_vs_off": ts_parity,
+            "recompiles_after_warmup": 0,
+            "n_series": len(ts_col.store.names()),
+            "ticks": ts_col.ticks,
+            "health": m_ts.get("health"),
+            "worst_state": ts_mon.report()["worst"],
+        }
+        ts_rec = record["telemetry_serving"]
+        log(f"telemetry serving: overhead={ts_rec['overhead_frac']} "
+            f"({ts_rec['ticks']} ticks over {ts_rec['n_series']} series), "
+            f"health={ts_rec['worst_state']}, parity={ts_parity}")
+
         # ---- prefix-heavy workload: shared system prompt, mixed tails - #
         # The admission fast path's acceptance numbers (ISSUE 5): the SAME
         # workload runs twice through bucketed batched-prefill engines —
@@ -1146,8 +1222,17 @@ def serving_main() -> None:
             prefix_block_size=block, prefix_min_insert_blocks=min_insert)
             for _ in range(fl_n)]
         router = FleetRouter(fl_engines, affinity=True)
+        fl_col = None
         try:
             assert router.wait_ready(600), "fleet warmup timed out"
+            # continuous telemetry rides the fleet run too (ISSUE 15):
+            # per-replica sensors + health scoring + routing penalty,
+            # sampled by a background collector for the whole probe
+            from chainermn_tpu.monitor.health import fleet_health
+
+            fl_col = fleet_health(router, cadence_s=ts_cadence,
+                                  stall_timeout_s=60.0)
+            fl_col.start()
             t0 = time.time()
             frs = [router.submit(prompt, n) for prompt, n in jobs]
             kill_deadline = time.time() + 60
@@ -1161,6 +1246,16 @@ def serving_main() -> None:
             router.kill_replica(0)
             finished = [fr.wait(timeout=600) for fr in frs]
             wall_fl = time.time() - t0
+            # the health verdict is scored on the collector cadence: give
+            # it a bounded window to observe the quarantine before the
+            # report is captured (deterministic, not sleep-and-hope)
+            h_deadline = time.time() + 30
+            while time.time() < h_deadline:
+                h = router.fleet_report().get("health") or {}
+                if h.get("replicas", {}).get("0", {}).get(
+                        "state") == "critical":
+                    break
+                time.sleep(ts_cadence)
             rep = router.fleet_report()
             fl_parity = True
             for i in (0, 1):
@@ -1209,6 +1304,11 @@ def serving_main() -> None:
                     sum(r.engine.recompiles.values()) for r in survivors),
                 "replica_states": {k: v["state"]
                                    for k, v in rep["replicas"].items()},
+                # the health monitor's verdicts at probe end: the killed
+                # replica must have gone critical, survivors healthy
+                "health": rep.get("health"),
+                "ts_series": len(fl_col.store.names()),
+                "ts_ticks": fl_col.ticks,
             }
             # rolling publish through the surviving replicas: the
             # quarantined kill-probe victim is skipped, everyone still
@@ -1225,6 +1325,8 @@ def serving_main() -> None:
                     sum(r.engine.recompiles.values()) for r in survivors),
             }
         finally:
+            if fl_col is not None:
+                fl_col.stop()
             router.close()
         fl = record["fleet_serving"]
         log(f"fleet serving: {fl['replicas']}x{fl['slots_per_replica']} "

@@ -167,6 +167,13 @@ class EngineReplica:
         out of the fleet)."""
         return self._state in (ReplicaState.STARTING, ReplicaState.HEALTHY)
 
+    @property
+    def busy(self) -> bool:
+        """Work queued or decoding right now — the decode-stall deadman's
+        ``active_fn`` gate (an idle replica not minting tokens is fine; a
+        busy one not minting tokens is stalled)."""
+        return self.scheduler.has_work
+
     def start(self) -> None:
         if not self._thread.is_alive() and not self._stop.is_set():
             self._thread.start()

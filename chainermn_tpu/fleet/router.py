@@ -262,6 +262,11 @@ class FleetRouter:
         # work away from them (unless nothing else is healthy)
         self._publishing: set[int] = sanitizer.guarded(
             set(), lock=self._lock, name="FleetRouter._publishing")
+        # optional HealthMonitor (attach_health): routing reads its
+        # per-replica verdict as the leading sort key — degraded
+        # replicas are deprioritized before the supervisor would
+        # quarantine them
+        self._health = None
         self.replicas = [
             EngineReplica(i, eng, on_failure=self._on_replica_failure,
                           labels=labels, autostart=autostart,
@@ -297,6 +302,15 @@ class FleetRouter:
         """Hard-kill one replica (poison -> quarantine; its work is
         re-routed) — the continuity probe's entry point."""
         self.replicas[replica_id].kill()
+
+    def attach_health(self, monitor) -> None:
+        """Attach a :class:`~chainermn_tpu.monitor.health.HealthMonitor`
+        (usually via :func:`~chainermn_tpu.monitor.health.fleet_health`):
+        every routing decision then carries the monitor's per-replica
+        verdict as the leading sort key, and :meth:`fleet_report` gains a
+        ``health`` block. Detach with ``attach_health(None)``."""
+        with self._lock:
+            self._health = monitor
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop every replica thread and settle every outstanding request
@@ -337,7 +351,7 @@ class FleetRouter:
         with self._lock:
             if self._closed:
                 raise RuntimeError("fleet router is closed")
-            snaps = [r.snapshot() for r in self.replicas]
+            snaps = self._snapshots_locked()
             if not any(s.healthy for s in snaps):
                 raise RuntimeError(
                     "no replica accepting work (all quarantined/stopped)")
@@ -388,6 +402,18 @@ class FleetRouter:
     # ------------------------------------------------------------------ #
     # routing internals                                                   #
     # ------------------------------------------------------------------ #
+
+    def _snapshots_locked(self) -> list:
+        """Occupancy snapshots of every replica, annotated with the
+        attached health monitor's verdict (0 when none is attached).
+        ``HealthMonitor._lock`` is a sanitizer leaf lock — reading the
+        cached level while holding the router lock acquires nothing
+        further, so no lock-order edge exists here."""
+        snaps = [r.snapshot() for r in self.replicas]
+        if self._health is not None:
+            for s in snaps:
+                s.health = self._health.level(str(s.replica_id))
+        return snaps
 
     def _route_locked(self, prompt, snaps, exclude: Optional[int] = None
                ) -> RouteDecision:
@@ -533,7 +559,7 @@ class FleetRouter:
                     f"fleet request {fr.id} hit its {fr.deadline_s}s "
                     "deadline during replica failover"))
                 return
-            snaps = [r.snapshot() for r in self.replicas]
+            snaps = self._snapshots_locked()
             if (fr.reroutes >= self.max_reroutes
                     or not any(s.healthy for s in snaps)):
                 self._finalize_locked(fr, st, err)
@@ -599,7 +625,7 @@ class FleetRouter:
                         f"fleet request {fr.id} hit its {fr.deadline_s}s "
                         "deadline during replica failover"))
                 return
-            snaps = [r.snapshot() for r in self.replicas]
+            snaps = self._snapshots_locked()
             if (fr.reroutes >= self.max_reroutes
                     or not any(s.healthy for s in snaps)):
                 failure = EngineFailed(
@@ -753,7 +779,11 @@ class FleetRouter:
             [r.metrics.payload() for r in self.replicas])
         hits = int(self._c_aff_hits.value)
         misses = int(self._c_aff_miss.value)
+        with self._lock:
+            hm = self._health
+        health = hm.report() if hm is not None else None
         return {
+            "health": health,
             "replicas": replicas,
             "capacity": self.capacity,
             "n_replicas": len(self.replicas),

@@ -44,7 +44,11 @@ class ReplicaSnapshot:
     compare fairly. ``ttft_ewma_s`` breaks load ties toward the replica
     that has recently been fast; ``kv_free_frac`` lets a paged replica
     running low on blocks shed affinity traffic before it starts
-    preempting."""
+    preempting. ``health`` is the telemetry verdict (0 healthy /
+    1 degraded / 2 critical, from :class:`~chainermn_tpu.monitor.health.
+    HealthMonitor` when the router has one attached): it outranks load,
+    so a degraded replica is deprioritized while it can still serve —
+    the step *before* the supervisor would quarantine it."""
 
     replica_id: int
     healthy: bool = True
@@ -53,6 +57,7 @@ class ReplicaSnapshot:
     n_slots: int = 1
     ttft_ewma_s: float = 0.0
     kv_free_frac: float = 1.0
+    health: int = 0
 
     @property
     def load(self) -> float:
@@ -98,9 +103,11 @@ class RoutingPolicy:
 
     @staticmethod
     def _key(snap: ReplicaSnapshot) -> tuple:
-        # deterministic total order: load, then recent speed, then id —
-        # equal-load equal-speed replicas always resolve to the lowest id
-        return (snap.load, snap.ttft_ewma_s, snap.replica_id)
+        # deterministic total order: health verdict first (a degraded
+        # replica loses to ANY healthy one regardless of load), then
+        # load, then recent speed, then id — equal replicas always
+        # resolve to the lowest id
+        return (snap.health, snap.load, snap.ttft_ewma_s, snap.replica_id)
 
     def least_loaded(self, snapshots: Sequence[ReplicaSnapshot]
                      ) -> Optional[ReplicaSnapshot]:
@@ -124,6 +131,7 @@ class RoutingPolicy:
                            if s.replica_id == affinity_replica and s.healthy),
                           None)
             if (holder is not None
+                    and holder.health <= base.health
                     and holder.kv_free_frac >= self.min_kv_free_frac
                     and holder.load - base.load <= self.max_imbalance):
                 return RouteDecision(holder.replica_id, affinity_hit=True,

@@ -36,9 +36,19 @@ four pillars:
   multi-window burn rates; breaches emit flight-recorder events naming
   the offending trace ids, and ``slo_burn_rate`` gauges pool fleet-wide
   through :func:`aggregate`.
+- **Continuous telemetry** (:class:`TimeSeriesStore` / :class:`Collector`
+  / :class:`HealthMonitor`): a fixed-cadence collector samples every
+  registry instrument into bounded ring-buffer series (counters as
+  rates, histograms as windowed p50/p99), a declarative derived-signal
+  graph (:class:`Rate` / :class:`EWMA` / :class:`Ratio` /
+  :class:`WindowPercentile`) feeds edge-triggered detectors (z-score
+  drift, thresholds, decode-stall deadman), and detector states compose
+  into per-replica ``healthy``/``degraded``/``critical`` scores the
+  fleet router consults as a routing penalty (:func:`fleet_health`).
 - **Scrape endpoint** (:func:`chainermn_tpu.monitor.http.serve`):
   stdlib-only background HTTP server exposing ``/metrics`` (Prometheus
-  text), ``/traces`` (Chrome JSON), ``/slo``, and ``/events``.
+  text), ``/traces`` (Chrome JSON), ``/slo``, ``/events``,
+  ``/timeseries``, and ``/health``.
 
 The per-step hot-path cost is a few dict/deque operations (<2% step time
 even on millisecond CPU steps — asserted by ``bench.py --mode monitor``);
@@ -62,6 +72,12 @@ from __future__ import annotations
 from chainermn_tpu.monitor._state import get_event_log, get_registry
 from chainermn_tpu.monitor.annotations import annotate
 from chainermn_tpu.monitor.events import EventLog, device_memory_lines
+from chainermn_tpu.monitor.health import (
+    HealthMonitor,
+    HealthScore,
+    fleet_health,
+    standard_replica_sensors,
+)
 from chainermn_tpu.monitor.instrument import (
     MonitoredFunction,
     RecompileGuard,
@@ -80,6 +96,18 @@ from chainermn_tpu.monitor.slo import (
     LatencyObjective,
     SLOEngine,
     get_slo_engine,
+)
+from chainermn_tpu.monitor.timeseries import (
+    Collector,
+    DeadmanDetector,
+    Detector,
+    EWMA,
+    Rate,
+    Ratio,
+    ThresholdDetector,
+    TimeSeriesStore,
+    WindowPercentile,
+    ZScoreDetector,
 )
 from chainermn_tpu.monitor.trace import Span, Trace, Tracer, get_tracer
 from chainermn_tpu.monitor import http  # noqa: F401 — monitor.http.serve
@@ -111,24 +139,37 @@ def aggregate(comm) -> dict:
 
 
 __all__ = [
+    "Collector",
     "Counter",
+    "DeadmanDetector",
+    "Detector",
+    "EWMA",
     "ErrorRateObjective",
     "EventLog",
     "Gauge",
+    "HealthMonitor",
+    "HealthScore",
     "Histogram",
     "LatencyObjective",
     "MetricsRegistry",
     "MonitoredFunction",
+    "Rate",
+    "Ratio",
     "RecompileGuard",
     "SLOEngine",
     "Span",
+    "ThresholdDetector",
+    "TimeSeriesStore",
     "Trace",
     "Tracer",
+    "WindowPercentile",
+    "ZScoreDetector",
     "aggregate",
     "annotate",
     "device_memory_lines",
     "emit",
     "exposition",
+    "fleet_health",
     "get_event_log",
     "get_registry",
     "get_slo_engine",
@@ -138,4 +179,5 @@ __all__ = [
     "merge_rank_payloads",
     "record_memory_gauges",
     "snapshot",
+    "standard_replica_sensors",
 ]

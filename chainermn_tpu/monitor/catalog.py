@@ -93,6 +93,11 @@ METRIC_NAMES = frozenset({
     "slo_breaches_total",
     "slo_burn_rate",
     "slo_compliant",
+    # continuous telemetry (time-series collector + health scoring)
+    "detector_state",
+    "health_state",
+    "ts_collect_lag_seconds",
+    "ts_samples_total",
     # concurrency sanitizer
     "lock_hold_seconds",
 })
@@ -154,6 +159,10 @@ EVENT_KINDS = frozenset({
     "weight_swap",
     # SLO
     "slo_breach",
+    # continuous telemetry (detector edges + health transitions)
+    "detector_cleared",
+    "detector_fired",
+    "health_changed",
     # concurrency sanitizer
     "lock_contended",
 })

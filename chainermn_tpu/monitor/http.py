@@ -12,6 +12,14 @@ browser, ``curl``, a future fleet router polling replica burn rates:
   evaluate` pass as JSON (scraping IS the periodic evaluation driver);
 - ``/events``  — the flight-recorder tail as JSON (``?last=N``, default
   64);
+- ``/timeseries`` — the continuous-telemetry ring buffers
+  (:class:`~chainermn_tpu.monitor.timeseries.TimeSeriesStore`) as JSON
+  when a store/collector was passed to :func:`serve`; ``?last=N``
+  bounds points per series (default 128), ``?prefix=`` filters series
+  by name;
+- ``/health``  — per-replica :class:`~chainermn_tpu.monitor.health.
+  HealthMonitor` scores (``healthy``/``degraded``/``critical`` with
+  contributing signals) when a monitor was passed to :func:`serve`;
 - ``/fleet``   — the serving fleet's :meth:`~chainermn_tpu.fleet.router.
   FleetRouter.fleet_report` as JSON (replica states, reroute/shed
   counters, affinity hit rate, fleet-pooled latency percentiles) when a
@@ -45,12 +53,16 @@ class MonitorServer:
     """Owns the background HTTP server; build via :func:`serve`."""
 
     def __init__(self, host: str, port: int, *, registry, events, tracer,
-                 slo, fleet=None) -> None:
+                 slo, fleet=None, timeseries=None, health=None) -> None:
         self._registry = registry
         self._events = events
         self._tracer = tracer
         self._slo = slo
         self._fleet = fleet
+        # a Collector is accepted where a TimeSeriesStore is expected —
+        # the scrape serves the collector's store either way
+        self._timeseries = getattr(timeseries, "store", timeseries)
+        self._health = health
         owner = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -111,14 +123,29 @@ class MonitorServer:
                        if self._fleet is not None else {})
             return (200, "application/json",
                     json.dumps(payload, default=str).encode())
+        if route == "/timeseries":
+            last = int(q.get("last", ["128"])[0])
+            prefix = q.get("prefix", [None])[0]
+            payload = (self._timeseries.to_json(last=last, prefix=prefix)
+                       if self._timeseries is not None else {})
+            return (200, "application/json",
+                    json.dumps(payload, default=str).encode())
+        if route == "/health":
+            payload = (self._health.report()
+                       if self._health is not None else {})
+            return (200, "application/json",
+                    json.dumps(payload, default=str).encode())
         if route == "/":
             index = ("chainermn_tpu monitor\n"
-                     "  /metrics  Prometheus text exposition\n"
-                     "  /traces   Chrome trace-event JSON (?kind=)\n"
-                     "  /slo      SLO burn-rate evaluation\n"
-                     "  /events   flight-recorder tail (?last=N)\n"
-                     "  /fleet    serving-fleet report (replica states, "
-                     "pooled percentiles)\n")
+                     "  /metrics     Prometheus text exposition\n"
+                     "  /traces      Chrome trace-event JSON (?kind=)\n"
+                     "  /slo         SLO burn-rate evaluation\n"
+                     "  /events      flight-recorder tail (?last=N)\n"
+                     "  /fleet       serving-fleet report (replica "
+                     "states, pooled percentiles)\n"
+                     "  /timeseries  telemetry ring buffers "
+                     "(?last=N&prefix=)\n"
+                     "  /health      per-replica health scores\n")
             return 200, "text/plain; charset=utf-8", index.encode()
         return 404, "text/plain; charset=utf-8", b"not found\n"
 
@@ -141,14 +168,20 @@ class MonitorServer:
 
 
 def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
-          events=None, tracer=None, slo=None, fleet=None) -> MonitorServer:
+          events=None, tracer=None, slo=None, fleet=None,
+          timeseries=None, health=None) -> MonitorServer:
     """Stand up the scrape endpoint on a background thread and return the
     running :class:`MonitorServer` (``.port`` carries the bound port when
     ``port=0``). Defaults wire the process-wide registry, flight
     recorder, tracer, and SLO engine; pass private instances for
     isolation (tests), and a :class:`~chainermn_tpu.fleet.router.
     FleetRouter` as ``fleet=`` to light up ``/fleet`` (there is no
-    process-wide default router — fleets are explicitly owned). Close
+    process-wide default router — fleets are explicitly owned). Likewise
+    ``timeseries=`` (a :class:`~chainermn_tpu.monitor.timeseries.
+    TimeSeriesStore` or :class:`~chainermn_tpu.monitor.timeseries.
+    Collector`) lights up ``/timeseries`` and ``health=`` (a
+    :class:`~chainermn_tpu.monitor.health.HealthMonitor`) lights up
+    ``/health`` — continuous telemetry is explicitly owned too. Close
     with :meth:`MonitorServer.close` (also a context manager)."""
     if registry is None:
         registry = get_registry()
@@ -163,7 +196,8 @@ def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
 
         slo = get_slo_engine()
     return MonitorServer(host, port, registry=registry, events=events,
-                         tracer=tracer, slo=slo, fleet=fleet)
+                         tracer=tracer, slo=slo, fleet=fleet,
+                         timeseries=timeseries, health=health)
 
 
 __all__ = ["MonitorServer", "serve"]

@@ -119,6 +119,10 @@ class ServingMetrics:
         # breakdown — the "where did the p99 go" exhibit in report()
         self._labels = labels
         self._worst_trace: Optional[dict] = None
+        # continuous-telemetry hook (attach_health): a zero-arg callable
+        # returning this instance's current HealthScore as a JSON dict;
+        # report() embeds it so the health verdict rides every record
+        self._health_fn = None
 
     # ------------------------------------------------------------------ #
     # recording (scheduler-driven)                                        #
@@ -227,6 +231,21 @@ class ServingMetrics:
     # ------------------------------------------------------------------ #
     # reporting                                                           #
     # ------------------------------------------------------------------ #
+
+    @property
+    def instance(self) -> str:
+        """This scheduler's ``instance=`` label value — the key the
+        continuous-telemetry collector uses to find this instance's
+        series in the shared registry."""
+        return self._labels["instance"]
+
+    def attach_health(self, fn) -> None:
+        """Attach a zero-arg callable returning the current
+        :class:`~chainermn_tpu.monitor.health.HealthScore` JSON for this
+        instance (wired by :func:`~chainermn_tpu.monitor.health.
+        fleet_health`); :meth:`report` then carries a ``health`` block.
+        Detach with ``attach_health(None)``."""
+        self._health_fn = fn
 
     @property
     def requests_submitted(self) -> int:
@@ -363,6 +382,11 @@ class ServingMetrics:
             # the slowest traced request's full phase attribution — the
             # compact "where the p99 TTFT went" answer, per trace
             out["critical_path"] = self._worst_trace
+        if self._health_fn is not None:
+            try:
+                out["health"] = self._health_fn()
+            except Exception as e:  # noqa: BLE001 — reporting never raises
+                out["health"] = {"error": f"{type(e).__name__}: {e}"}
         if sanitizer.enabled():
             # lock-hold / contention accounting (sanitizer runs only):
             # which lock the serving path actually spends its time in

@@ -8,6 +8,14 @@ background client thread), and pushes a burst of ragged random prompts
 through it: some blocking, one streamed token-by-token. Prints the serving
 metrics (TTFT/TPOT percentiles, tokens/s, slot occupancy) at the end.
 
+And the continuous-telemetry demo (ISSUE 15): ``--health`` runs a
+background collector sampling every registry instrument into ring-buffer
+time series at ``--ts-cadence``, scores each replica
+healthy/degraded/critical through the standard detector set (TTFT p99
+drift, queue-depth threshold, decode-stall deadman), prints the verdict
+at the end, and — with ``--http-port`` — serves live ``/timeseries`` and
+``/health`` JSON it then scrapes back over the real socket.
+
 Also the telemetry demo: the burst runs inside a
 :func:`chainermn_tpu.monitor.annotate` profiler scope (capture with
 ``jax.profiler.trace`` and the span shows up named in XProf/Perfetto),
@@ -238,6 +246,19 @@ def main() -> None:
                     help="serve the monitor scrape endpoints (/metrics "
                          "/traces /slo /events) on this port for the "
                          "duration of the burst (0: ephemeral; -1: off)")
+    ap.add_argument("--health", action="store_true",
+                    help="continuous telemetry (ISSUE 15): a background "
+                         "collector samples every registry instrument "
+                         "into ring-buffer time series, the standard "
+                         "detector set (TTFT drift, queue threshold, "
+                         "decode-stall deadman) scores each replica "
+                         "healthy/degraded/critical, and the verdict "
+                         "prints at the end; with --http-port the "
+                         "/timeseries and /health endpoints serve live "
+                         "JSON")
+    ap.add_argument("--ts-cadence", type=float, default=0.05,
+                    help="collector sampling cadence in seconds "
+                         "(--health)")
     args = ap.parse_args()
 
     comm = chainermn_tpu.create_communicator("tpu") if args.tensor_parallel \
@@ -343,6 +364,36 @@ def main() -> None:
                               max_queue=args.max_queue or None,
                               default_deadline_s=args.deadline or None)
 
+    collector = None
+    if args.health:
+        from chainermn_tpu.monitor.health import (
+            HealthMonitor,
+            fleet_health,
+            standard_replica_sensors,
+        )
+        from chainermn_tpu.monitor.timeseries import Collector
+
+        if fleet_mode:
+            # per-replica sensors + lifecycle probes + routing penalty,
+            # wired in one call
+            collector = fleet_health(front, cadence_s=args.ts_cadence,
+                                     stall_timeout_s=30.0)
+        else:
+            collector = Collector(cadence_s=args.ts_cadence)
+            inst = front.metrics.instance
+            sigs, dets = standard_replica_sensors(
+                inst, stall_timeout_s=30.0, tag="0")
+            for sg in sigs:
+                collector.add_signal(sg)
+            for dt in dets:
+                collector.add_detector(dt)
+            health_mon = HealthMonitor(store=collector.store)
+            health_mon.watch("0", detectors=dets)
+            collector.attach_health(health_mon)
+            front.metrics.attach_health(
+                lambda m=health_mon: m.score_json("0"))
+        collector.start()
+
     monitor.get_tracer().configure(sample=args.trace)
     slo_engine = None
     if args.slo_ttft_ms:
@@ -352,10 +403,13 @@ def main() -> None:
             threshold_s=args.slo_ttft_ms / 1e3, windows=(30.0, 120.0)))
     server = None
     if args.http_port >= 0:
-        server = monitor.http.serve(port=args.http_port, slo=slo_engine,
-                                    fleet=front if fleet_mode else None)
+        server = monitor.http.serve(
+            port=args.http_port, slo=slo_engine,
+            fleet=front if fleet_mode else None,
+            timeseries=collector,
+            health=collector.health if collector is not None else None)
         print(f"monitor endpoints at {server.url} "
-              "(/metrics /traces /slo /events /fleet)")
+              "(/metrics /traces /slo /events /fleet /timeseries /health)")
     shared = (rng.randint(2, args.vocab, args.shared_prefix)
               .astype(np.int32) if args.shared_prefix else
               np.zeros((0,), np.int32))
@@ -474,6 +528,30 @@ def main() -> None:
         tracer.export_chrome(args.trace_out)
         print(f"wrote {n} trace(s) to {args.trace_out} "
               "(load in chrome://tracing or ui.perfetto.dev)")
+    if collector is not None:
+        collector.stop()
+        hm = collector.health
+        hrep = hm.report() if hm is not None else {}
+        print(f"health: worst={hrep.get('worst')} over "
+              f"{hrep.get('n_watched', 0)} replica(s), "
+              f"{len(collector.store.names())} series, "
+              f"{collector.ticks} ticks")
+        for key, score in sorted(hrep.get("replicas", {}).items()):
+            print(f"  replica {key}: {score['state']} "
+                  f"(contributing: {score['contributing'] or 'none'})")
+        if server is not None:
+            # scrape our own endpoints over the real socket — the same
+            # JSON any external prober would see
+            import json as _json
+            from urllib.request import urlopen
+
+            with urlopen(f"{server.url}/health", timeout=10) as r:
+                scraped = _json.loads(r.read())
+            with urlopen(f"{server.url}/timeseries?last=8",
+                         timeout=10) as r:
+                ts_scraped = _json.loads(r.read())
+            print(f"scraped /health: worst={scraped.get('worst')}; "
+                  f"/timeseries: {ts_scraped.get('n_series', 0)} series")
     if server is not None:
         server.close()
     if args.prometheus:

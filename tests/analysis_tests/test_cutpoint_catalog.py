@@ -47,6 +47,7 @@ PINNED_METRICS = frozenset({
     "deploy_swap_failures_total",
     "deploy_swap_seconds",
     "deploy_swaps_total",
+    "detector_state",
     "device_bytes_in_use",
     "device_peak_bytes_in_use",
     "dispatch_inflight",
@@ -60,6 +61,7 @@ PINNED_METRICS = frozenset({
     "fleet_reroutes_total",
     "fleet_route_fallbacks_total",
     "fleet_shed_total",
+    "health_state",
     "kv_block_appends_total",
     "kv_blocks_free",
     "kv_blocks_in_use",
@@ -111,6 +113,8 @@ PINNED_METRICS = frozenset({
     "trainer_failures_total",
     "trainer_mttr_seconds",
     "trainer_restores_total",
+    "ts_collect_lag_seconds",
+    "ts_samples_total",
 })
 
 PINNED_EVENTS = frozenset({
@@ -122,6 +126,8 @@ PINNED_EVENTS = frozenset({
     "checkpoint_save_async_enqueued",
     "compile",
     "decode_step",
+    "detector_cleared",
+    "detector_fired",
     "engine_error",
     "engine_restart",
     "fault_injected",
@@ -134,6 +140,7 @@ PINNED_EVENTS = frozenset({
     "fleet_shed",
     "fleet_spawn",
     "fleet_spawn_restore",
+    "health_changed",
     "kv_admit_defer",
     "kv_append",
     "kv_preempt",

@@ -233,6 +233,44 @@ def test_serve_lm_fleet():
     assert "affinity_hit_rate" in proc.stdout
 
 
+@pytest.mark.slow  # another multi-second subprocess run: full-suite only, to keep tier-1 inside its timeout
+def test_serve_lm_health_endpoints():
+    """ISSUE 15: ``--health`` runs the background collector + health
+    scoring over the serving run, prints the end-of-run verdict, and the
+    demo's self-scrape proves /health and /timeseries serve live JSON
+    over a real socket (ephemeral --http-port 0)."""
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "6", "--slots", "2", "--max-new", "6",
+         "--prefill-len", "8", "--d-model", "32", "--layers", "1",
+         "--heads", "4", "--health", "--ts-cadence", "0.05",
+         "--http-port", "0"],
+    )
+    assert "6/6 requests served" in proc.stdout
+    assert "health: worst=healthy over 1 replica(s)" in proc.stdout
+    assert "replica 0: healthy" in proc.stdout
+    assert "scraped /health: worst=healthy" in proc.stdout
+    assert "/timeseries:" in proc.stdout
+    assert "zero recompiles" in proc.stdout
+
+
+@pytest.mark.slow  # another multi-second subprocess run: full-suite only, to keep tier-1 inside its timeout
+def test_serve_lm_health_fleet():
+    """ISSUE 15 + ISSUE 8: the same telemetry pipeline over a 2-replica
+    fleet — fleet_health wires per-replica sensors and the router's
+    routing penalty; both replicas end the run scored healthy."""
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "8", "--slots", "2", "--replicas", "2",
+         "--max-new", "6", "--prefill-len", "8", "--d-model", "32",
+         "--layers", "1", "--heads", "4", "--health"],
+    )
+    assert "8/8 requests served" in proc.stdout
+    assert "health: worst=healthy over 2 replica(s)" in proc.stdout
+    assert "replica 0: healthy" in proc.stdout
+    assert "replica 1: healthy" in proc.stdout
+
+
 @pytest.mark.slow  # two more multi-second subprocess runs: full-suite only, to keep tier-1 inside its timeout
 def test_train_lm_publish_to_engine():
     """ISSUE 10: the online train→serve loop — a live engine comes up

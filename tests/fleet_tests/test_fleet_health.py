@@ -1,0 +1,172 @@
+"""Fleet chaos acceptance for the continuous-telemetry pipeline
+(ISSUE 15): ``fleet_health`` wires one collector + monitor over a live
+2-replica router; a warm-killed replica scores healthy -> critical ->
+healthy with the router deprioritizing it WHILE critical (before any
+quarantine), and a hard kill latches critical for good. Collector ticks
+are hand-driven with explicit ``now`` so every verdict is
+deterministic."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.fleet import FleetRouter, ReplicaState
+from chainermn_tpu.models import TransformerLM
+from chainermn_tpu.monitor.health import CRITICAL, HEALTHY, fleet_health
+from chainermn_tpu.serving import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=1,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make_fleet(lm, params, **kw):
+    return FleetRouter(
+        [ServingEngine(lm, params, n_slots=2, prefill_len=6, cache_len=32)
+         for _ in range(2)], **kw)
+
+
+def _wait(pred, timeout=60.0, what="condition"):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _serve_one(router, prompt, n=3):
+    fr = router.submit(np.array(prompt, np.int32), n)
+    assert fr.wait(timeout=120)
+    return fr
+
+
+def test_fleet_chaos_health_drives_routing(lm_and_params):
+    """The acceptance path: warm kill -> one CRITICAL verdict (restart
+    latch) during which routing avoids the victim -> HEALTHY again;
+    then a fatal kill -> quarantine -> persistently CRITICAL."""
+    lm, params = lm_and_params
+    with make_fleet(lm, params, max_restarts=2) as router:
+        assert router.wait_ready(300)
+        col = fleet_health(router, stall_timeout_s=60.0)
+        mon = col.health
+        assert mon is not None and mon.keys == ["0", "1"]
+
+        # traffic so the sampled instruments exist, then the baseline
+        # tick: everything healthy, and health shows up in BOTH report
+        # surfaces (per-replica metrics + the fleet report)
+        _serve_one(router, [1, 2, 3])
+        _serve_one(router, [4, 5])
+        col.tick(now=1.0)
+        assert [mon.level(k) for k in ("0", "1")] == [0, 0]
+        rep = router.fleet_report()
+        assert rep["health"]["worst"] == HEALTHY
+        assert rep["health"]["n_watched"] == 2
+        m = router.replicas[0].metrics.report()
+        assert m["health"]["state"] == HEALTHY
+
+        # ---- warm restart: RuntimeError -> supervisor restarts -------- #
+        victim = router.replicas[0]
+        victim.kill(RuntimeError("chaos"))
+        _wait(lambda: victim.restarts == 1
+              and victim.state is ReplicaState.HEALTHY,
+              what="warm restart of replica 0")
+        s = mon.evaluate(now=2.0)["0"]          # the restart latch
+        assert s.state == CRITICAL
+        assert "replica_restart" in s.contributing
+        # the router consults health FIRST: while the latch holds, new
+        # work lands on the peer no matter the load ordering
+        fr = router.submit(np.array([9, 8, 7], np.int32), 2)
+        assert fr.replica_id == 1
+        assert router.fleet_report()["health"]["worst"] == CRITICAL
+        assert fr.wait(timeout=120)
+
+        # latch is one-shot: the next tick scores it healthy again and
+        # the replica is routable once more
+        col.tick(now=3.0)
+        assert mon.level("0") == 0
+        assert router.fleet_report()["health"]["worst"] == HEALTHY
+
+        # ---- fatal kill: quarantine, critical for good ---------------- #
+        victim.kill()                            # ReplicaKilled: no restart
+        _wait(lambda: victim.state is ReplicaState.QUARANTINED,
+              what="quarantine of replica 0")
+        for now in (4.0, 5.0):
+            s = mon.evaluate(now=now)["0"]
+            assert s.state == CRITICAL
+            assert s.contributing == ["replica_state"]
+            assert s.detail["replica_state"] == "quarantined"
+        rep = router.fleet_report()
+        assert rep["health"]["replicas"]["0"]["state"] == CRITICAL
+        assert rep["health"]["replicas"]["1"]["state"] == HEALTHY
+        # the survivor still serves
+        fr = _serve_one(router, [6, 7])
+        assert fr.replica_id == 1
+
+
+def test_fleet_health_collector_samples_replica_series(lm_and_params):
+    """The pooled store really carries per-replica series: both
+    replicas' token counters (and derived rates) appear after traffic +
+    two ticks, and ts_samples_total accounts for the samples."""
+    lm, params = lm_and_params
+    with make_fleet(lm, params) as router:
+        assert router.wait_ready(300)
+        col = fleet_health(router, stall_timeout_s=60.0)
+        for i in range(4):
+            _serve_one(router, [1 + i, 2 + i])
+        col.tick(now=1.0)
+        col.tick(now=2.0)
+        names = col.store.names()
+        insts = {r.metrics.instance for r in router.replicas}
+        assert len(insts) == 2
+        for inst in insts:
+            key = f'serving_tokens_total{{instance="{inst}"}}'
+            assert key in names
+            assert key + ":rate" in names
+        assert col.ticks == 2
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fleet_chaos_soak(lm_and_params, seed):
+    """3-seed soak: randomized victim/traffic order, same invariant —
+    every warm kill produces exactly one CRITICAL verdict for the victim
+    and full recovery, with the peer never leaving healthy."""
+    rng = np.random.default_rng(seed)
+    lm, params = lm_and_params
+    with make_fleet(lm, params, max_restarts=4) as router:
+        assert router.wait_ready(300)
+        col = fleet_health(router, stall_timeout_s=60.0)
+        mon = col.health
+        now = 1.0
+        col.tick(now=now)
+        for round_n in range(2):
+            for _ in range(int(rng.integers(1, 4))):
+                _serve_one(router, list(rng.integers(1, 16, size=2)),
+                           n=int(rng.integers(2, 5)))
+            vid = int(rng.integers(0, 2))
+            victim = router.replicas[vid]
+            peer = str(1 - vid)
+            before = victim.restarts
+            victim.kill(RuntimeError(f"soak-{seed}-{round_n}"))
+            _wait(lambda: victim.restarts == before + 1
+                  and victim.state is ReplicaState.HEALTHY,
+                  what=f"warm restart (seed={seed} round={round_n})")
+            now += 1.0
+            scores = mon.evaluate(now=now)
+            assert scores[str(vid)].state == CRITICAL
+            assert scores[peer].state == HEALTHY
+            now += 1.0
+            scores = mon.evaluate(now=now)
+            assert scores[str(vid)].state == HEALTHY
+        # the fleet still serves end-to-end after the soak
+        fr = _serve_one(router, [3, 1, 4])
+        assert fr.state.name == "DONE"

@@ -13,11 +13,11 @@ from chainermn_tpu.fleet.routing import (
 
 
 def snap(rid, *, healthy=True, queued=0, active=0, slots=4, ttft=0.0,
-         kv_free=1.0):
+         kv_free=1.0, health=0):
     return ReplicaSnapshot(replica_id=rid, healthy=healthy,
                            queue_depth=queued, active_slots=active,
                            n_slots=slots, ttft_ewma_s=ttft,
-                           kv_free_frac=kv_free)
+                           kv_free_frac=kv_free, health=health)
 
 
 # --------------------------------------------------------------------- #
@@ -189,3 +189,46 @@ def test_trie_bounded_nodes_evict_lru():
 def test_trie_rejects_bad_block_size():
     with pytest.raises(ValueError, match="block_size"):
         FleetTrie(block_size=0)
+
+
+# --------------------------------------------------------------------- #
+# health verdict as routing penalty (ISSUE 15)                           #
+# --------------------------------------------------------------------- #
+
+
+def test_health_outranks_load():
+    # a degraded replica loses to a busier healthy one — the telemetry
+    # verdict sorts before load in the placement key
+    p = RoutingPolicy()
+    d = p.route([snap(0, health=1), snap(1, queued=3, active=4)])
+    assert d.replica_id == 1
+    # critical loses to degraded the same way
+    d = p.route([snap(0, health=2), snap(1, health=1, queued=9)])
+    assert d.replica_id == 1
+
+
+def test_equal_health_falls_back_to_load():
+    p = RoutingPolicy()
+    d = p.route([snap(0, health=1, queued=2), snap(1, health=1)])
+    assert d.replica_id == 1
+
+
+def test_degraded_replica_still_routable_when_alone():
+    # deprioritized is not quarantined: with no healthier peer the
+    # degraded replica still serves
+    p = RoutingPolicy()
+    d = p.route([snap(0, health=2), snap(1, health=2, queued=5)])
+    assert d.replica_id == 0
+    assert p.route([snap(3, health=2)]).replica_id == 3
+
+
+def test_affinity_never_upgrades_to_a_sicker_holder():
+    p = RoutingPolicy(max_imbalance=10.0)
+    snaps = [snap(0, health=1), snap(1)]
+    # holder is degraded, base healthy: affinity loses
+    d = p.route(snaps, affinity_replica=0, affinity_blocks=8)
+    assert d.replica_id == 1 and not d.affinity_hit
+    # equally-healthy holder keeps the affinity win
+    snaps = [snap(0, health=1, queued=1), snap(1, health=1)]
+    d = p.route(snaps, affinity_replica=0, affinity_blocks=8)
+    assert d.replica_id == 0 and d.affinity_hit

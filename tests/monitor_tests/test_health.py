@@ -1,0 +1,154 @@
+"""HealthMonitor composition fixtures (ISSUE 15): severity folding,
+lifecycle mapping, the restart latch, edge-triggered ``health_changed``
+events, and the standard replica sensor set — all with injected clocks
+and hand-driven ``evaluate(now=)``."""
+
+import pytest
+
+from chainermn_tpu.monitor.events import EventLog
+from chainermn_tpu.monitor.health import (
+    CRITICAL,
+    DEGRADED,
+    HEALTHY,
+    HealthMonitor,
+    HealthScore,
+    standard_replica_sensors,
+)
+from chainermn_tpu.monitor.registry import MetricsRegistry
+from chainermn_tpu.monitor.timeseries import (
+    DeadmanDetector,
+    ThresholdDetector,
+    TimeSeriesStore,
+)
+
+
+def _mon():
+    reg = MetricsRegistry()
+    ev = EventLog()
+    store = TimeSeriesStore()
+    return reg, ev, store, HealthMonitor(registry=reg, events=ev,
+                                         store=store)
+
+
+def test_unwatched_and_unscored_keys_read_healthy():
+    _reg, _ev, _store, mon = _mon()
+    assert mon.level("nope") == 0
+    assert mon.score("nope") is None
+    assert mon.score_json("nope") is None
+    rep = mon.report()
+    assert rep == {"replicas": {}, "worst": HEALTHY, "n_watched": 0}
+
+
+def test_severity_folds_to_worst_detector():
+    _reg, _ev, store, mon = _mon()
+    mon.watch("0", detectors=[
+        ThresholdDetector("qd", "q", 10.0, severity="degraded"),
+        DeadmanDetector("stall", "tok", 2.0, severity="critical"),
+    ])
+    store.append("q", 1.0, 5.0)
+    store.append("tok", 1.0, 1.0, kind="counter")
+    s = mon.evaluate(now=1.0)["0"]
+    assert s.state == HEALTHY and s.contributing == []
+    # degraded detector fires alone
+    store.append("q", 2.0, 50.0)
+    store.append("tok", 2.0, 2.0, kind="counter")
+    s = mon.evaluate(now=2.0)["0"]
+    assert s.state == DEGRADED and s.contributing == ["qd"]
+    # critical detector fires too: worst severity wins
+    store.append("q", 6.0, 50.0)
+    s = mon.evaluate(now=6.0)["0"]
+    assert s.state == CRITICAL
+    assert set(s.contributing) == {"qd", "stall"}
+    assert mon.level("0") == 2
+    # json round-trip names the contributors
+    js = mon.score_json("0")
+    assert js["state"] == CRITICAL and "stall" in js["contributing"]
+
+
+def test_lifecycle_states_map_to_critical():
+    _reg, _ev, _store, mon = _mon()
+    state = ["healthy"]
+    mon.watch("r", state_fn=lambda: state[0])
+    assert mon.evaluate(now=1.0)["r"].state == HEALTHY
+    state[0] = "starting"          # benign: warming up is not an alarm
+    assert mon.evaluate(now=2.0)["r"].state == HEALTHY
+    state[0] = "quarantined"
+    s = mon.evaluate(now=3.0)["r"]
+    assert s.state == CRITICAL and s.contributing == ["replica_state"]
+    assert s.detail["replica_state"] == "quarantined"
+
+
+def test_restart_latch_produces_exactly_one_critical_verdict():
+    _reg, ev, _store, mon = _mon()
+    restarts = [0]
+    mon.watch("r", restarts_fn=lambda: restarts[0])
+    # first evaluation records the baseline, never latches
+    assert mon.evaluate(now=1.0)["r"].state == HEALTHY
+    restarts[0] = 1                 # warm restart between ticks
+    s = mon.evaluate(now=2.0)["r"]
+    assert s.state == CRITICAL and s.contributing == ["replica_restart"]
+    # latch is one-shot: next evaluation recovers
+    assert mon.evaluate(now=3.0)["r"].state == HEALTHY
+    kinds = [(e["kind"], e.get("state")) for e in ev.tail(16)]
+    assert ("health_changed", CRITICAL) in kinds
+    assert kinds[-1] == ("health_changed", HEALTHY)
+
+
+def test_health_changed_is_edge_triggered_and_gauge_published():
+    reg, ev, store, mon = _mon()
+    mon.watch("5", detectors=[
+        ThresholdDetector("qd", "q", 10.0, severity="degraded")])
+    store.append("q", 1.0, 50.0)
+    mon.evaluate(now=1.0)
+    mon.evaluate(now=2.0)           # still degraded: no second event
+    changes = [e for e in ev.tail(16) if e["kind"] == "health_changed"]
+    assert len(changes) == 1
+    assert changes[0]["replica"] == "5"
+    assert changes[0]["state"] == DEGRADED and changes[0]["was"] is None
+    assert reg.snapshot()["gauges"]["health_state" '{replica="5"}'] == 1.0
+
+
+def test_report_aggregates_worst_state():
+    _reg, _ev, store, mon = _mon()
+    mon.watch("a", detectors=[ThresholdDetector("qa", "qa", 10.0)])
+    mon.watch("b", detectors=[ThresholdDetector(
+        "qb", "qb", 10.0, severity="critical")])
+    store.append("qa", 1.0, 1.0)
+    store.append("qb", 1.0, 99.0)
+    mon.evaluate(now=1.0)
+    rep = mon.report()
+    assert rep["n_watched"] == 2 and rep["worst"] == CRITICAL
+    assert rep["replicas"]["a"]["state"] == HEALTHY
+    assert rep["replicas"]["b"]["state"] == CRITICAL
+    assert mon.keys == ["a", "b"]
+
+
+def test_health_score_to_json_shape():
+    s = HealthScore(state=DEGRADED, level=1, contributing=["x"],
+                    detail={"x": {"firing": True}})
+    assert s.to_json() == {"state": "degraded", "level": 1,
+                           "contributing": ["x"],
+                           "detail": {"x": {"firing": True}}}
+
+
+def test_standard_replica_sensors_cover_the_taxonomy():
+    signals, dets = standard_replica_sensors("3", tag="r3")
+    names = [d.name for d in dets]
+    assert names == ["ttft_p99_drift@r3", "queue_depth@r3",
+                     "decode_stall@r3"]
+    assert signals == []
+    stall = dets[-1]
+    assert stall.severity == "critical"
+    assert stall.series == 'serving_tokens_total{instance="3"}'
+    # optional sensors join the set
+    signals, dets = standard_replica_sensors(
+        "3", min_kv_blocks_free=4.0, spec=True)
+    names = [d.name for d in dets]
+    assert "kv_blocks_free@3" in names and "spec_accept_drift@3" in names
+    assert len(signals) == 1        # the spec accept-rate ratio
+
+
+@pytest.mark.parametrize("bad", ["panic", "", "ok"])
+def test_detector_severity_validated_at_watch_time(bad):
+    with pytest.raises(ValueError):
+        ThresholdDetector("x", "s", 1.0, severity=bad)

@@ -76,6 +76,49 @@ def test_events_endpoint_tails_flight_recorder(stack):
     assert [e["n"] for e in out["events"]] == [2, 3, 4]
 
 
+def test_timeseries_and_health_endpoints_default_empty(stack):
+    srv, *_ = stack
+    assert json.loads(_get(srv, "/timeseries")) == {}
+    assert json.loads(_get(srv, "/health")) == {}
+
+
+def test_timeseries_and_health_endpoints_serve_live_json():
+    from chainermn_tpu.monitor.health import HealthMonitor
+    from chainermn_tpu.monitor.timeseries import (
+        Collector,
+        ThresholdDetector,
+        TimeSeriesStore,
+    )
+
+    reg = MetricsRegistry()
+    ev = EventLog()
+    store = TimeSeriesStore()
+    mon = HealthMonitor(registry=reg, events=ev, store=store)
+    mon.watch("0", detectors=[
+        ThresholdDetector("qd", "q", 10.0, severity="degraded")])
+    for i in range(6):
+        store.append("q", float(i), 50.0)
+    mon.evaluate(now=6.0)
+    col = Collector(registry=reg, events=ev, store=store)
+    srv = monitor_http.serve(port=0, registry=reg, events=ev,
+                             timeseries=col, health=mon)
+    try:
+        # the collector handle is unwrapped to its store
+        out = json.loads(_get(srv, "/timeseries"))
+        assert out["n_series"] == 1
+        assert len(out["series"]["q"]["points"]) == 6
+        # ?last= and ?prefix= narrow the payload
+        out = json.loads(_get(srv, "/timeseries?last=2"))
+        assert out["series"]["q"]["points"] == [[4.0, 50.0], [5.0, 50.0]]
+        assert json.loads(
+            _get(srv, "/timeseries?prefix=zzz"))["n_series"] == 0
+        health = json.loads(_get(srv, "/health"))
+        assert health["worst"] == "degraded"
+        assert health["replicas"]["0"]["contributing"] == ["qd"]
+    finally:
+        srv.close()
+
+
 def test_index_and_404(stack):
     srv, *_ = stack
     assert b"/metrics" in _get(srv, "/")

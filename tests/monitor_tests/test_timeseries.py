@@ -1,0 +1,300 @@
+"""Continuous-telemetry unit fixtures (ISSUE 15): ring-buffer bounds,
+injectable-clock determinism, derived-signal math, and the detector
+edge-trigger contract — all driven through ``Collector.tick(now=...)``
+with a fake clock, so nothing here ever sleeps."""
+
+import threading
+
+import pytest
+
+from chainermn_tpu.monitor.events import EventLog
+from chainermn_tpu.monitor.registry import MetricsRegistry
+from chainermn_tpu.monitor.timeseries import (
+    Collector,
+    DeadmanDetector,
+    EWMA,
+    Rate,
+    Ratio,
+    ThresholdDetector,
+    TimeSeriesStore,
+    WindowPercentile,
+    ZScoreDetector,
+)
+
+
+# ---------------------------------------------------------------------- #
+# store                                                                   #
+# ---------------------------------------------------------------------- #
+
+
+def test_store_ring_is_bounded():
+    store = TimeSeriesStore(maxlen=4)
+    for i in range(100):
+        store.append("s", float(i), float(i * 10))
+    pts = store.points("s")
+    assert len(pts) == 4
+    assert pts == [(96.0, 960.0), (97.0, 970.0), (98.0, 980.0),
+                   (99.0, 990.0)]
+    assert store.last("s") == (99.0, 990.0)
+    assert store.values("missing") == []
+    assert store.last("missing") is None
+
+
+def test_store_to_json_last_and_prefix():
+    store = TimeSeriesStore(maxlen=16)
+    for i in range(6):
+        store.append("a:x", float(i), float(i))
+        store.append("b:y", float(i), float(-i), kind="counter")
+    out = store.to_json(last=2)
+    assert out["n_series"] == 2
+    assert out["series"]["a:x"]["points"] == [[4.0, 4.0], [5.0, 5.0]]
+    assert out["series"]["b:y"]["kind"] == "counter"
+    only_b = store.to_json(prefix="b:")
+    assert list(only_b["series"]) == ["b:y"]
+    assert only_b["n_series"] == 1
+
+
+def test_store_rejects_degenerate_maxlen():
+    with pytest.raises(ValueError):
+        TimeSeriesStore(maxlen=1)
+
+
+# ---------------------------------------------------------------------- #
+# derived signals                                                         #
+# ---------------------------------------------------------------------- #
+
+
+def test_rate_signal_differentiates_counter():
+    store = TimeSeriesStore()
+    sig = Rate("tok")
+    store.append("tok", 1.0, 100.0, kind="counter")
+    sig.evaluate(store, 1.0)            # one point: no rate yet
+    assert store.values("tok:rate") == []
+    store.append("tok", 3.0, 150.0, kind="counter")
+    sig.evaluate(store, 3.0)
+    assert store.values("tok:rate") == [25.0]   # 50 tokens / 2 s
+    sig.evaluate(store, 4.0)            # source did not advance: no point
+    assert store.values("tok:rate") == [25.0]
+
+
+def test_ewma_signal_converges():
+    store = TimeSeriesStore()
+    sig = EWMA("v", alpha=0.5)
+    for i, x in enumerate([1.0, 3.0, 3.0]):
+        store.append("v", float(i), x)
+        sig.evaluate(store, float(i))
+    # 1.0 -> 2.0 -> 2.5 with alpha .5
+    assert store.values("v:ewma") == [1.0, 2.0, 2.5]
+
+
+def test_ratio_signal_skips_zero_denominator():
+    store = TimeSeriesStore()
+    sig = Ratio("acc", "prop", "accept_ratio")
+    store.append("acc", 1.0, 4.0)
+    store.append("prop", 1.0, 0.0)
+    sig.evaluate(store, 1.0)
+    assert store.values("accept_ratio") == []
+    store.append("prop", 2.0, 8.0)
+    sig.evaluate(store, 2.0)
+    assert store.values("accept_ratio") == [0.5]
+
+
+def test_window_percentile_uses_only_window():
+    store = TimeSeriesStore()
+    sig = WindowPercentile("lat", q=50.0, window_s=5.0)
+    store.append("lat", 0.0, 1000.0)    # stale: outside the window at t=10
+    for t, v in ((7.0, 1.0), (8.0, 3.0), (9.0, 5.0)):
+        store.append("lat", t, v)
+    sig.evaluate(store, 10.0)
+    assert store.values("lat:w50") == [3.0]
+
+
+# ---------------------------------------------------------------------- #
+# detectors                                                               #
+# ---------------------------------------------------------------------- #
+
+
+def test_threshold_detector_directions():
+    store = TimeSeriesStore()
+    above = ThresholdDetector("qd", "q", 10.0)
+    below = ThresholdDetector("kv", "free", 5.0, direction="below",
+                              severity="critical")
+    store.append("q", 1.0, 11.0)
+    store.append("free", 1.0, 3.0)
+    assert above.check(store, 1.0)["firing"]
+    assert below.check(store, 1.0)["firing"]
+    store.append("q", 2.0, 10.0)        # at the bound: not beyond it
+    store.append("free", 2.0, 5.0)
+    assert not above.check(store, 2.0)["firing"]
+    assert not below.check(store, 2.0)["firing"]
+    assert not ThresholdDetector("e", "empty", 1.0).check(store, 2.0)[
+        "firing"]
+
+
+def test_zscore_detector_fires_on_drift_not_on_flat_series():
+    store = TimeSeriesStore()
+    det = ZScoreDetector("drift", "s", z=3.0, min_points=8)
+    flat = ZScoreDetector("flat", "f", z=3.0, min_points=8)
+    for i in range(20):
+        store.append("s", float(i), 1.0 + 0.1 * (i % 2))   # wobbly baseline
+        store.append("f", float(i), 1.0)                    # constant
+    assert not det.check(store, 20.0)["firing"]
+    store.append("s", 20.0, 50.0)       # huge outlier
+    store.append("f", 20.0, 1.0)
+    v = det.check(store, 20.0)
+    assert v["firing"] and v["zscore"] > 3.0
+    assert not flat.check(store, 20.0)["firing"]    # std ~ 0 never alarms
+
+
+def test_zscore_detector_needs_min_points():
+    store = TimeSeriesStore()
+    det = ZScoreDetector("d", "s", min_points=8)
+    for i in range(5):
+        store.append("s", float(i), float(i))
+    assert not det.check(store, 5.0)["firing"]
+
+
+def test_deadman_fires_only_while_active_and_rearms():
+    store = TimeSeriesStore()
+    active = [True]
+    det = DeadmanDetector("stall", "tok", 2.0,
+                          active_fn=lambda: active[0])
+    store.append("tok", 0.0, 10.0, kind="counter")
+    assert not det.check(store, 0.0)["firing"]
+    # progress keeps it quiet
+    store.append("tok", 1.0, 20.0, kind="counter")
+    assert not det.check(store, 1.0)["firing"]
+    # no progress while busy: stall clock runs out
+    assert not det.check(store, 2.5)["firing"]      # 1.5s stalled
+    v = det.check(store, 4.0)                        # 3.0s stalled
+    assert v["firing"] and v["stalled_s"] == 3.0
+    # going idle rearms — an empty queue is not a stall
+    active[0] = False
+    assert not det.check(store, 10.0)["firing"]
+    active[0] = True
+    assert not det.check(store, 11.0)["firing"]     # clock restarted
+    assert det.check(store, 14.0)["firing"]
+
+
+def test_detector_evaluate_edge_triggers_events_and_gauge():
+    store = TimeSeriesStore()
+    reg = MetricsRegistry()
+    ev = EventLog()
+    det = ThresholdDetector("qd", "q", 10.0, severity="critical")
+    store.append("q", 1.0, 5.0)
+    det.evaluate(store, 1.0, registry=reg, events=ev)
+    store.append("q", 2.0, 20.0)
+    det.evaluate(store, 2.0, registry=reg, events=ev)
+    det.evaluate(store, 3.0, registry=reg, events=ev)   # still firing
+    store.append("q", 4.0, 5.0)
+    det.evaluate(store, 4.0, registry=reg, events=ev)
+    kinds = [e["kind"] for e in ev.tail(16)]
+    # edge-trigger: exactly one fired + one cleared despite two firing
+    # evaluations
+    assert kinds.count("detector_fired") == 1
+    assert kinds.count("detector_cleared") == 1
+    fired = [e for e in ev.tail(16) if e["kind"] == "detector_fired"][0]
+    assert fired["detector"] == "qd" and fired["value"] == 20.0
+    assert reg.snapshot()["gauges"][
+        "detector_state" '{detector="qd"}'] == 0.0
+
+
+def test_detector_rejects_bad_config():
+    with pytest.raises(ValueError):
+        ThresholdDetector("x", "s", 1.0, severity="panic")
+    with pytest.raises(ValueError):
+        ThresholdDetector("x", "s", 1.0, direction="sideways")
+    with pytest.raises(ValueError):
+        ZScoreDetector("x", "s", direction="diagonal")
+    with pytest.raises(ValueError):
+        DeadmanDetector("x", "s", 0.0)
+
+
+# ---------------------------------------------------------------------- #
+# collector                                                               #
+# ---------------------------------------------------------------------- #
+
+
+def _stack():
+    reg = MetricsRegistry()
+    ev = EventLog()
+    clock = [0.0]
+    col = Collector(registry=reg, events=ev, cadence_s=0.25,
+                    clock=lambda: clock[0])
+    return reg, ev, clock, col
+
+
+def test_collector_tick_is_deterministic_under_injected_clock():
+    reg, _ev, _clk, col = _stack()
+    c = reg.counter("serving_tokens_total", {"instance": "9"})
+    g = reg.gauge("serving_queue_depth_now", {"instance": "9"})
+    h = reg.histogram("serving_ttft_seconds", {"instance": "9"}, unit="s")
+    c.inc(10)
+    g.set(4)
+    h.observe(0.5, t=0.9)
+    out1 = col.tick(now=1.0)
+    key = 'serving_tokens_total{instance="9"}'
+    assert col.store.last(key) == (1.0, 10.0)
+    assert col.store.last('serving_queue_depth_now{instance="9"}') \
+        == (1.0, 4.0)
+    # histogram -> windowed percentiles
+    assert col.store.last(
+        'serving_ttft_seconds{instance="9"}:p50') == (1.0, 0.5)
+    c.inc(10)
+    out2 = col.tick(now=2.0)
+    # counter delta over the 1s gap -> rate series
+    assert col.store.last(key + ":rate") == (2.0, 10.0)
+    assert out1["samples"] > 0 and out2["samples"] >= out1["samples"]
+    assert col.ticks == 2
+    # the collector meters itself
+    assert reg.snapshot()["counters"]["ts_samples_total"] > 0
+
+
+def test_collector_runs_signals_then_detectors():
+    reg, ev, _clk, col = _stack()
+    c = reg.counter("serving_tokens_total", {"instance": "7"})
+    key = 'serving_tokens_total{instance="7"}'
+    col.add_signal(EWMA(key + ":rate", alpha=0.5))
+    det = ThresholdDetector("rate_floor", key + ":rate", 1.0,
+                            direction="below")
+    col.add_detector(det)
+    for i in range(1, 5):
+        c.inc(100)
+        verdicts = col.tick(now=float(i))["detectors"]
+    assert store_has(col, key + ":rate:ewma")
+    assert not verdicts["rate_floor"]["firing"]     # 100 tok/s >> 1
+    # stop the counter: rate falls to 0 -> detector fires, event emitted
+    col.tick(now=5.0)
+    verdicts = col.tick(now=6.0)["detectors"]
+    assert verdicts["rate_floor"]["firing"]
+    assert any(e["kind"] == "detector_fired" for e in ev.tail(8))
+
+
+def store_has(col, name):
+    return name in col.store.names()
+
+
+def test_collector_thread_smoke():
+    reg, _ev, _clk, _ = _stack()
+    col = Collector(registry=reg, cadence_s=0.01)   # real clock
+    reg.gauge("serving_queue_depth_now").set(1.0)
+    col.start()
+    assert col.start() is col            # idempotent while running
+    done = threading.Event()
+
+    def waiter():
+        while col.ticks < 3:
+            pass
+        done.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    assert done.wait(10.0), "collector thread made no progress"
+    col.stop()
+    col.stop()                            # idempotent
+    ticks = col.ticks
+    assert ticks >= 3
+    assert col.store.last("serving_queue_depth_now") is not None
+    # the thread metered its own scheduling lag
+    lag = reg.snapshot()["histograms"]["ts_collect_lag_seconds"]
+    assert lag["count"] >= ticks - 1

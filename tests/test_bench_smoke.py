@@ -151,6 +151,20 @@ def test_bench_serving_mode_smoke():
     assert sp["recompiles_after_warmup"] == 0
     # ONE verify program, compiled at warmup, across every accept length
     assert sp["compile_counts"]["spec_verify"] == 1
+    # ---- the ISSUE-15 continuous telemetry (acceptance criterion) ---- #
+    ts = rec["telemetry_serving"]
+    # the collector + detector graph ran against the warm engine for the
+    # whole ON workload and cost (<2% production target; CI bound
+    # generous — millisecond CPU decodes under a shared runner)
+    assert ts["overhead_frac"] < 0.15, ts
+    assert ts["parity_on_vs_off"] is True
+    assert ts["recompiles_after_warmup"] == 0
+    assert ts["ticks"] > 0 and ts["n_series"] > 0
+    assert ts["tokens_per_sec_on"] > 0 and ts["tokens_per_sec_off"] > 0
+    # the health verdict travels with the record: scored, named state
+    assert ts["worst_state"] in ("healthy", "degraded", "critical")
+    assert ts["health"]["state"] == ts["worst_state"]
+    assert isinstance(ts["health"]["contributing"], list)
     # ---- the ISSUE-10 hot swap (acceptance criterion) ---------------- #
     hs = rec["hot_swap"]
     # three publishes landed mid-stream through the version fence: every
@@ -191,6 +205,15 @@ def test_bench_serving_mode_smoke():
     # rolling publish after the kill probe (ISSUE 10): the quarantined
     # replica is skipped-and-reported, every surviving replica takes the
     # new version, and no survivor recompiled
+    # the fleet ran under fleet_health the whole time (ISSUE 15): pooled
+    # per-replica series collected on the background cadence, and the
+    # router's health report embedded in the record. The kill probe
+    # quarantined replica 0, so its verdict is critical by lifecycle.
+    assert fl["ts_series"] > 0 and fl["ts_ticks"] > 0
+    assert fl["health"]["n_watched"] == 2
+    assert fl["health"]["worst"] == "critical"
+    assert fl["health"]["replicas"]["0"]["state"] == "critical"
+    assert "replica_state" in fl["health"]["replicas"]["0"]["contributing"]
     pub = fl["publish"]
     assert pub["ok"] is True
     assert "skipped" in pub["outcomes"]["0"]         # the kill-probe victim
