@@ -1334,6 +1334,122 @@ def serving_main() -> None:
             f"mid-run replica kill (reroutes={fl['reroutes']}, "
             f"lost={not fl['no_request_lost']}), affinity "
             f"hit_rate={fl['affinity_hit_rate']}, parity={fl_parity}")
+
+        # ---- fleet autoscale: diurnal arrivals (ISSUE 16) ------------- #
+        # A compressed diurnal cycle: sinusoidal arrival rate over one
+        # window (trough -> peak -> trough) against a fleet that starts
+        # at min_replicas with the closed-loop controller LIVE. Replica
+        # count must track load — scale up under the peak, retire back
+        # to the floor in the trough — with zero requests lost.
+        import math
+
+        from chainermn_tpu.fleet import AutoscalePolicy, FleetController
+
+        as_window = float(e("CHAINERMN_TPU_SERVE_AS_WINDOW", "6.0"))
+        # arrival rates are expressed as MULTIPLES of one replica's
+        # measured service rate, so the peak is a genuine overload on
+        # any machine (a fixed req/s would be a no-op on a fast box)
+        as_base_x = float(e("CHAINERMN_TPU_SERVE_AS_BASE_X", "0.3"))
+        as_peak_x = float(e("CHAINERMN_TPU_SERVE_AS_PEAK_X", "3.0"))
+        as_cap = int(e("CHAINERMN_TPU_SERVE_AS_MAX_REQUESTS", "400"))
+        as_min = int(e("CHAINERMN_TPU_SERVE_AS_MIN", "1"))
+        as_max = int(e("CHAINERMN_TPU_SERVE_AS_MAX", "3"))
+        as_prefill, as_new = 16, 12
+
+        def as_engine():
+            # deliberately small: ONE slot per replica, so the diurnal
+            # peak genuinely exceeds a single replica's service rate
+            return ServingEngine(model, params, n_slots=1,
+                                 prefill_len=as_prefill,
+                                 cache_len=as_prefill + as_new + 4)
+
+        router2 = FleetRouter([as_engine() for _ in range(as_min)])
+        ctrl = as_col = None
+        try:
+            assert router2.wait_ready(600), "autoscale warmup timed out"
+            rng2 = np.random.RandomState(7)
+            # calibrate: sequential service time of this request shape on
+            # the floor fleet — the sinusoid's amplitude is set off it
+            t_cal = time.time()
+            for _ in range(3):
+                p2 = rng2.randint(1, vocab, size=8).astype(np.int32)
+                router2.submit(p2, as_new).wait(timeout=600)
+            svc_s = max((time.time() - t_cal) / 3.0, 1e-3)
+            as_base = as_base_x / svc_s
+            as_peak = as_peak_x / svc_s
+            as_col = fleet_health(router2, cadence_s=ts_cadence,
+                                  stall_timeout_s=60.0)
+            as_col.start()
+            ctrl = FleetController(
+                router2, as_col, engine_factory=as_engine,
+                autoscale=AutoscalePolicy(
+                    min_replicas=as_min, max_replicas=as_max,
+                    queue_high=1.0, idle_low=0.25, up_after_s=0.2,
+                    down_after_s=0.8, cooldown_s=0.3),
+                cadence_s=0.05, sensor_kw=dict(stall_timeout_s=60.0))
+            ctrl.start()
+            t0 = time.time()
+            as_frs, caps = [], []
+            while ((el := time.time() - t0) < as_window
+                   and len(as_frs) < as_cap):
+                rate = as_base + (as_peak - as_base) * 0.5 * (
+                    1.0 - math.cos(2.0 * math.pi * el / as_window))
+                # ~50ms arrival chunks: sleep() granularity stays sane
+                # even when the calibrated peak is hundreds of req/s
+                burst = max(1, int(rate * 0.05))
+                for _ in range(burst):
+                    p2 = rng2.randint(
+                        1, vocab, size=rng2.randint(4, 9)).astype(np.int32)
+                    as_frs.append(router2.submit(p2, as_new))
+                caps.append(router2.capacity)
+                time.sleep(burst / max(rate, 0.5))
+            as_done = [fr.wait(timeout=600) for fr in as_frs]
+            # the trough: give the controller a bounded window to see
+            # sustained idleness and retire back down to the floor
+            down_deadline = time.time() + 60
+            while (time.time() < down_deadline
+                   and router2.capacity > as_min):
+                time.sleep(0.05)
+            caps.append(router2.capacity)
+            wall_as = round(time.time() - t0, 3)
+            crep = ctrl.report()
+            as_lost = [fr.id for fr in as_frs
+                       if not fr.finished or fr.state.value != "done"]
+            record["fleet_autoscale"] = {
+                "window_s": as_window,
+                "service_s_calibrated": round(svc_s, 4),
+                "arrival_base_hz": round(as_base, 2),
+                "arrival_peak_hz": round(as_peak, 2),
+                "requests": len(as_frs),
+                "done": sum(fr.state.value == "done" for fr in as_frs),
+                "all_terminal": all(as_done),
+                "no_request_lost": not as_lost,
+                "min_replicas": as_min,
+                "max_replicas": as_max,
+                "peak_capacity": max(caps),
+                "final_capacity": router2.capacity,
+                "scale_ups": crep["autoscale"]["scale_ups"],
+                "scale_downs": crep["autoscale"]["scale_downs"],
+                "replica_count_tracks_load": bool(
+                    max(caps) > as_min and router2.capacity == as_min),
+                "recompiles_after_warmup": sum(
+                    sum(r.engine.recompiles.values())
+                    for r in router2.replicas if r.accepting),
+                "decisions": crep["decisions"],
+                "wall_s": wall_as,
+            }
+        finally:
+            if ctrl is not None:
+                ctrl.stop()
+            if as_col is not None:
+                as_col.stop()
+            router2.close()
+        fa = record["fleet_autoscale"]
+        log(f"fleet autoscale: {fa['requests']} diurnal arrivals over "
+            f"{fa['window_s']}s, capacity {fa['min_replicas']}->"
+            f"{fa['peak_capacity']}->{fa['final_capacity']} "
+            f"(ups={fa['scale_ups']}, downs={fa['scale_downs']}), "
+            f"lost={not fa['no_request_lost']}")
         from chainermn_tpu.monitor import snapshot as monitor_snapshot
 
         record["monitor"] = monitor_snapshot()

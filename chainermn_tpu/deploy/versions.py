@@ -27,7 +27,8 @@ class WeightVersion:
     """One committed weight set, as seen by one engine."""
 
     version: int
-    source: str = "init"          # "init" | "publish" | "restore"
+    # "init" | "publish" | "restore" | "canary" | "rollback"
+    source: str = "init"
     step: Optional[int] = None    # producer's train step, when known
     wall_time: float = field(default_factory=time.time)
 
@@ -55,6 +56,19 @@ class VersionLog:
     def current(self) -> WeightVersion:
         with self._lock:
             return self._entries[-1]
+
+    def rollback_target(self) -> Optional[WeightVersion]:
+        """The newest entry whose version differs from the current one —
+        what an auto-rollback should land on. Scans backwards so a
+        re-record of the same version (a retried publish) never makes the
+        deployment its own rollback target. ``None`` when the log has
+        only ever seen one version."""
+        with self._lock:
+            cur = self._entries[-1]
+            for entry in reversed(self._entries[:-1]):
+                if entry.version != cur.version:
+                    return entry
+        return None
 
     def history(self) -> List[WeightVersion]:
         with self._lock:

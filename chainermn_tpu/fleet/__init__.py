@@ -21,7 +21,12 @@ layer) applied to the serving side:
   global ``max_queue`` shedding, replica failover with replayed
   re-routes (stream-dedup'd — a consumer sees a seamless continuation),
   and fleet-pooled observability (``/fleet`` via
-  ``monitor.http.serve(fleet=router)``).
+  ``monitor.http.serve(fleet=router)``);
+- :mod:`~chainermn_tpu.fleet.control` — :class:`FleetController`: the
+  closed control loop over the telemetry pipeline (ISSUE 16) —
+  autoscaling with hysteresis, SLO-guarded canary deploys with
+  auto-rollback, and pre-quarantine admission rebalancing
+  (``/control`` via ``monitor.http.serve(controller=...)``).
 
 Correctness invariants (pinned in ``tests/fleet_tests``): a fleet serves
 a mixed prefix-heavy workload token-for-token equal to solo
@@ -35,6 +40,12 @@ as ``chainermn_tpu.monitor``, pinned by
 ``tests/monitor_tests/test_import_hygiene.py``.
 """
 
+from chainermn_tpu.fleet.control import (
+    AutoscalePolicy,
+    CanaryPolicy,
+    FleetController,
+    RebalancePolicy,
+)
 from chainermn_tpu.fleet.replica import (
     EngineReplica,
     ReplicaHang,
@@ -50,10 +61,14 @@ from chainermn_tpu.fleet.routing import (
 )
 
 __all__ = [
+    "AutoscalePolicy",
+    "CanaryPolicy",
     "EngineReplica",
+    "FleetController",
     "FleetRequest",
     "FleetRouter",
     "FleetTrie",
+    "RebalancePolicy",
     "ReplicaHang",
     "ReplicaKilled",
     "ReplicaSnapshot",

@@ -1,0 +1,704 @@
+"""The fleet control plane: sensors -> policies -> actuators, closed.
+
+PR 15 built the fleet's nervous system (time-series store, detectors,
+per-replica health scores, SLO burn rates) and earlier PRs built the
+actuators (``spawn_replica``, quarantine, drain, ``FleetRouter.
+publish``); nothing connected them. :class:`FleetController` is that
+connection — a deterministic control loop (same daemon-thread +
+``tick(now=)`` design as the monitor's :class:`~chainermn_tpu.monitor.
+timeseries.Collector`) that reads the telemetry pipeline and drives
+three policies:
+
+- **Autoscaling** (:class:`AutoscalePolicy`): sustained queue-depth
+  breach or SLO burn scales UP via ``spawn_replica`` (the new replica
+  warms in parallel and is synced to the fleet's current weight
+  version); sustained idleness scales DOWN via the graceful
+  ``retire_replica`` drain. Hysteresis (``up_after_s`` /
+  ``down_after_s``), a post-action ``cooldown_s``, and hard
+  ``min_replicas``/``max_replicas`` bounds keep a noisy signal from
+  flapping the fleet.
+- **SLO-guarded canary deploys** (:class:`CanaryPolicy`):
+  :meth:`FleetController.deploy` swaps EXACTLY ONE replica
+  (``FleetRouter.publish(canary=rid)`` — blast radius 1/N for one bake
+  window), compares its health score and the SLO verdict against the
+  fleet baseline over ``bake_s``, then either PROMOTES (rolling swap of
+  the rest, the canary excluded — it already carries the new version)
+  or AUTO-ROLLBACKS: every accepting replica is re-published onto the
+  pre-canary weights and the controller's :class:`~chainermn_tpu.
+  deploy.versions.VersionLog` records the reversal at
+  ``rollback_target()``. A canary that dies mid-bake aborts cleanly
+  (peers never saw the new weights — nothing to undo); a commit fault
+  during the promote roll triggers the same rollback, so a
+  partially-rolled fleet converges back to one version.
+- **Pre-quarantine rebalancing** (:class:`RebalancePolicy`): a replica
+  scoring DEGRADED (not critical — the supervisor owns that) has its
+  admission weight shed, so routing sends it proportionally less
+  traffic while it recovers; the weight is restored the tick it scores
+  healthy again.
+
+Every decision is an edge-triggered, cataloged flight-recorder event
+(``controller_scale_up`` / ``controller_scale_down`` /
+``controller_rebalance`` / ``canary_start`` / ``canary_promote`` /
+``canary_rollback``) that NAMES the triggering signals, mirrored into
+counters/gauges, and surfaced through :meth:`report` — which
+``FleetRouter.fleet_report`` embeds under ``"control"`` and
+``monitor.http.serve(controller=...)`` exposes at ``/control``.
+
+Locking: the controller's own lock is a ``sanitizer.make_lock`` LEAF
+guarding only the report-visible state (canary record, decision ring,
+pending deploy). Policy work runs on the tick thread (single ticker by
+contract, like the Collector) and every router/collector call happens
+OUTSIDE the lock — the controller calls into the router, never the
+reverse, so no lock-order cycle can exist.
+
+This module must not import ``chainermn_tpu.extensions`` (or jax, or
+the serving package) at module level — pinned by
+``tests/monitor_tests/test_import_hygiene.py``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass
+from typing import Callable, Optional
+
+from chainermn_tpu.analysis import sanitizer
+from chainermn_tpu.deploy.versions import VersionLog
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+
+
+@dataclass
+class AutoscalePolicy:
+    """When and how far to scale (all thresholds in sensor units).
+
+    Pressure = queued work per accepting replica above ``queue_high``,
+    or (``burn_gate``) the SLO engine reporting non-compliance. Pressure
+    sustained for ``up_after_s`` spawns one replica; NO pressure and
+    fleet load at/below ``idle_low`` sustained for ``down_after_s``
+    retires one. ``cooldown_s`` separates consecutive scale actions so
+    the previous action's effect is observed before the next."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    queue_high: float = 4.0
+    idle_low: float = 0.25
+    up_after_s: float = 1.0
+    down_after_s: float = 5.0
+    cooldown_s: float = 2.0
+    burn_gate: bool = True
+
+
+@dataclass
+class CanaryPolicy:
+    """Bake-window guard for one-replica canary deploys: during
+    ``bake_s`` the canary must not score WORSE than the healthiest
+    interpretation of the fleet baseline (its peers' worst level at
+    evaluation time, or the baseline captured at canary start —
+    whichever is higher), and (``slo_gate``) the SLO engine must not
+    newly breach while it bakes."""
+
+    bake_s: float = 5.0
+    slo_gate: bool = True
+
+
+@dataclass
+class RebalancePolicy:
+    """Admission weight applied to DEGRADED (level 1) replicas — shed
+    before the supervisor would ever consider quarantine."""
+
+    degraded_weight: float = 0.25
+
+
+class _Canary:
+    """One in-flight canary deploy (tick-thread state, report-copied)."""
+
+    __slots__ = ("replica_id", "new_params", "old_params", "step",
+                 "started_at", "version", "baseline_level",
+                 "baseline_compliant")
+
+    def __init__(self, replica_id, new_params, old_params, step,
+                 started_at, version, baseline_level,
+                 baseline_compliant) -> None:
+        self.replica_id = replica_id
+        self.new_params = new_params
+        self.old_params = old_params
+        self.step = step
+        self.started_at = started_at
+        self.version = version
+        self.baseline_level = baseline_level
+        self.baseline_compliant = baseline_compliant
+
+    def to_json(self) -> dict:
+        return {"replica": self.replica_id, "version": self.version,
+                "started_at": self.started_at, "step": self.step,
+                "baseline_level": self.baseline_level,
+                "baseline_compliant": self.baseline_compliant}
+
+
+_controller_ids = itertools.count()
+
+
+class FleetController:
+    """Closed-loop controller over one fleet (module docstring).
+
+    Parameters
+    ----------
+    router : FleetRouter
+        The fleet under control.
+    collector : Collector
+        The telemetry pipeline (normally from :func:`~chainermn_tpu.
+        monitor.health.fleet_health`) — its store feeds the queue-depth
+        sensor and its attached :class:`~chainermn_tpu.monitor.health.
+        HealthMonitor` feeds the canary/rebalance verdicts.
+    slo : SLOEngine, optional
+        Burn-rate gate for both scale-up pressure and the canary bake.
+    engine_factory : callable() -> ServingEngine, optional
+        Builds the engine for each scale-up (required when an
+        ``autoscale`` policy is given).
+    autoscale / canary / rebalance : policy dataclasses or None
+        ``None`` disables that policy entirely.
+    cadence_s / clock : like the Collector — ``start()`` runs
+        :meth:`tick` on a daemon thread; tests drive ``tick(now=)``.
+    sensor_kw : dict, optional
+        Forwarded to :func:`~chainermn_tpu.monitor.health.wire_replica`
+        when wiring spawned replicas into the health pipeline (use the
+        same values ``fleet_health`` was called with).
+    """
+
+    def __init__(self, router, collector, *, slo=None,
+                 engine_factory: Optional[Callable] = None,
+                 autoscale: Optional[AutoscalePolicy] = None,
+                 canary: Optional[CanaryPolicy] = None,
+                 rebalance: Optional[RebalancePolicy] = None,
+                 cadence_s: float = 0.5, clock=None,
+                 sensor_kw: Optional[dict] = None,
+                 publish_timeout_s: float = 60.0,
+                 retire_timeout_s: float = 60.0,
+                 registry=None, events=None) -> None:
+        if cadence_s <= 0:
+            raise ValueError(f"cadence_s must be > 0, got {cadence_s}")
+        if autoscale is not None and engine_factory is None:
+            raise ValueError(
+                "an autoscale policy needs engine_factory= to build "
+                "scale-up replicas")
+        self.router = router
+        self.collector = collector
+        self.slo = slo
+        self.autoscale = autoscale
+        self.canary = canary
+        self.rebalance = rebalance
+        self.cadence_s = float(cadence_s)
+        self.log = VersionLog()          # fleet-level deploy audit trail
+        self._engine_factory = engine_factory
+        self._sensor_kw = dict(sensor_kw or {})
+        self._publish_timeout_s = float(publish_timeout_s)
+        self._retire_timeout_s = float(retire_timeout_s)
+        self._clock = clock if clock is not None else time.monotonic
+        self._events = events if events is not None else get_event_log()
+        self._registry = registry if registry is not None else get_registry()
+        labels = {"controller": str(next(_controller_ids))}
+        self._labels = labels
+        reg = self._registry
+        self._c_ticks = reg.counter("controller_ticks_total", labels)
+        self._c_ups = reg.counter("controller_scale_ups_total", labels)
+        self._c_downs = reg.counter("controller_scale_downs_total", labels)
+        self._c_deploys = reg.counter("canary_deploys_total", labels)
+        self._c_promotes = reg.counter("canary_promotes_total", labels)
+        self._c_rollbacks = reg.counter("canary_rollbacks_total", labels)
+        self._g_target = reg.gauge("controller_target_replicas", labels)
+        self._g_phase = reg.gauge("controller_canary_phase", labels)
+        # leaf: guards ONLY report-visible state; no call made under it
+        # ever acquires another lock (enforced at runtime by leaf=True)
+        self._lock = sanitizer.make_lock("FleetController._lock", leaf=True)
+        self._canary: Optional[_Canary] = None
+        self._pending_deploy: Optional[tuple] = None
+        self._decisions: deque = deque(maxlen=32)
+        self._last_outcome: Optional[dict] = None
+        # tick-thread-private policy state (single ticker by contract)
+        self._pressure_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_scale: Optional[float] = None
+        self._target: Optional[int] = None
+        self._fleet_version = 0
+        self._params_current = None      # last PROMOTED params (sync src)
+        self._pending_sync: list = []    # spawned replicas awaiting sync
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        router.attach_controller(self)
+
+    # ------------------------------------------------------------------ #
+    # the deploy entry point (any thread)                                 #
+    # ------------------------------------------------------------------ #
+
+    def deploy(self, params, *, step: Optional[int] = None) -> None:
+        """Queue ``params`` for a canary deploy; the next tick starts
+        the bake. One deploy in flight at a time — a second call while
+        one is pending or baking raises."""
+        if self.canary is None:
+            raise RuntimeError(
+                "controller has no canary policy (pass canary=)")
+        with self._lock:
+            if self._canary is not None or self._pending_deploy is not None:
+                raise RuntimeError(
+                    "a canary deploy is already in flight; wait for its "
+                    "promote/rollback before deploying again")
+            self._pending_deploy = (params, step)
+
+    # ------------------------------------------------------------------ #
+    # the control loop                                                    #
+    # ------------------------------------------------------------------ #
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """One full sense -> decide -> act pass, deterministic under an
+        injected ``now``. Returns a summary of the signals read and the
+        actions taken (also kept in the decision ring for reports)."""
+        now = self._clock() if now is None else float(now)
+        summary = {"now": now, "actions": []}
+        if getattr(self.router, "_closed", False):
+            return summary
+        sensors = self._read_sensors(now)
+        summary["signals"] = sensors
+        self._canary_tick(now, sensors, summary)
+        self._autoscale_tick(now, sensors, summary)
+        self._rebalance_tick(sensors, summary)
+        self._sync_spawned(summary)
+        self._c_ticks.inc()
+        if self._target is not None:
+            self._g_target.set(self._target)
+        # graftlint: unguarded-ok — atomic reference read (writers lock)
+        self._g_phase.set(0 if self._canary is None else 1)
+        if summary["actions"]:
+            with self._lock:
+                self._decisions.extend(summary["actions"])
+        return summary
+
+    def start(self) -> "FleetController":
+        """Run :meth:`tick` every ``cadence_s`` on a daemon thread
+        (idempotent while running)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="chainermn-fleet-controller",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def __enter__(self) -> "FleetController":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — controller must not die
+                print(f"chainermn_tpu.fleet: controller tick failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
+            self._stop.wait(self.cadence_s)
+
+    # ------------------------------------------------------------------ #
+    # sensors                                                             #
+    # ------------------------------------------------------------------ #
+
+    def _read_sensors(self, now: float) -> dict:
+        """The controller's inputs, in one dict: sampled queue depth
+        (from the collector's store, cross-checked against the live
+        scheduler depth — the gauge only moves when the replica's drive
+        loop steps, so a stalled replica's sample freezes while its real
+        queue grows; the max sees the growth), fleet load, SLO verdict,
+        and the derived pressure signals. Reading the store/snapshots
+        takes no controller lock."""
+        store = self.collector.store
+        accepting = [r for r in self.router.replicas if r.accepting]
+        queued = active = slots = 0.0
+        for r in accepting:
+            key = (f'serving_queue_depth_now'
+                   f'{{instance="{r.metrics.instance}"}}')
+            last = store.last(key)
+            live = float(r.scheduler.queue_depth)
+            queued += (max(float(last[1]), live) if last is not None
+                       else live)
+            snap = r.snapshot()
+            active += snap.active_slots
+            slots += snap.n_slots
+        n = max(len(accepting), 1)
+        compliant, max_burn = True, 0.0
+        if self.slo is not None:
+            for entry in self.slo.evaluate(now).values():
+                compliant = compliant and bool(entry.get("compliant", True))
+                max_burn = max(max_burn,
+                               float(entry.get("max_burn_rate", 0.0)))
+        sensors = {
+            "accepting": len(accepting),
+            "queue_total": queued,
+            "queue_per_replica": queued / n,
+            "load": (queued + active) / max(slots, 1.0),
+            "slo_compliant": compliant,
+            "max_burn_rate": max_burn,
+            "pressure": [],
+        }
+        p = self.autoscale
+        if p is not None:
+            if sensors["queue_per_replica"] > p.queue_high:
+                sensors["pressure"].append("queue_depth")
+            if p.burn_gate and not compliant:
+                sensors["pressure"].append("slo_burn")
+        return sensors
+
+    @property
+    def health(self):
+        return self.collector.health
+
+    def _level(self, replica_id) -> int:
+        hm = self.health
+        return hm.level(str(replica_id)) if hm is not None else 0
+
+    # ------------------------------------------------------------------ #
+    # policy 1: autoscaling                                               #
+    # ------------------------------------------------------------------ #
+
+    def _autoscale_tick(self, now: float, s: dict, summary: dict) -> None:
+        p = self.autoscale
+        if p is None:
+            return
+        # graftlint: unguarded-ok — atomic reference read (writers lock)
+        if self._canary is not None:
+            # a bake window compares the canary against a STABLE
+            # baseline — resizing the fleet mid-bake would move it
+            self._pressure_since = self._idle_since = None
+            return
+        capacity = s["accepting"]
+        if self._target is None:
+            self._target = capacity
+        in_cooldown = (self._last_scale is not None
+                       and now - self._last_scale < p.cooldown_s)
+        pressure = bool(s["pressure"]) and capacity < p.max_replicas
+        idle = (not s["pressure"] and s["load"] <= p.idle_low
+                and capacity > p.min_replicas)
+        if pressure:
+            self._idle_since = None
+            if self._pressure_since is None:
+                self._pressure_since = now
+            elif (now - self._pressure_since >= p.up_after_s
+                  and not in_cooldown):
+                self._scale_up(now, s, summary)
+        elif idle:
+            self._pressure_since = None
+            if self._idle_since is None:
+                self._idle_since = now
+            elif (now - self._idle_since >= p.down_after_s
+                  and not in_cooldown):
+                self._scale_down(now, s, summary)
+        else:
+            self._pressure_since = self._idle_since = None
+
+    def _scale_up(self, now: float, s: dict, summary: dict) -> None:
+        replica = self.router.spawn_replica(
+            engine=self._engine_factory(), wait_ready=False)
+        self._last_scale = now
+        self._pressure_since = None
+        self._target = min(s["accepting"] + 1,
+                           self.autoscale.max_replicas)
+        hm = self.health
+        if hm is not None:
+            from chainermn_tpu.monitor.health import wire_replica
+
+            wire_replica(self.collector, hm, replica, **self._sensor_kw)
+        self._pending_sync.append(replica)
+        self._c_ups.inc()
+        action = {"action": "scale_up", "t": now,
+                  "replica": replica.replica_id,
+                  "signals": list(s["pressure"]),
+                  "queue_per_replica": round(s["queue_per_replica"], 3),
+                  "capacity": s["accepting"]}
+        summary["actions"].append(action)
+        self._events.emit("controller_scale_up",
+                          replica=replica.replica_id,
+                          signals=list(s["pressure"]),
+                          queue_per_replica=round(
+                              s["queue_per_replica"], 3),
+                          capacity=s["accepting"])
+
+    def _scale_down(self, now: float, s: dict, summary: dict) -> None:
+        candidates = [r for r in self.router.replicas if r.accepting]
+        if len(candidates) <= self.autoscale.min_replicas:
+            return
+        # least-loaded victim; ties retire the youngest replica
+        victim = min(candidates,
+                     key=lambda r: (r.snapshot().load, -r.replica_id))
+        rid = victim.replica_id
+        out = self.router.retire_replica(rid,
+                                         timeout=self._retire_timeout_s)
+        self._last_scale = now
+        self._idle_since = None
+        self._target = max(s["accepting"] - 1,
+                           self.autoscale.min_replicas)
+        self._pending_sync = [r for r in self._pending_sync
+                              if r.replica_id != rid]
+        hm = self.health
+        if hm is not None:
+            hm.unwatch(str(rid))
+        self._c_downs.inc()
+        action = {"action": "scale_down", "t": now, "replica": rid,
+                  "signals": ["idle"], "load": round(s["load"], 3),
+                  "forced": out["forced"], "capacity": s["accepting"]}
+        summary["actions"].append(action)
+        self._events.emit("controller_scale_down", replica=rid,
+                          signals=["idle"], load=round(s["load"], 3),
+                          forced=out["forced"], capacity=s["accepting"])
+
+    def _sync_spawned(self, summary: dict) -> None:
+        """Bring freshly-warm spawned replicas onto the fleet's current
+        PROMOTED weights (their factory built them from the original
+        params; after any promote those are stale)."""
+        for replica in list(self._pending_sync):
+            if not replica.ready.is_set():
+                continue
+            self._pending_sync.remove(replica)
+            if not replica.accepting or self._params_current is None:
+                continue
+            self.router.publish(self._params_current,
+                                canary=replica.replica_id,
+                                timeout=self._publish_timeout_s)
+
+    # ------------------------------------------------------------------ #
+    # policy 2: SLO-guarded canary deploys                                #
+    # ------------------------------------------------------------------ #
+
+    def _canary_tick(self, now: float, s: dict, summary: dict) -> None:
+        with self._lock:
+            c = self._canary
+            pending = None
+            if c is None and self._pending_deploy is not None:
+                pending, self._pending_deploy = self._pending_deploy, None
+        if c is None:
+            if pending is not None:
+                self._start_canary(now, pending[0], pending[1], s, summary)
+            return
+        replica = self.router.replicas[c.replica_id]
+        if not replica.accepting:
+            # canary died mid-bake: its weights died with it, peers
+            # never saw the new version — abort, nothing to republish
+            self._rollback(now, c, summary, reason="canary_lost",
+                           signals=[f"replica_state@{c.replica_id}"],
+                           dirty=False)
+            return
+        signals = self._regression_signals(c, s)
+        if signals:
+            self._rollback(now, c, summary, reason="regression",
+                           signals=signals, dirty=True)
+            return
+        if now - c.started_at >= self.canary.bake_s:
+            self._promote(now, c, summary)
+
+    def _start_canary(self, now: float, params, step, s: dict,
+                      summary: dict) -> None:
+        candidates = [r for r in self.router.replicas if r.accepting]
+        if not candidates:
+            self._events.emit("canary_rollback", replica=None,
+                              reason="no_accepting_replica", signals=[])
+            self._c_rollbacks.inc()
+            return
+        replica = min(candidates,
+                      key=lambda r: (r.snapshot().load, r.replica_id))
+        rid = replica.replica_id
+        old_params = replica.engine.params
+        out = self.router.publish(params, canary=rid, step=step,
+                                  timeout=self._publish_timeout_s)
+        if not out["ok"]:
+            # the canary itself refused the new weights: the fleet never
+            # left the old version — record the aborted attempt
+            self._c_rollbacks.inc()
+            action = {"action": "canary_rollback", "t": now,
+                      "replica": rid, "reason": "canary_publish_failed",
+                      "signals": []}
+            summary["actions"].append(action)
+            self._events.emit("canary_rollback", replica=rid,
+                              reason="canary_publish_failed", signals=[])
+            with self._lock:
+                self._last_outcome = action
+            return
+        peers = [self._level(r.replica_id) for r in candidates
+                 if r.replica_id != rid]
+        self._fleet_version += 1
+        version = self._fleet_version
+        self.log.record(version, source="canary", step=step)
+        c = _Canary(rid, params, old_params, step, now, version,
+                    baseline_level=max(peers, default=0),
+                    baseline_compliant=bool(s["slo_compliant"]))
+        with self._lock:
+            self._canary = c
+        self._c_deploys.inc()
+        action = {"action": "canary_start", "t": now, "replica": rid,
+                  "version": version, "bake_s": self.canary.bake_s}
+        summary["actions"].append(action)
+        self._events.emit("canary_start", replica=rid, version=version,
+                          bake_s=self.canary.bake_s, step=step)
+
+    def _regression_signals(self, c: _Canary, s: dict) -> list:
+        """Signals that damn the canary: its health level rose above
+        both the live peer baseline and the start-of-bake baseline, or
+        the SLO newly breached during the bake."""
+        signals = []
+        level = self._level(c.replica_id)
+        peers = [self._level(r.replica_id) for r in self.router.replicas
+                 if r.accepting and r.replica_id != c.replica_id]
+        baseline = max(max(peers, default=0), c.baseline_level)
+        if level >= 1 and level > baseline:
+            signals.append(f"health@{c.replica_id}")
+        if (self.canary.slo_gate and c.baseline_compliant
+                and not s["slo_compliant"]):
+            signals.append("slo_burn")
+        return signals
+
+    def _promote(self, now: float, c: _Canary, summary: dict) -> None:
+        peers = [r for r in self.router.replicas
+                 if r.accepting and r.replica_id != c.replica_id]
+        ok = True
+        if peers:
+            out = self.router.publish(c.new_params,
+                                      exclude=(c.replica_id,),
+                                      step=c.step,
+                                      timeout=self._publish_timeout_s)
+            ok = out["ok"]
+        if not ok:
+            self._rollback(now, c, summary, reason="promote_failed",
+                           signals=["publish_error"], dirty=True)
+            return
+        self.log.record(c.version, source="publish", step=c.step)
+        self._params_current = c.new_params
+        self._c_promotes.inc()
+        action = {"action": "canary_promote", "t": now,
+                  "replica": c.replica_id, "version": c.version,
+                  "baked_s": round(now - c.started_at, 3)}
+        summary["actions"].append(action)
+        with self._lock:
+            self._canary = None
+            self._last_outcome = action
+        self._events.emit("canary_promote", replica=c.replica_id,
+                          version=c.version,
+                          baked_s=round(now - c.started_at, 3))
+
+    def _rollback(self, now: float, c: _Canary, summary: dict, *,
+                  reason: str, signals: list, dirty: bool) -> None:
+        """Converge every accepting replica back onto the pre-canary
+        weights. ``dirty=False`` (canary lost) skips the republish — no
+        surviving replica ever held the new version."""
+        target = self.log.rollback_target()
+        if dirty:
+            # republish the OLD params fleet-wide: the canary (and any
+            # peers a failed promote already rolled) step back; replicas
+            # still on the old content take a same-content swap (a
+            # pointer exchange — zero recompiles, nothing dropped)
+            self.router.publish(c.old_params,
+                                timeout=self._publish_timeout_s)
+        self._fleet_version = target.version if target is not None else 0
+        self.log.record(self._fleet_version, source="rollback")
+        self._c_rollbacks.inc()
+        action = {"action": "canary_rollback", "t": now,
+                  "replica": c.replica_id, "reason": reason,
+                  "signals": list(signals), "version": c.version,
+                  "rolled_back_to": self._fleet_version}
+        summary["actions"].append(action)
+        with self._lock:
+            self._canary = None
+            self._last_outcome = action
+        self._events.emit("canary_rollback", replica=c.replica_id,
+                          reason=reason, signals=list(signals),
+                          version=c.version,
+                          rolled_back_to=self._fleet_version)
+
+    # ------------------------------------------------------------------ #
+    # policy 3: pre-quarantine rebalancing                                #
+    # ------------------------------------------------------------------ #
+
+    def _rebalance_tick(self, s: dict, summary: dict) -> None:
+        p = self.rebalance
+        if p is None or self.health is None:
+            return
+        for r in self.router.replicas:
+            if not r.accepting:
+                continue
+            rid = r.replica_id
+            level = self._level(rid)
+            want = p.degraded_weight if level == 1 else 1.0
+            have = self.router.admission_weight(rid)
+            if have == want:
+                continue
+            self.router.set_admission_weight(rid, want)
+            self._registry.gauge(
+                "fleet_admission_weight",
+                dict(self._labels, replica=str(rid))).set(want)
+            action = {"action": "rebalance", "replica": rid,
+                      "weight": want, "level": level}
+            summary["actions"].append(action)
+            self._events.emit("controller_rebalance", replica=rid,
+                              weight=want, level=level)
+
+    # ------------------------------------------------------------------ #
+    # observability                                                       #
+    # ------------------------------------------------------------------ #
+
+    def report(self) -> dict:
+        """The ``/control`` payload: policies, live phase, canary state,
+        version history, admission weights, and the decision ring."""
+        with self._lock:
+            canary = self._canary
+            pending = self._pending_deploy is not None
+            decisions = list(self._decisions)
+            last_outcome = self._last_outcome
+        weights = {
+            str(r.replica_id): self.router.admission_weight(r.replica_id)
+            for r in self.router.replicas if r.accepting}
+        cur = self.log.current
+        return {
+            "ticks": int(self._c_ticks.value),
+            "phase": ("baking" if canary is not None
+                      else "pending" if pending else "idle"),
+            "target_replicas": self._target,
+            "capacity": self.router.capacity,
+            "autoscale": (dict(asdict(self.autoscale),
+                               scale_ups=int(self._c_ups.value),
+                               scale_downs=int(self._c_downs.value))
+                          if self.autoscale is not None else None),
+            "canary": ({"policy": asdict(self.canary),
+                        "active": (canary.to_json()
+                                   if canary is not None else None),
+                        "last_outcome": last_outcome,
+                        "deploys": int(self._c_deploys.value),
+                        "promotes": int(self._c_promotes.value),
+                        "rollbacks": int(self._c_rollbacks.value)}
+                       if self.canary is not None else None),
+            "rebalance": (dict(asdict(self.rebalance), weights=weights)
+                          if self.rebalance is not None else None),
+            "versions": {
+                "current": {"version": cur.version, "source": cur.source,
+                            "step": cur.step},
+                "history": [{"version": e.version, "source": e.source,
+                             "step": e.step}
+                            for e in self.log.history()],
+            },
+            "decisions": decisions,
+        }
+
+
+__all__ = [
+    "AutoscalePolicy",
+    "CanaryPolicy",
+    "FleetController",
+    "RebalancePolicy",
+]

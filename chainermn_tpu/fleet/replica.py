@@ -27,7 +27,8 @@ treated as a replica failure, so a wedged collective on ONE mesh drains
 and restarts one replica while the others keep serving.
 
 Every transition is observable: a ``fleet_replica_state`` gauge per
-replica (0 starting, 1 healthy, 2 restarting, 3 quarantined, 4 stopped),
+replica (0 starting, 1 healthy, 2 restarting, 3 quarantined, 4 stopped,
+5 draining, 6 retired),
 ``fleet_replica_restarts_total{replica=}``, and
 ``fleet_replica_error`` / ``fleet_replica_quarantine`` flight-recorder
 events.
@@ -56,6 +57,8 @@ class ReplicaState(enum.Enum):
     RESTARTING = "restarting"
     QUARANTINED = "quarantined"
     STOPPED = "stopped"
+    DRAINING = "draining"     # graceful retire in progress (not accepting)
+    RETIRED = "retired"       # drained clean and released (terminal)
 
 
 _STATE_CODE = {
@@ -64,6 +67,8 @@ _STATE_CODE = {
     ReplicaState.RESTARTING: 2,
     ReplicaState.QUARANTINED: 3,
     ReplicaState.STOPPED: 4,
+    ReplicaState.DRAINING: 5,
+    ReplicaState.RETIRED: 6,
 }
 
 
@@ -133,6 +138,11 @@ class EngineReplica:
         self._idle_wait_s = idle_wait_s
         self._on_failure = on_failure
         self._state = ReplicaState.STARTING
+        # guards the state FIELD only (leaf: nothing nests under it) —
+        # the warmup thread's STARTING->HEALTHY CAS races the retire
+        # path's DRAINING; the metric gauge is updated outside the lock
+        self._state_lock = sanitizer.make_lock(
+            "EngineReplica._state_lock", leaf=True)
         self._poison: Optional[BaseException] = None
         self._work = threading.Event()
         self._stop = threading.Event()
@@ -158,13 +168,15 @@ class EngineReplica:
 
     @property
     def state(self) -> ReplicaState:
-        return self._state
+        return self._state  # graftlint: unguarded-ok — atomic enum read
 
     @property
     def accepting(self) -> bool:
         """Routable: warming up or serving (a RESTARTING replica is mid-
         recovery — don't pile new work onto it; QUARANTINED/STOPPED are
         out of the fleet)."""
+        # the lock exists for check-then-set transitions, not snapshots
+        # graftlint: unguarded-ok — one atomic enum read
         return self._state in (ReplicaState.STARTING, ReplicaState.HEALTHY)
 
     @property
@@ -185,6 +197,7 @@ class EngineReplica:
         mechanism only."""
         if not self.accepting:
             raise RuntimeError(
+                # graftlint: unguarded-ok — diagnostic read only
                 f"replica {self.replica_id} is {self._state.value}, "
                 "not accepting work")
         req = self.scheduler.submit(prompt, max_new_tokens, rng=rng,
@@ -223,23 +236,67 @@ class EngineReplica:
         self._work.set()
         if self._thread.is_alive():
             self._thread.join(timeout)
-        if self._state is not ReplicaState.QUARANTINED:
-            self._set_state(ReplicaState.STOPPED)
+        with self._state_lock:
+            if self._state not in (ReplicaState.QUARANTINED,
+                                   ReplicaState.RETIRED):
+                self._state = ReplicaState.STOPPED
+            st = self._state
+        self._g_state.set(_STATE_CODE[st])
+
+    # ------------------------------------------------------------------ #
+    # graceful retire (the scale-down actuator)                           #
+    # ------------------------------------------------------------------ #
+
+    def begin_retire(self) -> None:
+        """Enter DRAINING: stop accepting new work while the drive loop
+        keeps stepping the in-flight requests to completion. The router's
+        :meth:`~chainermn_tpu.fleet.router.FleetRouter.retire_replica`
+        owns the full sequence (drain QUEUED, wait in-flight, stop)."""
+        with self._state_lock:
+            if self._state not in (ReplicaState.STARTING,
+                                   ReplicaState.HEALTHY):
+                raise RuntimeError(
+                    f"replica {self.replica_id} is {self._state.value}, "
+                    "cannot retire")
+            self._state = ReplicaState.DRAINING
+        self._g_state.set(_STATE_CODE[ReplicaState.DRAINING])
+
+    def finish_retire(self, timeout: float = 10.0) -> None:
+        """Stop the drive thread and mark RETIRED (only reached when the
+        drain completed; a failure mid-drain quarantines instead)."""
+        self._stop.set()
+        self._work.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        self._transition_if(ReplicaState.DRAINING, ReplicaState.RETIRED)
 
     # ------------------------------------------------------------------ #
     # the drive loop (one thread per replica)                             #
     # ------------------------------------------------------------------ #
 
     def _set_state(self, state: ReplicaState) -> None:
-        self._state = state
+        with self._state_lock:
+            self._state = state
         self._g_state.set(_STATE_CODE[state])
+
+    def _transition_if(self, frm: ReplicaState, to: ReplicaState) -> bool:
+        """Compare-and-set state transition. The guard matters: a replica
+        retired (or killed) while its warmup is still compiling must NOT
+        be resurrected to HEALTHY when the warmup lands — the controller
+        scales down faster than a cold engine warms."""
+        with self._state_lock:
+            if self._state is not frm:
+                return False
+            self._state = to
+        self._g_state.set(_STATE_CODE[to])
+        return True
 
     def _loop(self) -> None:
         try:
             # each replica warms its OWN compiled-program family, in
             # parallel with its peers (warmup is idempotent)
             self.engine.warmup()
-            self._set_state(ReplicaState.HEALTHY)
+            self._transition_if(ReplicaState.STARTING, ReplicaState.HEALTHY)
         except Exception as e:  # noqa: BLE001 — a replica that cannot warm
             self._quarantine(e)  # up must not take traffic
             self.ready.set()
@@ -270,6 +327,7 @@ class EngineReplica:
                     self._work.wait(self._idle_wait_s)
             except Exception as e:  # noqa: BLE001 — the supervisor boundary
                 self._supervise_failure(e)
+                # graftlint: unguarded-ok — own-thread read after verdict
                 if self._state is not ReplicaState.HEALTHY:
                     return
 
@@ -289,10 +347,15 @@ class EngineReplica:
         drain QUEUED work for re-routing, then warm-restart within budget
         or quarantine. The router's callback runs LAST, once this
         replica's fate is decided, so re-routing sees the true fleet."""
+        # a failure while DRAINING must not warm-restart the replica back
+        # into the accepting pool — the retire decision stands, so the
+        # failure is terminal (quarantine; in-flight work re-routes)
+        # graftlint: unguarded-ok — atomic read on the replica's own thread
+        fatal_drain = self._state is ReplicaState.DRAINING
         self._set_state(ReplicaState.RESTARTING)
         self.scheduler.fail_inflight(e)
         drained = self.scheduler.drain_queued()
-        fatal = isinstance(e, ReplicaKilled)
+        fatal = isinstance(e, ReplicaKilled) or fatal_drain
         restarted = False
         if (not fatal and self.restarts < self.max_restarts
                 and not self._stop.is_set()):

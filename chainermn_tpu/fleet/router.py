@@ -267,6 +267,14 @@ class FleetRouter:
         # replicas are deprioritized before the supervisor would
         # quarantine them
         self._health = None
+        # optional FleetController (attach_controller): fleet_report
+        # grows a "control" block; the controller itself only ever calls
+        # INTO the router (never the other way), so no call cycle exists
+        self._controller = None
+        # per-replica admission weights (the control plane's rebalance
+        # actuator): missing key = full weight 1.0
+        self._weights: dict[int, float] = sanitizer.guarded(
+            {}, lock=self._lock, name="FleetRouter._weights")
         self.replicas = [
             EngineReplica(i, eng, on_failure=self._on_replica_failure,
                           labels=labels, autostart=autostart,
@@ -285,10 +293,14 @@ class FleetRouter:
             r.start()
 
     def wait_ready(self, timeout: float = 300.0) -> bool:
-        """Block until every replica finished warmup (compiled programs
-        built); True when all are ready within the timeout."""
+        """Block until every replica still accepting work finished warmup
+        (compiled programs built); True when all are ready within the
+        timeout. Retired/quarantined replicas are skipped — a replica
+        retired before it ever started will never signal ready."""
         deadline = time.perf_counter() + timeout
         for r in self.replicas:
+            if not r.accepting:
+                continue
             if not r.ready.wait(max(0.0, deadline - time.perf_counter())):
                 return False
         return True
@@ -311,6 +323,33 @@ class FleetRouter:
         ``health`` block. Detach with ``attach_health(None)``."""
         with self._lock:
             self._health = monitor
+
+    def attach_controller(self, controller) -> None:
+        """Attach a :class:`~chainermn_tpu.fleet.control.FleetController`
+        so :meth:`fleet_report` carries its decision state under
+        ``"control"``. Detach with ``attach_controller(None)``."""
+        with self._lock:
+            self._controller = controller
+
+    def set_admission_weight(self, replica_id: int, weight: float) -> None:
+        """Scale how much new traffic ``replica_id`` attracts (0 < w <=
+        1; 1.0 resets). The routing policy divides the replica's
+        normalized load by its weight, so a shed replica looks
+        proportionally busier and loses placements it would otherwise
+        win — without ever becoming unroutable (pre-quarantine
+        rebalancing, driven by the control plane)."""
+        w = float(weight)
+        if not 0.0 < w <= 1.0:
+            raise ValueError(f"admission weight must be in (0, 1], got {w}")
+        with self._lock:
+            if w == 1.0:
+                self._weights.pop(int(replica_id), None)
+            else:
+                self._weights[int(replica_id)] = w
+
+    def admission_weight(self, replica_id: int) -> float:
+        with self._lock:
+            return self._weights.get(int(replica_id), 1.0)
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop every replica thread and settle every outstanding request
@@ -413,6 +452,9 @@ class FleetRouter:
         if self._health is not None:
             for s in snaps:
                 s.health = self._health.level(str(s.replica_id))
+        if self._weights:
+            for s in snaps:
+                s.admission_weight = self._weights.get(s.replica_id, 1.0)
         return snaps
 
     def _route_locked(self, prompt, snaps, exclude: Optional[int] = None
@@ -652,7 +694,8 @@ class FleetRouter:
     # ------------------------------------------------------------------ #
 
     def publish(self, params, *, step: Optional[int] = None,
-                timeout: float = 60.0) -> dict:
+                timeout: float = 60.0, canary: Optional[int] = None,
+                exclude: Sequence = ()) -> dict:
         """Rolling weight publish: swap ``params`` into every replica,
         ONE at a time. While a replica is fenced (draining its in-flight
         work before the swap), routing steers new submissions to its
@@ -661,12 +704,27 @@ class FleetRouter:
         started with. A replica that fails its swap (or is quarantined)
         is recorded and skipped; the roll continues, so one bad replica
         never wedges the deployment. Returns a per-replica outcome dict;
-        ``ok`` is True only when every accepting replica took the new
-        version."""
+        ``ok`` is True only when every targeted accepting replica took
+        the new version.
+
+        ``canary=rid`` swaps EXACTLY that one replica (the control
+        plane's canary path: blast radius 1/N for one bake window);
+        ``exclude=(rid, ...)`` rolls everyone else (the promote path —
+        the canary already carries the new version). The two are
+        mutually exclusive."""
         from chainermn_tpu.deploy.publish import WeightPublisher
 
+        if canary is not None and exclude:
+            raise ValueError("publish: canary= and exclude= are mutually "
+                             "exclusive")
+        if canary is not None:
+            targets = [self.replicas[int(canary)]]
+        else:
+            skip = {int(i) for i in exclude}
+            targets = [r for r in list(self.replicas)
+                       if r.replica_id not in skip]
         results: dict[str, dict] = {}
-        for replica in list(self.replicas):
+        for replica in targets:
             rid = replica.replica_id
             if not replica.accepting:
                 results[str(rid)] = {"ok": False,
@@ -695,7 +753,7 @@ class FleetRouter:
                     self._publishing.discard(rid)
         ok = all(r.get("ok") for r in results.values()
                  if "skipped" not in r) and bool(results)
-        self._events.emit("fleet_publish", ok=ok,
+        self._events.emit("fleet_publish", ok=ok, canary=canary,
                           replicas={k: v.get("version", None)
                                     for k, v in results.items()})
         return {"ok": ok, "replicas": results}
@@ -748,6 +806,52 @@ class FleetRouter:
             replica.ready.wait(timeout)
         return replica
 
+    def retire_replica(self, replica_id: int, *,
+                       timeout: float = 60.0) -> dict:
+        """Gracefully take one replica OUT of the fleet — the clean
+        scale-down actuator (quarantine is the failure-driven one).
+
+        Sequence: the replica enters DRAINING (no longer accepting, its
+        drive loop keeps stepping), its QUEUED work is drained and
+        re-routed to peers (nothing ever started, nothing lost), in-
+        flight requests finish on the weights they started with, then
+        the thread stops and the replica lands RETIRED. If in-flight
+        work outlives ``timeout`` the replica is hard-killed instead —
+        the supervisor's drain-failure path re-routes the stragglers and
+        quarantines (``forced=True`` in the result)."""
+        replica = self.replicas[replica_id]
+        rid = replica.replica_id
+        replica.begin_retire()          # raises unless accepting
+        with self._lock:
+            # its prefix beliefs die with it: stop routing affinity
+            # traffic at KV that is about to be released
+            self._trie.drop_replica(rid)
+        drained = replica.scheduler.drain_queued()
+        drained_ids = {id(req) for req in drained}
+        with self._lock:
+            affected = [fr for fr in list(self._requests.values())
+                        if fr.replica_id == rid and not fr.finished]
+        for fr in affected:
+            inner = fr._inner
+            if inner is not None and id(inner) in drained_ids:
+                self._rebind_drained(fr, inner)
+        deadline = time.perf_counter() + timeout
+        while replica.scheduler.has_work \
+                and time.perf_counter() < deadline:
+            time.sleep(0.002)
+        forced = bool(replica.scheduler.has_work)
+        if forced:
+            # stragglers past the drain budget: the supervisor path
+            # fails them over to peers and quarantines the replica
+            replica.kill(ReplicaKilled(
+                f"replica {rid} retire drain exceeded {timeout}s"))
+        else:
+            replica.finish_retire()
+        self._events.emit("fleet_retire", replica=rid,
+                          drained=len(drained), forced=forced)
+        return {"replica": rid, "drained": len(drained), "forced": forced,
+                "state": replica.state.value}
+
     # ------------------------------------------------------------------ #
     # observability                                                       #
     # ------------------------------------------------------------------ #
@@ -781,9 +885,15 @@ class FleetRouter:
         misses = int(self._c_aff_miss.value)
         with self._lock:
             hm = self._health
+            ctrl = self._controller
+            weights = dict(self._weights)
+        for rid, w in weights.items():
+            replicas.get(str(rid), {})["admission_weight"] = w
         health = hm.report() if hm is not None else None
+        control = ctrl.report() if ctrl is not None else None
         return {
             "health": health,
+            "control": control,
             "replicas": replicas,
             "capacity": self.capacity,
             "n_replicas": len(self.replicas),

@@ -48,7 +48,11 @@ class ReplicaSnapshot:
     1 degraded / 2 critical, from :class:`~chainermn_tpu.monitor.health.
     HealthMonitor` when the router has one attached): it outranks load,
     so a degraded replica is deprioritized while it can still serve —
-    the step *before* the supervisor would quarantine it."""
+    the step *before* the supervisor would quarantine it.
+    ``admission_weight`` (0 < w <= 1) is the control plane's rebalance
+    knob: shedding a replica's weight inflates its apparent load, so the
+    policy sends it proportionally less traffic without ever making it
+    unroutable — the step before even the health penalty."""
 
     replica_id: int
     healthy: bool = True
@@ -58,10 +62,16 @@ class ReplicaSnapshot:
     ttft_ewma_s: float = 0.0
     kv_free_frac: float = 1.0
     health: int = 0
+    admission_weight: float = 1.0
 
     @property
     def load(self) -> float:
-        return (self.queue_depth + self.active_slots) / max(self.n_slots, 1)
+        # the epsilon keeps the weight effective at zero occupancy (an
+        # idle shed replica still loses ties to an idle full-weight
+        # peer); at weight 1.0 it is a constant offset and cancels out
+        # of every comparison the policy makes
+        raw = (self.queue_depth + self.active_slots) / max(self.n_slots, 1)
+        return (raw + 1e-3) / max(self.admission_weight, 1e-6)
 
 
 @dataclass

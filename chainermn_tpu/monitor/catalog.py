@@ -89,6 +89,16 @@ METRIC_NAMES = frozenset({
     "fleet_reroutes_total",
     "fleet_route_fallbacks_total",
     "fleet_shed_total",
+    # control plane (autoscaler + canary deploys + rebalancing)
+    "canary_deploys_total",
+    "canary_promotes_total",
+    "canary_rollbacks_total",
+    "controller_canary_phase",
+    "controller_scale_downs_total",
+    "controller_scale_ups_total",
+    "controller_target_replicas",
+    "controller_ticks_total",
+    "fleet_admission_weight",
     # SLO
     "slo_breaches_total",
     "slo_burn_rate",
@@ -148,11 +158,19 @@ EVENT_KINDS = frozenset({
     "fleet_publish",
     "fleet_replica_error",
     "fleet_replica_quarantine",
+    "fleet_retire",
     "fleet_route",
     "fleet_route_fallback",
     "fleet_shed",
     "fleet_spawn",
     "fleet_spawn_restore",
+    # control plane (edge-triggered controller decisions)
+    "canary_promote",
+    "canary_rollback",
+    "canary_start",
+    "controller_rebalance",
+    "controller_scale_down",
+    "controller_scale_up",
     "publish",
     "publish_failed",
     "swap_exec",

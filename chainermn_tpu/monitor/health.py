@@ -60,8 +60,10 @@ _LEVEL_BY_SEVERITY = {"degraded": 1, "critical": 2}
 
 # replica lifecycle states that are NOT critical by themselves (the
 # fleet's ReplicaState enum values; anything else — restarting,
-# quarantined, stopped — maps straight to CRITICAL)
-_BENIGN_LIFECYCLE = ("starting", "healthy")
+# quarantined, stopped — maps straight to CRITICAL). Draining/retired
+# are deliberate control-plane transitions, not failures: a gracefully
+# retiring replica must not drag the fleet's worst-of verdict down.
+_BENIGN_LIFECYCLE = ("starting", "healthy", "draining", "retired")
 
 
 @dataclass
@@ -121,6 +123,22 @@ class HealthMonitor:
         w = _Watch(detectors, state_fn, restarts_fn)
         with self._lock:
             self._watches[str(key)] = w
+        return self
+
+    def unwatch(self, key) -> "HealthMonitor":
+        """Stop scoring ``key`` and forget its last score (a retired
+        replica must drop out of the worst-of fleet verdict, not linger
+        at whatever state it last held)."""
+        with self._lock:
+            self._watches.pop(str(key), None)
+            self._scores.pop(str(key), None)
+        return self
+
+    def add_detectors(self, key, *detectors) -> "HealthMonitor":
+        """Extend an existing watch with more detectors (the canary
+        path wires regression probes onto an already-watched replica)."""
+        with self._lock:
+            self._watches[str(key)].detectors.extend(detectors)
         return self
 
     @property
@@ -275,6 +293,29 @@ def standard_replica_sensors(instance: str, *,
     return signals, detectors
 
 
+def wire_replica(collector: Collector, monitor: HealthMonitor, replica, *,
+                 stall_timeout_s: float = 10.0, spec: bool = False,
+                 **sensor_kw) -> None:
+    """Wire ONE fleet replica into an existing collector + monitor: the
+    standard sensor set (keyed by the replica's metrics instance, tagged
+    by replica id), lifecycle + restart-latch probes, and the metrics-
+    report ``health`` block. :func:`fleet_health` calls this for the
+    constructor-time fleet; the control plane calls it again for every
+    replica it spawns, so scaled-up capacity is scored from its first
+    tick."""
+    signals, detectors = standard_replica_sensors(
+        replica.metrics.instance, stall_timeout_s=stall_timeout_s,
+        spec=spec, tag=str(replica.replica_id),
+        active_fn=(lambda r=replica: r.busy), **sensor_kw)
+    for sig in signals:
+        collector.add_signal(sig)
+    monitor.watch(str(replica.replica_id), detectors=detectors,
+                  state_fn=(lambda r=replica: r.state),
+                  restarts_fn=(lambda r=replica: r.restarts))
+    replica.metrics.attach_health(
+        lambda m=monitor, k=str(replica.replica_id): m.score_json(k))
+
+
 def fleet_health(router, *, cadence_s: float = 0.25, registry=None,
                  events=None, clock=None, maxlen: int = 512,
                  stall_timeout_s: float = 10.0,
@@ -294,17 +335,9 @@ def fleet_health(router, *, cadence_s: float = 0.25, registry=None,
     collector = Collector(registry=registry, events=events, store=store,
                           cadence_s=cadence_s, clock=clock)
     for replica in router.replicas:
-        signals, detectors = standard_replica_sensors(
-            replica.metrics.instance, stall_timeout_s=stall_timeout_s,
-            spec=spec, tag=str(replica.replica_id),
-            active_fn=(lambda r=replica: r.busy), **sensor_kw)
-        for sig in signals:
-            collector.add_signal(sig)
-        monitor.watch(str(replica.replica_id), detectors=detectors,
-                      state_fn=(lambda r=replica: r.state),
-                      restarts_fn=(lambda r=replica: r.restarts))
-        replica.metrics.attach_health(
-            lambda m=monitor, k=str(replica.replica_id): m.score_json(k))
+        wire_replica(collector, monitor, replica,
+                     stall_timeout_s=stall_timeout_s, spec=spec,
+                     **sensor_kw)
     collector.attach_health(monitor)
     router.attach_health(monitor)
     return collector
@@ -318,4 +351,5 @@ __all__ = [
     "HealthScore",
     "fleet_health",
     "standard_replica_sensors",
+    "wire_replica",
 ]

@@ -24,6 +24,10 @@ browser, ``curl``, a future fleet router polling replica burn rates:
   FleetRouter.fleet_report` as JSON (replica states, reroute/shed
   counters, affinity hit rate, fleet-pooled latency percentiles) when a
   router was passed to :func:`serve`; ``{}`` otherwise;
+- ``/control`` — the fleet control plane's :meth:`~chainermn_tpu.fleet.
+  control.FleetController.report` (autoscaler state, canary phase,
+  version history, decision ring) when a controller was passed to
+  :func:`serve`;
 - ``/``        — a plain-text index of the above.
 
 Serving is read-only and allocation-light: every handler renders from
@@ -53,12 +57,14 @@ class MonitorServer:
     """Owns the background HTTP server; build via :func:`serve`."""
 
     def __init__(self, host: str, port: int, *, registry, events, tracer,
-                 slo, fleet=None, timeseries=None, health=None) -> None:
+                 slo, fleet=None, timeseries=None, health=None,
+                 controller=None) -> None:
         self._registry = registry
         self._events = events
         self._tracer = tracer
         self._slo = slo
         self._fleet = fleet
+        self._controller = controller
         # a Collector is accepted where a TimeSeriesStore is expected —
         # the scrape serves the collector's store either way
         self._timeseries = getattr(timeseries, "store", timeseries)
@@ -135,6 +141,11 @@ class MonitorServer:
                        if self._health is not None else {})
             return (200, "application/json",
                     json.dumps(payload, default=str).encode())
+        if route == "/control":
+            payload = (self._controller.report()
+                       if self._controller is not None else {})
+            return (200, "application/json",
+                    json.dumps(payload, default=str).encode())
         if route == "/":
             index = ("chainermn_tpu monitor\n"
                      "  /metrics     Prometheus text exposition\n"
@@ -145,7 +156,9 @@ class MonitorServer:
                      "states, pooled percentiles)\n"
                      "  /timeseries  telemetry ring buffers "
                      "(?last=N&prefix=)\n"
-                     "  /health      per-replica health scores\n")
+                     "  /health      per-replica health scores\n"
+                     "  /control     fleet control-plane report "
+                     "(autoscaler, canary, rebalance)\n")
             return 200, "text/plain; charset=utf-8", index.encode()
         return 404, "text/plain; charset=utf-8", b"not found\n"
 
@@ -169,7 +182,7 @@ class MonitorServer:
 
 def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
           events=None, tracer=None, slo=None, fleet=None,
-          timeseries=None, health=None) -> MonitorServer:
+          timeseries=None, health=None, controller=None) -> MonitorServer:
     """Stand up the scrape endpoint on a background thread and return the
     running :class:`MonitorServer` (``.port`` carries the bound port when
     ``port=0``). Defaults wire the process-wide registry, flight
@@ -181,8 +194,10 @@ def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
     TimeSeriesStore` or :class:`~chainermn_tpu.monitor.timeseries.
     Collector`) lights up ``/timeseries`` and ``health=`` (a
     :class:`~chainermn_tpu.monitor.health.HealthMonitor`) lights up
-    ``/health`` — continuous telemetry is explicitly owned too. Close
-    with :meth:`MonitorServer.close` (also a context manager)."""
+    ``/health`` — continuous telemetry is explicitly owned too, as is
+    ``controller=`` (a :class:`~chainermn_tpu.fleet.control.
+    FleetController`) for ``/control``. Close with
+    :meth:`MonitorServer.close` (also a context manager)."""
     if registry is None:
         registry = get_registry()
     if events is None:
@@ -197,7 +212,8 @@ def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
         slo = get_slo_engine()
     return MonitorServer(host, port, registry=registry, events=events,
                          tracer=tracer, slo=slo, fleet=fleet,
-                         timeseries=timeseries, health=health)
+                         timeseries=timeseries, health=health,
+                         controller=controller)
 
 
 __all__ = ["MonitorServer", "serve"]

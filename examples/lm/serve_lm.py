@@ -74,6 +74,16 @@ geometry drives the fused-qkv layout permutation); pair with
 ``train_lm.py --snapshot-to`` for the train→reshard→serve chain, or with
 ``train_lm.py --publish-to engine`` for the online hot-swap variant.
 
+And the closed-loop control plane (ISSUE 16): ``--autoscale`` runs a
+background :class:`~chainermn_tpu.fleet.control.FleetController` over
+the fleet — sustained queue pressure spawns replicas (up to
+``--max-replicas``), sustained idleness retires them (down to
+``--min-replicas``), and ``--canary`` then demonstrates an SLO-guarded
+canary deploy end to end: bumped weights swap onto ONE replica, bake,
+and promote fleet-wide (or auto-rollback on regression), with the
+controller's decision ring and version history printed at the end and
+served live at ``/control`` with ``--http-port``.
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -98,6 +108,11 @@ Run (CPU mesh; any accelerator works the same)::
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/lm/serve_lm.py --paged-kv --temperature 0 \
         --speculate ngram --spec-k 4
+
+    # closed-loop autoscaling + a canary deploy through the controller:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/lm/serve_lm.py --autoscale --min-replicas 1 \
+        --max-replicas 3 --slots 1 --requests 24 --canary
 """
 
 from __future__ import annotations
@@ -189,6 +204,26 @@ def main() -> None:
                          "trie holds it, within the load-imbalance bound")
     ap.add_argument("--no-affinity", dest="affinity", action="store_false",
                     help="pure occupancy-aware least-loaded routing")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="closed-loop fleet control (ISSUE 16): a "
+                         "background FleetController scales the fleet "
+                         "between --min-replicas and --max-replicas on "
+                         "sustained queue pressure / idleness (implies "
+                         "fleet mode and the --health telemetry wiring)")
+    ap.add_argument("--min-replicas", type=int, default=1,
+                    help="autoscale floor (also the starting fleet size "
+                         "when --autoscale is given without --replicas)")
+    ap.add_argument("--max-replicas", type=int, default=3,
+                    help="autoscale ceiling")
+    ap.add_argument("--canary", action="store_true",
+                    help="after the burst, deploy bumped weights through "
+                         "the controller's canary path: one replica "
+                         "takes them, bakes for --canary-bake seconds "
+                         "against the fleet health/SLO baseline, then "
+                         "promotes fleet-wide (or auto-rollbacks on "
+                         "regression); needs --autoscale")
+    ap.add_argument("--canary-bake", type=float, default=1.0,
+                    help="canary bake window in seconds (--canary)")
     ap.add_argument("--reshard-from", default="",
                     help="restore the serving params from a "
                          "ShardedCheckpointer snapshot directory through "
@@ -345,13 +380,18 @@ def main() -> None:
         temperature=args.temperature, comm=comm,
         watchdog=args.watchdog or None, **paged_kw,
     )
-    fleet_mode = args.replicas > 1
+    if args.canary and not args.autoscale:
+        raise SystemExit("--canary deploys through the controller; add "
+                         "--autoscale")
+    fleet_mode = args.replicas > 1 or args.autoscale
+    n_start = (max(args.replicas, args.min_replicas) if args.autoscale
+               else args.replicas)
     eos = None if args.eos_id < 0 else args.eos_id
     if fleet_mode:
         from chainermn_tpu.fleet import FleetRouter
 
         engines = [ServingEngine(model, params, **engine_kw)
-                   for _ in range(args.replicas)]
+                   for _ in range(n_start)]
         engine = engines[0]
         front = FleetRouter(engines, eos_id=eos, affinity=args.affinity,
                             max_queue=args.max_queue or None,
@@ -365,7 +405,7 @@ def main() -> None:
                               default_deadline_s=args.deadline or None)
 
     collector = None
-    if args.health:
+    if args.health or args.autoscale:
         from chainermn_tpu.monitor.health import (
             HealthMonitor,
             fleet_health,
@@ -394,6 +434,27 @@ def main() -> None:
                 lambda m=health_mon: m.score_json("0"))
         collector.start()
 
+    controller = None
+    if args.autoscale:
+        from chainermn_tpu.fleet import (
+            AutoscalePolicy,
+            CanaryPolicy,
+            FleetController,
+        )
+
+        controller = FleetController(
+            front, collector,
+            engine_factory=lambda: ServingEngine(model, params,
+                                                 **engine_kw),
+            autoscale=AutoscalePolicy(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                queue_high=1.0, idle_low=0.25, up_after_s=0.2,
+                down_after_s=1.0, cooldown_s=0.3),
+            canary=CanaryPolicy(bake_s=args.canary_bake),
+            cadence_s=0.05, sensor_kw=dict(stall_timeout_s=30.0))
+        controller.start()
+
     monitor.get_tracer().configure(sample=args.trace)
     slo_engine = None
     if args.slo_ttft_ms:
@@ -407,9 +468,11 @@ def main() -> None:
             port=args.http_port, slo=slo_engine,
             fleet=front if fleet_mode else None,
             timeseries=collector,
-            health=collector.health if collector is not None else None)
+            health=collector.health if collector is not None else None,
+            controller=controller)
         print(f"monitor endpoints at {server.url} "
-              "(/metrics /traces /slo /events /fleet /timeseries /health)")
+              "(/metrics /traces /slo /events /fleet /timeseries "
+              "/health /control)")
     shared = (rng.randint(2, args.vocab, args.shared_prefix)
               .astype(np.int32) if args.shared_prefix else
               np.zeros((0,), np.int32))
@@ -450,6 +513,37 @@ def main() -> None:
             except Exception as e:  # shed past --deadline, or engine-failed
                 shed_or_failed += 1
                 print(f"request {h.id}: {type(e).__name__}: {e}")
+        if controller is not None and args.canary:
+            # the canary path end to end: bumped weights onto ONE
+            # replica, bake against the fleet baseline, promote (or
+            # auto-rollback) — driven entirely by the background loop
+            new_params = jax.tree_util.tree_map(
+                lambda a: a + jnp.asarray(0.01, a.dtype), params)
+            controller.deploy(new_params, step=1)
+            deadline = time.time() + 120
+            outcome = None
+            while time.time() < deadline:
+                crep = controller.report()
+                outcome = (crep["canary"] or {}).get("last_outcome")
+                if outcome is not None and crep["phase"] == "idle":
+                    break
+                time.sleep(0.05)
+            assert outcome is not None, "canary deploy never resolved"
+            print(f"canary deploy: {outcome['action']} "
+                  f"(replica {outcome['replica']}, "
+                  f"version {outcome.get('version')})")
+        if controller is not None:
+            crep = controller.report()
+            cur = crep["versions"]["current"]
+            print(f"controller: capacity={crep['capacity']} "
+                  f"target={crep['target_replicas']} "
+                  f"scale_ups={crep['autoscale']['scale_ups']} "
+                  f"scale_downs={crep['autoscale']['scale_downs']}")
+            for d in crep["decisions"]:
+                print(f"  decision: {d}")
+            print(f"weights: version={cur['version']} ({cur['source']}) "
+                  f"history={[(h['version'], h['source']) for h in crep['versions']['history']]}")
+            controller.stop()
         if fleet_mode:
             fleet_rep = client.fleet_report()
             pooled_ttft = fleet_rep["pooled"]["histograms"].get(

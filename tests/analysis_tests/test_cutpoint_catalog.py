@@ -39,11 +39,19 @@ PINNED_CUTPOINTS = (
 
 PINNED_METRICS = frozenset({
     "cached_prefix_frac",
+    "canary_deploys_total",
+    "canary_promotes_total",
+    "canary_rollbacks_total",
     "checkpoint_async_errors_total",
     "checkpoint_async_save_seconds",
     "checkpoint_corrupt_total",
     "checkpoint_load_seconds",
     "checkpoint_save_seconds",
+    "controller_canary_phase",
+    "controller_scale_downs_total",
+    "controller_scale_ups_total",
+    "controller_target_replicas",
+    "controller_ticks_total",
     "deploy_swap_failures_total",
     "deploy_swap_seconds",
     "deploy_swaps_total",
@@ -53,6 +61,7 @@ PINNED_METRICS = frozenset({
     "dispatch_inflight",
     "dispatch_lag_steps",
     "faults_injected_total",
+    "fleet_admission_weight",
     "fleet_affinity_hits_total",
     "fleet_affinity_misses_total",
     "fleet_replica_restarts_total",
@@ -119,12 +128,18 @@ PINNED_METRICS = frozenset({
 
 PINNED_EVENTS = frozenset({
     "admission_error",
+    "canary_promote",
+    "canary_rollback",
+    "canary_start",
     "checkpoint_async_error",
     "checkpoint_corrupt",
     "checkpoint_load",
     "checkpoint_save",
     "checkpoint_save_async_enqueued",
     "compile",
+    "controller_rebalance",
+    "controller_scale_down",
+    "controller_scale_up",
     "decode_step",
     "detector_cleared",
     "detector_fired",
@@ -135,6 +150,7 @@ PINNED_EVENTS = frozenset({
     "fleet_publish",
     "fleet_replica_error",
     "fleet_replica_quarantine",
+    "fleet_retire",
     "fleet_route",
     "fleet_route_fallback",
     "fleet_shed",

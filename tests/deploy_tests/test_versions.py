@@ -60,3 +60,29 @@ def test_concurrent_records_all_land():
     for t in threads:
         t.join()
     assert len(log) == 1 + n_threads * per
+
+
+def test_rollback_target_is_newest_differing_version():
+    log = VersionLog()
+    assert log.rollback_target() is None        # only v0 ever seen
+    log.record(1, source="canary")
+    t = log.rollback_target()
+    assert (t.version, t.source) == (0, "init")
+    log.record(1, source="publish")             # promote: same version
+    t = log.rollback_target()
+    assert (t.version, t.source) == (0, "init")
+    log.record(2, source="canary")
+    t = log.rollback_target()
+    # the newest DIFFERING entry — the promoted v1, not init
+    assert (t.version, t.source) == (1, "publish")
+    log.record(1, source="rollback")
+    t = log.rollback_target()
+    assert (t.version, t.source) == (2, "canary")
+
+
+def test_rollback_target_skips_retried_same_version():
+    log = VersionLog()
+    log.record(5, source="publish")
+    log.record(5, source="publish")             # retried publish
+    t = log.rollback_target()
+    assert (t.version, t.source) == (0, "init")

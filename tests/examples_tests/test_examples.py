@@ -271,6 +271,28 @@ def test_serve_lm_health_fleet():
     assert "replica 1: healthy" in proc.stdout
 
 
+@pytest.mark.slow  # another multi-second subprocess run: full-suite only, to keep tier-1 inside its timeout
+def test_serve_lm_autoscale_canary():
+    """ISSUE 16: ``--autoscale`` runs the closed-loop controller over
+    the serving burst — queue pressure on the single starting replica
+    scales the fleet up, the post-burst idle window scales it back
+    down, and ``--canary`` deploys bumped weights through the canary
+    path end to end (one-replica bake, then promote)."""
+    # default model size on purpose: the burst must OUTLAST the 0.2 s
+    # pressure window on the one starting slot, or no scale-up fires
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "24", "--slots", "1", "--autoscale",
+         "--min-replicas", "1", "--max-replicas", "2",
+         "--canary", "--canary-bake", "0.5"],
+    )
+    assert "24/24 requests served" in proc.stdout
+    assert "'action': 'scale_up'" in proc.stdout
+    assert "canary deploy: canary_promote" in proc.stdout
+    assert "version=1 (publish)" in proc.stdout
+    assert "zero recompiles" in proc.stdout
+
+
 @pytest.mark.slow  # two more multi-second subprocess runs: full-suite only, to keep tier-1 inside its timeout
 def test_train_lm_publish_to_engine():
     """ISSUE 10: the online train→serve loop — a live engine comes up

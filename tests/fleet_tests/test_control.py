@@ -1,0 +1,383 @@
+"""Deterministic control-plane acceptance (ISSUE 16): every test drives
+``Collector.tick(now=)`` + ``FleetController.tick(now=)`` by hand, so
+hysteresis windows, bake windows, and cooldowns are exact — no sleeps,
+no wall-clock races.
+
+The headline test proves the full reflex arc end to end: a sustained
+queue-depth breach emits ``controller_scale_up`` and the spawned
+replica serves traffic at the fleet's current weight version; a canary
+deploy bakes and promotes; an injected post-swap health regression on
+the next canary emits ``canary_rollback`` and every replica converges
+back onto ``rollback_target()`` with zero dropped requests and zero
+recompiles on survivors.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.fleet import (
+    AutoscalePolicy,
+    CanaryPolicy,
+    FleetController,
+    FleetRouter,
+    RebalancePolicy,
+    ReplicaState,
+)
+from chainermn_tpu.models import TransformerLM
+from chainermn_tpu.monitor.health import fleet_health
+from chainermn_tpu.monitor.timeseries import ThresholdDetector
+from chainermn_tpu.serving import ServingEngine
+
+NEVER = 1e9           # hysteresis window that can't elapse in a test
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=1,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make_engine(lm, params):
+    return ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                         cache_len=32)
+
+
+def _bump(params, delta=0.01):
+    return jax.tree_util.tree_map(
+        lambda a: a + jnp.asarray(delta, a.dtype), params)
+
+
+def _params_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.allclose(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _wait(pred, timeout=60.0, what="condition"):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _actions(summary):
+    return [a["action"] for a in summary["actions"]]
+
+
+# --------------------------------------------------------------------- #
+# the reflex arc (acceptance)                                           #
+# --------------------------------------------------------------------- #
+
+def test_reflex_arc_scale_up_canary_promote_then_auto_rollback(
+        lm_and_params):
+    """Sense -> decide -> act, closed: queue breach scales up, a canary
+    bakes and promotes, a regressing canary auto-rollbacks — all under
+    injected clocks."""
+    lm, params = lm_and_params
+    with FleetRouter([make_engine(lm, params)], autostart=False) as router:
+        col = fleet_health(router, stall_timeout_s=60.0)
+        mon = col.health
+        ctrl = FleetController(
+            router, col,
+            engine_factory=lambda: make_engine(lm, params),
+            autoscale=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                      queue_high=2.0, up_after_s=1.0,
+                                      down_after_s=NEVER, cooldown_s=0.0),
+            canary=CanaryPolicy(bake_s=2.0),
+            sensor_kw=dict(stall_timeout_s=60.0))
+
+        # replica 0's thread is not driving, so submissions accumulate
+        # REAL queue depth — sustained pressure, deterministically
+        frs = [router.submit(np.array([1 + i, 2], np.int32), 2)
+               for i in range(6)]
+        col.tick(now=1.0)
+        s1 = ctrl.tick(now=1.0)
+        assert "queue_depth" in s1["signals"]["pressure"]
+        assert s1["actions"] == []          # breach seen, not yet sustained
+        assert router.capacity == 1
+        col.tick(now=2.5)
+        s2 = ctrl.tick(now=2.5)
+        assert _actions(s2) == ["scale_up"]
+        assert s2["actions"][0]["signals"] == ["queue_depth"]
+        assert len(router.replicas) == 2
+        assert mon.keys == ["0", "1"]       # spawned replica is health-wired
+        assert ctrl.report()["autoscale"]["scale_ups"] == 1
+
+        # the fleet goes live: queued work drains, nothing was lost
+        router.start()
+        assert router.wait_ready(300)
+        for fr in frs:
+            assert fr.wait(timeout=120)
+        assert all(fr.state.name == "DONE" for fr in frs)
+        # ... and the spawned replica serves at the fleet's version
+        assert [r.engine.weight_version for r in router.replicas] == [0, 0]
+        live = [router.submit(np.array([7 + i], np.int32), 2)
+                for i in range(6)]
+        for fr in live:
+            assert fr.wait(timeout=120)
+        assert {fr.replica_id for fr in live} == {0, 1}
+
+        # ---- canary deploy: bake window, then promote ----------------- #
+        v1 = _bump(params)
+        ctrl.deploy(v1, step=1)
+        assert ctrl.report()["phase"] == "pending"
+        col.tick(now=3.0)
+        s3 = ctrl.tick(now=3.0)
+        assert _actions(s3) == ["canary_start"]
+        assert ctrl.report()["phase"] == "baking"
+        # blast radius is exactly one replica during the bake
+        assert sorted(r.engine.weight_version
+                      for r in router.replicas) == [0, 1]
+        col.tick(now=4.0)
+        s4 = ctrl.tick(now=4.0)             # mid-bake: no decision yet
+        assert s4["actions"] == []
+        fr = router.submit(np.array([5, 6], np.int32), 2)
+        assert fr.wait(timeout=120)         # fleet serves through the bake
+        col.tick(now=5.1)
+        s5 = ctrl.tick(now=5.1)             # bake_s elapsed -> promote
+        assert _actions(s5) == ["canary_promote"]
+        assert all(_params_equal(r.engine.params, v1)
+                   for r in router.replicas)
+        assert (ctrl.log.current.version, ctrl.log.current.source) \
+            == (1, "publish")
+
+        # ---- regressing canary: auto-rollback ------------------------- #
+        v2 = _bump(v1)
+        ctrl.deploy(v2, step=2)
+        col.tick(now=6.0)
+        s6 = ctrl.tick(now=6.0)
+        assert _actions(s6) == ["canary_start"]
+        rid = s6["actions"][0]["replica"]
+        # inject a post-swap health regression on the canary ONLY
+        mon.add_detectors(str(rid), ThresholdDetector(
+            f"chaos@{rid}", "chaos_signal", threshold=0.5,
+            severity="degraded"))
+        col.store.append("chaos_signal", 6.5, 1.0)
+        col.tick(now=6.5)
+        assert mon.level(str(rid)) == 1
+        s7 = ctrl.tick(now=6.5)
+        assert _actions(s7) == ["canary_rollback"]
+        a = s7["actions"][0]
+        assert a["reason"] == "regression"
+        assert a["signals"] == [f"health@{rid}"]
+        assert a["rolled_back_to"] == 1     # the last PROMOTED version
+        assert (ctrl.log.current.version, ctrl.log.current.source) \
+            == (1, "rollback")
+        # every replica is back on the rollback target's weights ...
+        assert all(_params_equal(r.engine.params, v1)
+                   for r in router.replicas)
+        # ... with zero dropped requests and zero recompiles anywhere
+        probe = router.submit(np.array([3, 1, 4], np.int32), 2)
+        assert probe.wait(timeout=120) and probe.state.name == "DONE"
+        for r in router.replicas:
+            assert r.engine.recompiles == {}, r.engine.recompiles
+        rep = ctrl.report()
+        assert rep["phase"] == "idle"
+        assert rep["canary"]["deploys"] == 2
+        assert rep["canary"]["promotes"] == 1
+        assert rep["canary"]["rollbacks"] == 1
+        assert [e["source"] for e in rep["versions"]["history"]] \
+            == ["init", "canary", "publish", "canary", "rollback"]
+
+
+# --------------------------------------------------------------------- #
+# autoscaler: scale-down + bounds                                       #
+# --------------------------------------------------------------------- #
+
+def test_scale_down_retires_idle_replica_and_respects_min(lm_and_params):
+    lm, params = lm_and_params
+    engines = [make_engine(lm, params) for _ in range(2)]
+    with FleetRouter(engines) as router:
+        assert router.wait_ready(300)
+        col = fleet_health(router, stall_timeout_s=60.0)
+        mon = col.health
+        ctrl = FleetController(
+            router, col,
+            engine_factory=lambda: make_engine(lm, params),
+            autoscale=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                      queue_high=NEVER, idle_low=0.25,
+                                      up_after_s=1.0, down_after_s=2.0,
+                                      cooldown_s=0.0),
+            sensor_kw=dict(stall_timeout_s=60.0))
+        fr = router.submit(np.array([1, 2, 3], np.int32), 2)
+        assert fr.wait(timeout=120)
+        col.tick(now=1.0)
+        s1 = ctrl.tick(now=1.0)            # idle observed, window starts
+        assert s1["actions"] == []
+        col.tick(now=3.5)
+        s2 = ctrl.tick(now=3.5)            # sustained past down_after_s
+        assert _actions(s2) == ["scale_down"]
+        assert s2["actions"][0]["signals"] == ["idle"]
+        assert not s2["actions"][0]["forced"]        # graceful drain
+        victim = s2["actions"][0]["replica"]
+        assert router.capacity == 1
+        assert router.replicas[victim].state is ReplicaState.RETIRED
+        assert mon.keys == [str(1 - victim)]         # unwatched on retire
+        # min_replicas floor: further idleness never drops below 1
+        for now in (6.0, 9.0, 12.0):
+            col.tick(now=now)
+            assert ctrl.tick(now=now)["actions"] == []
+        assert router.capacity == 1
+        # the survivor still serves
+        fr = router.submit(np.array([4, 5], np.int32), 2)
+        assert fr.wait(timeout=120)
+        assert fr.replica_id == 1 - victim
+
+
+def test_retire_replica_reroutes_queued_work(lm_and_params):
+    """The graceful-retirement actuator on its own: queued (unstarted)
+    work on the retiring replica is re-routed, not dropped."""
+    lm, params = lm_and_params
+    engines = [make_engine(lm, params) for _ in range(2)]
+    with FleetRouter(engines, autostart=False) as router:
+        frs = [router.submit(np.array([1 + i], np.int32), 2)
+               for i in range(4)]
+        assert {fr.replica_id for fr in frs} == {0, 1}
+        out = router.retire_replica(0, timeout=5.0)
+        assert out["state"] == "retired" and out["drained"] >= 1
+        assert not out["forced"]
+        # every request that was queued on 0 is now bound to 1
+        assert all(fr.replica_id == 1 for fr in frs)
+        router.start()                     # retired replica stays down
+        assert router.wait_ready(300)
+        for fr in frs:
+            assert fr.wait(timeout=120)
+            assert fr.state.name == "DONE" and fr.replica_id == 1
+        assert router.capacity == 1
+        assert router.replicas[0].state is ReplicaState.RETIRED
+        with pytest.raises(RuntimeError, match="cannot retire"):
+            router.retire_replica(0)
+
+
+def test_retire_during_warmup_never_resurrects(lm_and_params):
+    """A replica retired while its warmup is still compiling must stay
+    RETIRED when the warmup lands — the autoscaler scales down faster
+    than a cold engine warms, and the old unconditional
+    STARTING->HEALTHY transition resurrected the zombie (accepting, but
+    with a dead drive thread), which a later promote then published
+    onto and failed."""
+    lm, params = lm_and_params
+    with FleetRouter([make_engine(lm, params)]) as router:
+        assert router.wait_ready(300)
+        eng = make_engine(lm, params)
+        gate = threading.Event()
+        eng.warmup = gate.wait             # warmup blocked on the gate
+        spawned = router.spawn_replica(engine=eng, wait_ready=False)
+        rid = spawned.replica_id
+        assert spawned.state is ReplicaState.STARTING and spawned.accepting
+        # release the gate while retire_replica is joining the warmup
+        # thread — the warmup completion races the DRAINING->RETIRED
+        threading.Timer(0.2, gate.set).start()
+        out = router.retire_replica(rid, timeout=5.0)
+        assert out["state"] == "retired" and not out["forced"]
+        spawned._thread.join(30)
+        assert not spawned._thread.is_alive()
+        assert spawned.state is ReplicaState.RETIRED
+        assert not spawned.accepting
+        assert router.capacity == 1        # no zombie in the head-count
+
+
+# --------------------------------------------------------------------- #
+# rebalancing: degraded replicas shed admission weight                  #
+# --------------------------------------------------------------------- #
+
+def test_rebalance_sheds_degraded_weight_edge_triggered(lm_and_params):
+    lm, params = lm_and_params
+    engines = [make_engine(lm, params) for _ in range(2)]
+    with FleetRouter(engines) as router:
+        assert router.wait_ready(300)
+        col = fleet_health(router, stall_timeout_s=60.0)
+        mon = col.health
+        ctrl = FleetController(router, col,
+                               rebalance=RebalancePolicy(
+                                   degraded_weight=0.25))
+        mon.add_detectors("0", ThresholdDetector(
+            "chaos@0", "chaos_signal", threshold=0.5,
+            severity="degraded"))
+        col.store.append("chaos_signal", 1.0, 1.0)
+        col.tick(now=1.0)
+        assert mon.level("0") == 1
+        s1 = ctrl.tick(now=1.0)
+        assert _actions(s1) == ["rebalance"]
+        assert s1["actions"][0] == {"action": "rebalance", "replica": 0,
+                                    "weight": 0.25, "level": 1}
+        assert router.admission_weight(0) == 0.25
+        assert router.admission_weight(1) == 1.0
+        # edge-triggered: steady state emits nothing new
+        assert ctrl.tick(now=1.5)["actions"] == []
+        # the shed weight shows up in both report surfaces
+        assert ctrl.report()["rebalance"]["weights"] == {"0": 0.25,
+                                                         "1": 1.0}
+        frep = router.fleet_report()
+        assert frep["replicas"]["0"]["admission_weight"] == 0.25
+        assert frep["control"]["rebalance"]["weights"]["0"] == 0.25
+        # recovery restores the weight, again exactly once
+        col.store.append("chaos_signal", 2.0, 0.0)
+        col.tick(now=2.0)
+        assert mon.level("0") == 0
+        s2 = ctrl.tick(now=2.0)
+        assert _actions(s2) == ["rebalance"]
+        assert s2["actions"][0]["weight"] == 1.0
+        assert router.admission_weight(0) == 1.0
+        assert ctrl.tick(now=2.5)["actions"] == []
+
+
+# --------------------------------------------------------------------- #
+# guards + observability surface                                        #
+# --------------------------------------------------------------------- #
+
+def test_controller_guards(lm_and_params):
+    lm, params = lm_and_params
+    with FleetRouter([make_engine(lm, params)],
+                     autostart=False) as router:
+        col = fleet_health(router, stall_timeout_s=60.0)
+        with pytest.raises(ValueError, match="engine_factory"):
+            FleetController(router, col, autoscale=AutoscalePolicy())
+        with pytest.raises(ValueError, match="cadence_s"):
+            FleetController(router, col, cadence_s=0.0)
+        ctrl = FleetController(router, col, canary=CanaryPolicy())
+        no_canary = FleetController(router, col)
+        with pytest.raises(RuntimeError, match="canary policy"):
+            no_canary.deploy(params)
+        ctrl.deploy(params)
+        with pytest.raises(RuntimeError, match="already in flight"):
+            ctrl.deploy(params)
+
+
+def test_control_http_endpoint_serves_report(lm_and_params):
+    lm, params = lm_and_params
+    from chainermn_tpu.monitor import http as monitor_http
+
+    with FleetRouter([make_engine(lm, params)],
+                     autostart=False) as router:
+        col = fleet_health(router, stall_timeout_s=60.0)
+        ctrl = FleetController(router, col, canary=CanaryPolicy(),
+                               rebalance=RebalancePolicy())
+        ctrl.tick(now=1.0)
+        with monitor_http.serve(port=0, fleet=router,
+                                controller=ctrl) as srv:
+            body = urllib.request.urlopen(
+                f"{srv.url}/control", timeout=10).read()
+            payload = json.loads(body)
+            assert payload["phase"] == "idle"
+            assert payload["ticks"] >= 1
+            assert payload["canary"]["policy"]["bake_s"] == 5.0
+            assert payload["versions"]["current"]["source"] == "init"
+            # fleet report embeds the same surface
+            fleet = json.loads(urllib.request.urlopen(
+                f"{srv.url}/fleet", timeout=10).read())
+            assert fleet["control"]["phase"] == "idle"

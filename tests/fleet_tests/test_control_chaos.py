@@ -1,0 +1,217 @@
+"""Control-plane chaos (ISSUE 16 satellite): the canary path under the
+failures it exists for — a canary killed mid-bake aborts cleanly (no
+promote, peers untouched), a ``deploy.publish`` commit fault during the
+promote roll auto-rollbacks a PARTIALLY-rolled fleet back onto one
+version, and a 3-seed soak randomizes good/bad deploys over injected
+clocks. No sleeps anywhere: collector + controller ticks carry explicit
+``now`` values, and the fault injector is seeded."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.fleet import (
+    CanaryPolicy,
+    FleetController,
+    FleetRouter,
+    ReplicaState,
+)
+from chainermn_tpu.models import TransformerLM
+from chainermn_tpu.monitor.health import fleet_health
+from chainermn_tpu.monitor.timeseries import ThresholdDetector
+from chainermn_tpu.resilience import FaultInjector
+from chainermn_tpu.resilience.cutpoints import DEPLOY_PUBLISH
+from chainermn_tpu.serving import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=1,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make_fleet(lm, params, n=2, **kw):
+    return FleetRouter(
+        [ServingEngine(lm, params, n_slots=2, prefill_len=6, cache_len=32)
+         for _ in range(n)], **kw)
+
+
+def _bump(params, delta=0.01):
+    return jax.tree_util.tree_map(
+        lambda a: a + jnp.asarray(delta, a.dtype), params)
+
+
+def _params_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.allclose(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _wait(pred, timeout=60.0, what="condition"):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _serve_one(router, prompt, n=2):
+    fr = router.submit(np.array(prompt, np.int32), n)
+    assert fr.wait(timeout=120) and fr.state.name == "DONE"
+    return fr
+
+
+def _actions(summary):
+    return [a["action"] for a in summary["actions"]]
+
+
+def test_canary_killed_mid_bake_aborts_cleanly(lm_and_params):
+    """A canary that dies during its bake window must NOT be promoted:
+    the controller aborts, peers never see the new weights, and the
+    version log records the reversal — with nothing to republish (the
+    new version died with the canary)."""
+    lm, params = lm_and_params
+    with make_fleet(lm, params) as router:
+        assert router.wait_ready(300)
+        col = fleet_health(router, stall_timeout_s=60.0)
+        ctrl = FleetController(router, col,
+                               canary=CanaryPolicy(bake_s=5.0))
+        v1 = _bump(params)
+        ctrl.deploy(v1, step=1)
+        col.tick(now=1.0)
+        s1 = ctrl.tick(now=1.0)
+        assert _actions(s1) == ["canary_start"]
+        rid = s1["actions"][0]["replica"]
+        survivor = router.replicas[1 - rid]
+
+        router.replicas[rid].kill()          # ReplicaKilled: fatal
+        _wait(lambda: router.replicas[rid].state
+              is ReplicaState.QUARANTINED,
+              what=f"quarantine of canary {rid}")
+        col.tick(now=2.0)
+        s2 = ctrl.tick(now=2.0)
+        assert _actions(s2) == ["canary_rollback"]
+        a = s2["actions"][0]
+        assert a["reason"] == "canary_lost"
+        assert a["signals"] == [f"replica_state@{rid}"]
+        assert a["rolled_back_to"] == 0
+        assert (ctrl.log.current.version, ctrl.log.current.source) \
+            == (0, "rollback")
+        # the peer never left the old version — clean abort, no promote
+        assert survivor.engine.weight_version == 0
+        assert _params_equal(survivor.engine.params, params)
+        assert survivor.engine.recompiles == {}
+        # further bakes don't resume: the deploy is fully retired
+        assert ctrl.report()["phase"] == "idle"
+        assert ctrl.report()["canary"]["rollbacks"] == 1
+        s3 = ctrl.tick(now=7.0)              # past the original bake_s
+        assert s3["actions"] == []
+        fr = _serve_one(router, [1, 2, 3])   # fleet still serves
+        assert fr.replica_id == 1 - rid
+
+
+def test_promote_commit_fault_rolls_every_replica_back(lm_and_params):
+    """A ``deploy.publish`` commit fault in the middle of the promote
+    roll leaves the fleet PARTIALLY rolled (canary + later peers on new
+    weights, the faulted peer on old). Auto-rollback must converge every
+    replica back onto the pre-canary version — zero dropped requests,
+    zero recompiles."""
+    lm, params = lm_and_params
+    with make_fleet(lm, params, n=3) as router:
+        assert router.wait_ready(300)
+        col = fleet_health(router, stall_timeout_s=60.0)
+        ctrl = FleetController(router, col,
+                               canary=CanaryPolicy(bake_s=2.0))
+        v1 = _bump(params)
+        inj = FaultInjector(seed=0)
+        # hit 1 is the canary's own commit (let it pass); hit 2 is the
+        # FIRST peer commit of the promote roll — that one fires
+        inj.arm(DEPLOY_PUBLISH, kind="raise", after=1, times=1)
+        with inj:
+            ctrl.deploy(v1, step=1)
+            col.tick(now=1.0)
+            s1 = ctrl.tick(now=1.0)
+            assert _actions(s1) == ["canary_start"]
+            col.tick(now=3.5)
+            s2 = ctrl.tick(now=3.5)          # bake over -> promote -> boom
+        assert [p for p, _ in inj.fired_log] == [DEPLOY_PUBLISH]
+        assert _actions(s2) == ["canary_rollback"]
+        a = s2["actions"][0]
+        assert a["reason"] == "promote_failed"
+        assert a["signals"] == ["publish_error"]
+        assert a["rolled_back_to"] == 0
+        assert (ctrl.log.current.version, ctrl.log.current.source) \
+            == (0, "rollback")
+        # EVERY replica converged back onto the old weights — including
+        # the peer the roll had already swapped past the fault
+        for r in router.replicas:
+            assert r.accepting
+            assert _params_equal(r.engine.params, params)
+            assert r.engine.recompiles == {}, r.engine.recompiles
+        assert ctrl.report()["canary"]["promotes"] == 0
+        _serve_one(router, [4, 5])           # nothing dropped, still live
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_control_chaos_soak(lm_and_params, seed):
+    """Randomized good/bad deploy rounds: bad canaries regress (injected
+    degraded score) and must roll back, good ones must promote — after
+    every round the whole fleet sits on ONE version whose content the
+    test tracks, with zero recompiles and all traffic served."""
+    rng = np.random.default_rng(seed)
+    lm, params = lm_and_params
+    with make_fleet(lm, params) as router:
+        assert router.wait_ready(300)
+        col = fleet_health(router, stall_timeout_s=60.0)
+        mon = col.health
+        ctrl = FleetController(router, col,
+                               canary=CanaryPolicy(bake_s=2.0))
+        expected = params
+        now = 1.0
+        for round_n in range(3):
+            for _ in range(int(rng.integers(1, 4))):
+                _serve_one(router, list(rng.integers(1, 16, size=2)),
+                           n=int(rng.integers(2, 5)))
+            candidate = _bump(expected, delta=0.01 * (round_n + 1))
+            bad = bool(rng.integers(0, 2))
+            ctrl.deploy(candidate, step=round_n)
+            col.tick(now=now)
+            s = ctrl.tick(now=now)
+            assert _actions(s) == ["canary_start"]
+            rid = s["actions"][0]["replica"]
+            if bad:
+                series = f"chaos_{seed}_{round_n}"
+                mon.add_detectors(str(rid), ThresholdDetector(
+                    f"{series}@{rid}", series, threshold=0.5,
+                    severity="degraded"))
+                col.store.append(series, now + 0.5, 1.0)
+                col.tick(now=now + 0.5)
+                s = ctrl.tick(now=now + 0.5)
+                assert _actions(s) == ["canary_rollback"]
+                assert s["actions"][0]["reason"] == "regression"
+                # clear the injected signal so later rounds start clean
+                col.store.append(series, now + 0.6, 0.0)
+                col.tick(now=now + 0.6)
+                assert mon.level(str(rid)) == 0
+            else:
+                col.tick(now=now + 2.5)
+                s = ctrl.tick(now=now + 2.5)
+                assert _actions(s) == ["canary_promote"]
+                expected = candidate
+            # invariant: one version fleet-wide, nothing recompiled
+            for r in router.replicas:
+                assert _params_equal(r.engine.params, expected)
+                assert r.engine.recompiles == {}, r.engine.recompiles
+            now += 4.0
+        fr = _serve_one(router, [3, 1, 4])
+        assert fr.state.name == "DONE"

@@ -78,6 +78,11 @@ def test_bench_serving_mode_smoke():
         CHAINERMN_TPU_SERVE_BUCKETS="16,128",
         CHAINERMN_TPU_SERVE_SHARED_PREFIX="112",
         CHAINERMN_TPU_SERVE_PREFIX_BLOCK="16",
+        # keep the autoscale section inside the tier-1 budget: a shorter
+        # diurnal window and a 2-replica ceiling still exercise scale-up,
+        # peak>min, and drain-back-to-min (asserted below)
+        CHAINERMN_TPU_SERVE_AS_WINDOW="3.0",
+        CHAINERMN_TPU_SERVE_AS_MAX="2",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
     )
     proc = subprocess.run(
@@ -221,6 +226,21 @@ def test_bench_serving_mode_smoke():
     assert pub["outcomes"]["1"]["version"] == 1
     assert pub["weight_versions"]["1"] == 1
     assert pub["recompiles_after_publish_survivors"] == 0
+    # ---- the ISSUE-16 closed-loop autoscaler (acceptance criterion) -- #
+    fa = rec["fleet_autoscale"]
+    # diurnal sinusoidal arrivals: the fleet scaled up under the peak
+    # and retired back to the floor in the trough, losing nothing
+    assert fa["all_terminal"] is True
+    assert fa["no_request_lost"] is True
+    assert fa["done"] == fa["requests"] > 0
+    assert fa["scale_ups"] >= 1
+    assert fa["peak_capacity"] > fa["min_replicas"]
+    assert fa["final_capacity"] == fa["min_replicas"]
+    assert fa["replica_count_tracks_load"] is True
+    assert fa["recompiles_after_warmup"] == 0
+    # every decision in the ring names its triggering signals
+    assert all(d.get("signals") for d in fa["decisions"]
+               if d["action"] in ("scale_up", "scale_down"))
 
 
 def _run_monitor_mode(extra_env):
