@@ -406,6 +406,22 @@ class FleetController:
         else:
             self._pressure_since = self._idle_since = None
 
+    def _top_tenant(self) -> Optional[str]:
+        """Name the tenant consuming the most device time fleet-wide
+        (the cost ledgers' view) — the 'who is driving this pressure'
+        annotation on scale/rebalance decisions. None when no replica
+        carries a ledger or nothing has been attributed yet."""
+        totals: dict = {}
+        for r in self.router.replicas:
+            led = getattr(r.metrics, "costs", None)
+            if led is None:
+                continue
+            for tenant, secs in led.tenant_device_seconds().items():
+                totals[tenant] = totals.get(tenant, 0.0) + secs
+        if not totals:
+            return None
+        return max(totals.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
     def _scale_up(self, now: float, s: dict, summary: dict) -> None:
         replica = self.router.spawn_replica(
             engine=self._engine_factory(), wait_ready=False)
@@ -420,18 +436,22 @@ class FleetController:
             wire_replica(self.collector, hm, replica, **self._sensor_kw)
         self._pending_sync.append(replica)
         self._c_ups.inc()
+        # name the heaviest tenant in the decision record: "we scaled
+        # up, and THIS workload is why" — the noisy-neighbor join key
+        tt = self._top_tenant()
+        tenant_kw = {} if tt is None else {"top_tenant": tt}
         action = {"action": "scale_up", "t": now,
                   "replica": replica.replica_id,
                   "signals": list(s["pressure"]),
                   "queue_per_replica": round(s["queue_per_replica"], 3),
-                  "capacity": s["accepting"]}
+                  "capacity": s["accepting"], **tenant_kw}
         summary["actions"].append(action)
         self._events.emit("controller_scale_up",
                           replica=replica.replica_id,
                           signals=list(s["pressure"]),
                           queue_per_replica=round(
                               s["queue_per_replica"], 3),
-                          capacity=s["accepting"])
+                          capacity=s["accepting"], **tenant_kw)
 
     def _scale_down(self, now: float, s: dict, summary: dict) -> None:
         candidates = [r for r in self.router.replicas if r.accepting]
@@ -643,11 +663,13 @@ class FleetController:
             self._registry.gauge(
                 "fleet_admission_weight",
                 dict(self._labels, replica=str(rid))).set(want)
+            tt = self._top_tenant()
+            tenant_kw = {} if tt is None else {"top_tenant": tt}
             action = {"action": "rebalance", "replica": rid,
-                      "weight": want, "level": level}
+                      "weight": want, "level": level, **tenant_kw}
             summary["actions"].append(action)
             self._events.emit("controller_rebalance", replica=rid,
-                              weight=want, level=level)
+                              weight=want, level=level, **tenant_kw)
 
     # ------------------------------------------------------------------ #
     # observability                                                       #

@@ -191,7 +191,7 @@ class EngineReplica:
             self._thread.start()
 
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
-               stream_cb=None, deadline_s=None):
+               stream_cb=None, deadline_s=None, tenant: str = "default"):
         """Enqueue onto this replica's scheduler (thread-safe) and wake
         the drive loop. The router owns the routing decision; this is
         mechanism only."""
@@ -202,7 +202,8 @@ class EngineReplica:
                 "not accepting work")
         req = self.scheduler.submit(prompt, max_new_tokens, rng=rng,
                                     stream_cb=stream_cb,
-                                    deadline_s=deadline_s)
+                                    deadline_s=deadline_s,
+                                    tenant=tenant)
         self._work.set()
         return req
 

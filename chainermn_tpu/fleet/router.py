@@ -77,6 +77,7 @@ from chainermn_tpu.fleet.routing import (
     RoutingPolicy,
 )
 from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.monitor.costs import merge_cost_payloads
 from chainermn_tpu.monitor.registry import merge_rank_payloads
 
 
@@ -100,7 +101,8 @@ class FleetRequest:
     fleet-level event, never on a dead replica's scheduler."""
 
     def __init__(self, router: "FleetRouter", fid: int, prompt,
-                 max_new_tokens: int, rng, stream_cb, deadline_s) -> None:
+                 max_new_tokens: int, rng, stream_cb, deadline_s,
+                 tenant: str = "default") -> None:
         self._router = router
         self.id = fid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -108,6 +110,9 @@ class FleetRequest:
         self.rng = rng
         self.stream_cb = stream_cb
         self.deadline_s = deadline_s
+        # cost-attribution label: survives re-routes with the handle, so
+        # a replayed binding bills the same tenant on the new replica
+        self.tenant = str(tenant)
         self.t_submit = time.perf_counter()
         self.t_deadline = (self.t_submit + float(deadline_s)
                            if deadline_s is not None else None)
@@ -378,7 +383,8 @@ class FleetRouter:
 
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
                stream_cb: Optional[Callable[[int], None]] = None,
-               deadline_s: Optional[float] = None) -> FleetRequest:
+               deadline_s: Optional[float] = None,
+               tenant: str = "default") -> FleetRequest:
         """Route and enqueue one request; returns immediately. Raises
         ``QueueFullError`` when the fleet-wide queue bound is hit
         (counted as a fleet shed) and ``RuntimeError`` when no replica
@@ -405,7 +411,7 @@ class FleetRouter:
                 )
             fid = next(self._ids)
             fr = FleetRequest(self, fid, prompt, max_new_tokens, rng,
-                              stream_cb, deadline_s)
+                              stream_cb, deadline_s, tenant=tenant)
             t0 = time.perf_counter()
             decision = self._route_locked(fr.prompt, snaps)
             self._bind_locked(fr, decision, t0)
@@ -415,11 +421,12 @@ class FleetRouter:
 
     def generate(self, prompt, max_new_tokens: int, *, rng=None,
                  timeout: Optional[float] = None,
-                 deadline_s: Optional[float] = None) -> np.ndarray:
+                 deadline_s: Optional[float] = None,
+                 tenant: str = "default") -> np.ndarray:
         """Blocking single-request decode through the fleet — the
         ``ServingClient.generate`` shape."""
         fr = self.submit(prompt, max_new_tokens, rng=rng,
-                         deadline_s=deadline_s)
+                         deadline_s=deadline_s, tenant=tenant)
         if not fr.wait(timeout):
             self.cancel(fr)
             raise TimeoutError(
@@ -519,7 +526,8 @@ class FleetRouter:
         if fr.t_deadline is not None:
             remaining = fr.t_deadline - time.perf_counter()
         inner = replica.submit(fr.prompt, fr.max_new_tokens, rng=fr.rng,
-                               stream_cb=relay, deadline_s=remaining)
+                               stream_cb=relay, deadline_s=remaining,
+                               tenant=fr.tenant)
         t1 = time.perf_counter()
         inner.trace.add_span("route", t0, t1, replica=decision.replica_id,
                              affinity="hit" if decision.affinity_hit
@@ -881,6 +889,13 @@ class FleetRouter:
             }
         pooled = merge_rank_payloads(
             [r.metrics.payload() for r in self.replicas])
+        # per-tenant cost view pooled across replicas: a tenant's bill
+        # is fleet-wide, not per-replica (conservation still holds —
+        # the merge sums measured and attributed alike)
+        cost_payloads = [r.metrics.costs.payload() for r in self.replicas
+                         if getattr(r.metrics, "costs", None) is not None]
+        costs = (merge_cost_payloads(cost_payloads)
+                 if cost_payloads else None)
         hits = int(self._c_aff_hits.value)
         misses = int(self._c_aff_miss.value)
         with self._lock:
@@ -894,6 +909,7 @@ class FleetRouter:
         return {
             "health": health,
             "control": control,
+            "costs": costs,
             "replicas": replicas,
             "capacity": self.capacity,
             "n_replicas": len(self.replicas),

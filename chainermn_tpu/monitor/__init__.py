@@ -71,6 +71,12 @@ from __future__ import annotations
 
 from chainermn_tpu.monitor._state import get_event_log, get_registry
 from chainermn_tpu.monitor.annotations import annotate
+from chainermn_tpu.monitor.costs import (
+    CostLedger,
+    NoisyNeighborDetector,
+    merge_cost_payloads,
+    standard_tenant_sensors,
+)
 from chainermn_tpu.monitor.events import EventLog, device_memory_lines
 from chainermn_tpu.monitor.health import (
     HealthMonitor,
@@ -140,6 +146,7 @@ def aggregate(comm) -> dict:
 
 __all__ = [
     "Collector",
+    "CostLedger",
     "Counter",
     "DeadmanDetector",
     "Detector",
@@ -153,6 +160,7 @@ __all__ = [
     "LatencyObjective",
     "MetricsRegistry",
     "MonitoredFunction",
+    "NoisyNeighborDetector",
     "Rate",
     "Ratio",
     "RecompileGuard",
@@ -176,8 +184,10 @@ __all__ = [
     "get_tracer",
     "http",
     "instrument",
+    "merge_cost_payloads",
     "merge_rank_payloads",
     "record_memory_gauges",
     "snapshot",
     "standard_replica_sensors",
+    "standard_tenant_sensors",
 ]

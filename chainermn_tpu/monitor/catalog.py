@@ -99,6 +99,11 @@ METRIC_NAMES = frozenset({
     "controller_target_replicas",
     "controller_ticks_total",
     "fleet_admission_weight",
+    # cost accounting (per-tenant resource ledger + goodput breakdown)
+    "cost_conservation_error",
+    "goodput_fraction",
+    "tenant_device_seconds_total",
+    "tenant_kv_block_seconds_total",
     # SLO
     "slo_breaches_total",
     "slo_burn_rate",
@@ -175,6 +180,9 @@ EVENT_KINDS = frozenset({
     "publish_failed",
     "swap_exec",
     "weight_swap",
+    # cost accounting (ledger folds + noisy-neighbor edges)
+    "cost_flush",
+    "noisy_neighbor",
     # SLO
     "slo_breach",
     # continuous telemetry (detector edges + health transitions)

@@ -28,6 +28,10 @@ browser, ``curl``, a future fleet router polling replica burn rates:
   control.FleetController.report` (autoscaler state, canary phase,
   version history, decision ring) when a controller was passed to
   :func:`serve`;
+- ``/costs``   — the cost ledger's :meth:`~chainermn_tpu.monitor.costs.
+  CostLedger.report` (per-tenant device/block/queue seconds, goodput
+  breakdown, conservation check) when a ledger was passed to
+  :func:`serve`;
 - ``/``        — a plain-text index of the above.
 
 Serving is read-only and allocation-light: every handler renders from
@@ -58,13 +62,14 @@ class MonitorServer:
 
     def __init__(self, host: str, port: int, *, registry, events, tracer,
                  slo, fleet=None, timeseries=None, health=None,
-                 controller=None) -> None:
+                 controller=None, costs=None) -> None:
         self._registry = registry
         self._events = events
         self._tracer = tracer
         self._slo = slo
         self._fleet = fleet
         self._controller = controller
+        self._costs = costs
         # a Collector is accepted where a TimeSeriesStore is expected —
         # the scrape serves the collector's store either way
         self._timeseries = getattr(timeseries, "store", timeseries)
@@ -146,6 +151,11 @@ class MonitorServer:
                        if self._controller is not None else {})
             return (200, "application/json",
                     json.dumps(payload, default=str).encode())
+        if route == "/costs":
+            payload = (self._costs.report()
+                       if self._costs is not None else {})
+            return (200, "application/json",
+                    json.dumps(payload, default=str).encode())
         if route == "/":
             index = ("chainermn_tpu monitor\n"
                      "  /metrics     Prometheus text exposition\n"
@@ -158,7 +168,9 @@ class MonitorServer:
                      "(?last=N&prefix=)\n"
                      "  /health      per-replica health scores\n"
                      "  /control     fleet control-plane report "
-                     "(autoscaler, canary, rebalance)\n")
+                     "(autoscaler, canary, rebalance)\n"
+                     "  /costs       per-tenant cost ledger "
+                     "(device seconds, goodput, conservation)\n")
             return 200, "text/plain; charset=utf-8", index.encode()
         return 404, "text/plain; charset=utf-8", b"not found\n"
 
@@ -182,7 +194,8 @@ class MonitorServer:
 
 def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
           events=None, tracer=None, slo=None, fleet=None,
-          timeseries=None, health=None, controller=None) -> MonitorServer:
+          timeseries=None, health=None, controller=None,
+          costs=None) -> MonitorServer:
     """Stand up the scrape endpoint on a background thread and return the
     running :class:`MonitorServer` (``.port`` carries the bound port when
     ``port=0``). Defaults wire the process-wide registry, flight
@@ -196,8 +209,9 @@ def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
     :class:`~chainermn_tpu.monitor.health.HealthMonitor`) lights up
     ``/health`` — continuous telemetry is explicitly owned too, as is
     ``controller=`` (a :class:`~chainermn_tpu.fleet.control.
-    FleetController`) for ``/control``. Close with
-    :meth:`MonitorServer.close` (also a context manager)."""
+    FleetController`) for ``/control`` and ``costs=`` (a
+    :class:`~chainermn_tpu.monitor.costs.CostLedger`) for ``/costs``.
+    Close with :meth:`MonitorServer.close` (also a context manager)."""
     if registry is None:
         registry = get_registry()
     if events is None:
@@ -213,7 +227,7 @@ def serve(port: int = 0, host: str = "127.0.0.1", *, registry=None,
     return MonitorServer(host, port, registry=registry, events=events,
                          tracer=tracer, slo=slo, fleet=fleet,
                          timeseries=timeseries, health=health,
-                         controller=controller)
+                         controller=controller, costs=costs)
 
 
 __all__ = ["MonitorServer", "serve"]

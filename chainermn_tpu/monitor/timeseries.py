@@ -473,7 +473,10 @@ class Collector:
         for inst in insts:
             key = inst.key
             if isinstance(inst, Counter):
-                v = int(inst.value)
+                # float, not int: fractional counters (the cost ledger's
+                # device-seconds) must not lose their sub-unit deltas —
+                # integer counters sample identically either way
+                v = float(inst.value)
                 prev = self._prev_counters.get(key)
                 self._prev_counters[key] = (now, v)
                 self.store.append(key, now, v, kind="counter")

@@ -65,10 +65,13 @@ class ServingClient:
 
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
                stream_cb: Optional[Callable[[int], None]] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               tenant: str = "default") -> Request:
         """Enqueue a request; returns immediately. ``stream_cb`` (if set)
         is invoked from the engine thread once per generated token.
-        Raises ``QueueFullError`` in the calling thread when the bounded
+        ``tenant`` labels the request for the cost ledger's per-tenant
+        attribution; it never affects scheduling. Raises
+        ``QueueFullError`` in the calling thread when the bounded
         admission queue (``max_queue``) is at capacity — backpressure is
         the submitter's signal, not a queued request's problem."""
         if self._failure is not None:
@@ -77,20 +80,22 @@ class ServingClient:
             raise RuntimeError("client is closed")
         req = self.scheduler.submit(prompt, max_new_tokens, rng=rng,
                                     stream_cb=stream_cb,
-                                    deadline_s=deadline_s)
+                                    deadline_s=deadline_s,
+                                    tenant=tenant)
         self._work.set()
         return req
 
     def generate(self, prompt, max_new_tokens: int, *, rng=None,
                  timeout: Optional[float] = None,
-                 deadline_s: Optional[float] = None) -> np.ndarray:
+                 deadline_s: Optional[float] = None,
+                 tenant: str = "default") -> np.ndarray:
         """Blocking single-request decode: ``prompt + generated`` tokens,
         the :func:`chainermn_tpu.models.generate`-shaped result. A shed
         or engine-failed (ERRORED) request re-raises its stored exception
         here, in the caller's thread — degradation is loud, never a
         silent hang."""
         req = self.submit(prompt, max_new_tokens, rng=rng,
-                          deadline_s=deadline_s)
+                          deadline_s=deadline_s, tenant=tenant)
         if not req.wait(timeout):
             self.cancel(req)
             raise TimeoutError(

@@ -506,6 +506,11 @@ class ServingEngine:
         self._spec_proposed_total = 0
         self._spec_accepted_total = 0
         self._last_spec_window: Optional[tuple] = None
+        # cost-attribution mirror of the window: {slot: (kd, a)} for the
+        # last verify round (drafts that fit, drafts accepted) — NOT
+        # popped with the window, the scheduler's ledger reads it right
+        # after decode_round returns
+        self._last_spec_slots: dict = {}
         if self._spec is not None:
             self._drafter = build_drafter(self._spec, self)
             self._guard.watch("serving_spec_verify", self._spec_fn)
@@ -1484,6 +1489,17 @@ class ServingEngine:
         mode) — the per-request block-count series at retirement."""
         return len(self._slot_blocks[slot]) if self.paged else 0
 
+    def slot_block_shares(self, slot: int) -> float:
+        """Refcount-weighted block count the slot holds RIGHT NOW (0.0
+        in dense mode): a private block counts 1, a prefix block shared
+        by ``r`` live holders counts ``1/r`` — so summing this over all
+        holders always reproduces the pool's true occupancy. The cost
+        ledger integrates it into per-tenant KV block-seconds."""
+        if not self.paged:
+            return 0.0
+        return sum(1.0 / max(self._pool.refs(b), 1)
+                   for b in self._slot_blocks[slot])
+
     def kv_pool_stats(self) -> tuple[int, int]:
         """(blocks in use, blocks free) — the scheduler samples these
         into the ``kv_blocks_in_use``/``kv_blocks_free`` gauges."""
@@ -1693,6 +1709,7 @@ class ServingEngine:
         res = {}
         proposed = accepted = 0
         lengths = []
+        spec_slots = {}
         for slot in np.flatnonzero(self._active):
             slot = int(slot)
             kd = min(k, int(valid[slot]) - 1)   # drafts that fit the slot
@@ -1707,10 +1724,12 @@ class ServingEngine:
             proposed += kd
             accepted += a
             lengths.append(a)
+            spec_slots[slot] = (kd, a)
             res[slot] = toks
         self._spec_proposed_total += proposed
         self._spec_accepted_total += accepted
         self._last_spec_window = (proposed, accepted, lengths)
+        self._last_spec_slots = spec_slots
         return res
 
     def _rollback_spec_blocks(self, slot: int) -> None:
@@ -1753,6 +1772,14 @@ class ServingEngine:
     @property
     def spec_enabled(self) -> bool:
         return self._spec is not None
+
+    @property
+    def last_spec_slots(self) -> dict:
+        """``{slot: (kd, a)}`` of the last verify round (drafts that fit,
+        drafts accepted) — the per-slot attribution the cost ledger
+        splits accepted-vs-wasted verify work with. Unlike
+        :meth:`pop_spec_window` this is NOT cleared on read."""
+        return self._last_spec_slots
 
     def pop_spec_window(self) -> Optional[tuple]:
         """``(proposed, accepted, accept_lengths)`` of the last verify

@@ -123,6 +123,9 @@ class ServingMetrics:
         # returning this instance's current HealthScore as a JSON dict;
         # report() embeds it so the health verdict rides every record
         self._health_fn = None
+        # cost-accounting hook (attach_costs): the scheduler's per-tenant
+        # CostLedger; report() embeds its rendered breakdown as "costs"
+        self._costs = None
 
     # ------------------------------------------------------------------ #
     # recording (scheduler-driven)                                        #
@@ -246,6 +249,20 @@ class ServingMetrics:
         fleet_health`); :meth:`report` then carries a ``health`` block.
         Detach with ``attach_health(None)``."""
         self._health_fn = fn
+
+    def attach_costs(self, ledger) -> None:
+        """Attach the scheduler's :class:`~chainermn_tpu.monitor.costs.
+        CostLedger`; :meth:`report` then carries a ``costs`` block (per-
+        tenant device/block/queue seconds + goodput + conservation) and
+        the fleet layer pools :meth:`~chainermn_tpu.monitor.costs.
+        CostLedger.payload` across replicas. Detach with
+        ``attach_costs(None)``."""
+        self._costs = ledger
+
+    @property
+    def costs(self):
+        """The attached cost ledger, or None (accounting disabled)."""
+        return self._costs
 
     @property
     def requests_submitted(self) -> int:
@@ -387,6 +404,11 @@ class ServingMetrics:
                 out["health"] = self._health_fn()
             except Exception as e:  # noqa: BLE001 — reporting never raises
                 out["health"] = {"error": f"{type(e).__name__}: {e}"}
+        if self._costs is not None:
+            try:
+                out["costs"] = self._costs.report()
+            except Exception as e:  # noqa: BLE001 — reporting never raises
+                out["costs"] = {"error": f"{type(e).__name__}: {e}"}
         if sanitizer.enabled():
             # lock-hold / contention accounting (sanitizer runs only):
             # which lock the serving path actually spends its time in

@@ -96,6 +96,7 @@ import numpy as np
 from chainermn_tpu.analysis import sanitizer
 from chainermn_tpu.monitor import annotate
 from chainermn_tpu.monitor._state import get_event_log
+from chainermn_tpu.monitor.costs import CostLedger
 from chainermn_tpu.monitor.trace import NULL_TRACE, get_tracer
 from chainermn_tpu.resilience.retry import RetryPolicy
 from chainermn_tpu.serving.engine import EngineStateError
@@ -134,6 +135,9 @@ class Request:
     max_new_tokens: int
     rng: object = None                 # per-request PRNG key (solo-parity)
     stream_cb: Optional[Callable[[int], None]] = None
+    # cost-attribution label (PR 17): rides the request end to end and
+    # keys the ledger's per-tenant aggregates; never affects scheduling
+    tenant: str = "default"
     id: int = -1
     state: RequestState = RequestState.QUEUED
     slot: int = -1
@@ -143,6 +147,10 @@ class Request:
     t_submit: float = 0.0
     t_deadline: Optional[float] = None
     t_last_token: float = 0.0
+    # when the request last (re-)entered the queue — the cost ledger's
+    # queue-wait clock, reset on preempt/defer (t_submit stays the TTFT
+    # anchor and is never touched)
+    _t_enqueue: float = 0.0
     # engine weight version this request decodes on, stamped at slot
     # commit (None until admitted, or on engines without versioning)
     weight_version: Optional[int] = None
@@ -257,12 +265,21 @@ class FCFSScheduler:
                  restart_on_error: bool = True,
                  max_restarts: int = 8,
                  max_prefills_per_step: Optional[int] = None,
-                 tracer=None) -> None:
+                 tracer=None, cost_accounting: bool = True) -> None:
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.engine = engine
         self.eos_id = eos_id
         self.metrics = metrics or ServingMetrics(engine.n_slots)
+        # per-tenant resource ledger (PR 17): splits every measured
+        # device interval across the requests that shared it. Pure
+        # host-side dict arithmetic — default ON; ``cost_accounting=
+        # False`` strips even that (the bench's overhead baseline).
+        self.costs: Optional[CostLedger] = None
+        if cost_accounting:
+            self.costs = CostLedger(instance=self.metrics.instance)
+            self.metrics.attach_costs(self.costs)
+        self._t_block_sample: Optional[float] = None
         self.max_queue = max_queue
         self.default_deadline_s = default_deadline_s
         self._retry = retry
@@ -301,7 +318,8 @@ class FCFSScheduler:
 
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
                stream_cb: Optional[Callable[[int], None]] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               tenant: str = "default") -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.engine.validate_request(len(prompt), max_new_tokens)
         if deadline_s is None:
@@ -310,8 +328,10 @@ class FCFSScheduler:
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             rng=rng if rng is not None else jax.random.PRNGKey(0),
             stream_cb=stream_cb, deadline_s=deadline_s,
+            tenant=str(tenant),
         )
         req.t_submit = time.perf_counter()
+        req._t_enqueue = req.t_submit
         if deadline_s is not None:
             req.t_deadline = req.t_submit + float(deadline_s)
         with self._lock:
@@ -364,6 +384,8 @@ class FCFSScheduler:
             # path sees the CANCELLED state and releases the slot itself
             req.state = RequestState.CANCELLED
             self.metrics.record_done(cancelled=True)
+        if self.costs is not None:
+            self.costs.finalize(req.id)
         self._events.emit("slot_retire", req=req.id, slot=req.slot,
                           reason="cancelled", **self._trace_label(req))
         req.trace.finish(reason="cancelled")
@@ -401,6 +423,8 @@ class FCFSScheduler:
             drained = list(self._queue)
             self._queue.clear()
         for req in drained:
+            if self.costs is not None:
+                self.costs.finalize(req.id)
             if req._span_queue is not None:
                 req.trace.end_span(req._span_queue)
                 req._span_queue = None
@@ -497,6 +521,9 @@ class FCFSScheduler:
         # 2. decode: every active slot, one compiled call — one token per
         # slot on the legacy path, up to k+1 (speculative) / decode_window
         # tokens per slot on the multi-token rounds
+        # GIL-atomic snapshot for cost attribution (same contract as
+        # _flight_ctx): who occupied which slot when the decode launched
+        rows_snapshot = list(self._by_slot.items())  # graftlint: unguarded-ok
         t_dec0 = time.perf_counter()
         try:
             decoded = self.engine.decode_round(ctx=self._flight_ctx())
@@ -505,6 +532,33 @@ class FCFSScheduler:
                 raise
             decoded = {}
         t_dec1 = time.perf_counter()
+        if self.costs is not None and rows_snapshot and decoded:
+            # split the shared decode call across the n_slots rows the
+            # compiled program actually ran; slots with no request book
+            # as `idle`, rejected speculative drafts as `wasted`
+            spec_info = (self.engine.last_spec_slots
+                         if getattr(self.engine, "spec_enabled", False)
+                         else {})
+            rows = []
+            for slot, req in rows_snapshot:
+                if slot in spec_info:
+                    kd, a = spec_info[slot]
+                    rows.append((req.id, req.tenant, a + 1, kd - a))
+                else:
+                    rows.append((req.id, req.tenant,
+                                 max(len(decoded.get(slot, ())), 1), 0))
+            self.costs.record_decode(t_dec1 - t_dec0,
+                                     n_rows=self.engine.n_slots, rows=rows)
+        if self.costs is not None and getattr(self.engine, "paged", False):
+            # block-seconds: integral of blocks held over wall time,
+            # sampled once per step; shared prefix blocks split by live
+            # refcount so a popular prefix isn't billed N times over
+            if self._t_block_sample is not None and rows_snapshot:
+                self.costs.record_block_seconds(
+                    t_dec1 - self._t_block_sample,
+                    [(req.tenant, self.engine.slot_block_shares(slot))
+                     for slot, req in rows_snapshot])
+            self._t_block_sample = t_dec1
         for slot, toks in decoded.items():
             for tok in toks:
                 # dict.get is GIL-atomic and a concurrent cancel() is
@@ -536,6 +590,8 @@ class FCFSScheduler:
         self.metrics.record_step(self.queue_depth, self.engine.active_slots)
         if getattr(self.engine, "paged", False):
             self.metrics.record_kv_pool(*self.engine.kv_pool_stats())
+        if self.costs is not None:
+            self.costs.flush()
         return emitted
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> int:
@@ -633,6 +689,7 @@ class FCFSScheduler:
             req.trace.end_span(req._span_admit)
             req._span_admit = None
         req._span_queue = req.trace.start_span("queue")
+        req._t_enqueue = time.perf_counter()
         with self._lock:
             req.state = RequestState.QUEUED
             self._queue.appendleft(req)
@@ -647,6 +704,12 @@ class FCFSScheduler:
             req.trace.end_span(req._span_queue)
             req._span_queue = None
         req._span_admit = req.trace.start_span("admit")
+        if self.costs is not None:
+            # wall-clock wait since the last (re-)enqueue — a preempted
+            # request's second wait books again, on purpose: the tenant
+            # really did wait twice
+            self.costs.record_queue_wait(
+                req.tenant, time.perf_counter() - req._t_enqueue)
 
     def _flight_ctx(self) -> dict:
         """Request/trace identity of the in-flight slots — the labels the
@@ -710,6 +773,16 @@ class FCFSScheduler:
                 raise
             return 0  # engine restarted: keep serving the queue
         t_pre1 = time.perf_counter()
+        if self.costs is not None:
+            # one shared device call, split by token share: the compiled
+            # program always runs the full prefill_batch x bucket grid,
+            # so empty rows and intra-row padding book as `padding`
+            self.costs.record_prefill(
+                t_pre1 - t_pre0, bucket=plans[0].bucket,
+                batch_rows=self.engine.prefill_batch,
+                members=[(req.id, req.tenant,
+                          len(req.prompt) - plan.start)
+                         for req, plan in group])
         emitted = 0
         self.metrics.record_admission(len(group))
         for (req, plan), (slot, first) in zip(group, results):
@@ -791,6 +864,8 @@ class FCFSScheduler:
                           traces=[r.trace.trace_id for r in reqs
                                   if r.trace.enabled])
         for req in reqs:
+            if self.costs is not None:
+                self.costs.finalize(req.id)
             req.trace.mark_error(type(e).__name__)
             req.trace.finish(reason="admission_error")
             req._done.set()
@@ -845,6 +920,12 @@ class FCFSScheduler:
             if req.slot >= 0:
                 self.engine.release(req.slot)
                 self._by_slot.pop(req.slot, None)
+            if self.costs is not None:
+                # the work already booked as useful stays useful (the
+                # counters are monotonic); the REPLAY of these discarded
+                # tokens is what books as waste, forward, as it happens
+                self.costs.note_preempt(req.id, req.tenant,
+                                        len(req.tokens))
             req.slot = -1
             req.tokens = []
             req.state = RequestState.QUEUED
@@ -857,6 +938,7 @@ class FCFSScheduler:
                 idx = len(self._queue)
             self._queue.insert(idx, req)
         self.metrics.record_preemption()
+        req._t_enqueue = time.perf_counter()
         if req._span_admit is not None:
             req.trace.end_span(req._span_admit)
             req._span_admit = None
@@ -893,6 +975,8 @@ class FCFSScheduler:
             self._queue = sanitizer.guarded(
                 keep, lock=self._lock, name="FCFSScheduler._queue")
         for req in expired:
+            if self.costs is not None:
+                self.costs.finalize(req.id)
             # deadline-missed traces are retained regardless of sampling
             # (always-sample-on-deadline-miss): exactly the requests an
             # SLO breach will want to name
@@ -937,6 +1021,8 @@ class FCFSScheduler:
                                   if r.trace.enabled])
         get_event_log().dump(file=sys.stderr, last=32, once="failure")
         for req in victims:
+            if self.costs is not None:
+                self.costs.finalize(req.id)
             req.trace.mark_error(type(e).__name__)
             req.trace.finish(reason="engine_error")
             req._done.set()
@@ -979,6 +1065,8 @@ class FCFSScheduler:
             self._by_slot.pop(req.slot, None)
             req.state = RequestState.DONE
             self.metrics.record_done()
+        if self.costs is not None:
+            self.costs.finalize(req.id)
         self._events.emit("slot_retire", req=req.id, slot=req.slot,
                           reason=reason, tokens=len(req.tokens),
                           **self._trace_label(req))

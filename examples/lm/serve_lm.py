@@ -294,6 +294,16 @@ def main() -> None:
     ap.add_argument("--ts-cadence", type=float, default=0.05,
                     help="collector sampling cadence in seconds "
                          "(--health)")
+    ap.add_argument("--tenants", type=int, default=1,
+                    help="cost accounting (ISSUE 17): label the burst's "
+                         "requests with this many synthetic tenants "
+                         "(round-robin) and print the per-tenant cost "
+                         "table — device seconds by kind, KV "
+                         "block-seconds, queue wait — plus the fleet "
+                         "goodput breakdown at the end; with "
+                         "--http-port the /costs endpoint serves the "
+                         "same JSON live (1: everything bills to "
+                         "'default')")
     args = ap.parse_args()
 
     comm = chainermn_tpu.create_communicator("tpu") if args.tensor_parallel \
@@ -469,10 +479,11 @@ def main() -> None:
             fleet=front if fleet_mode else None,
             timeseries=collector,
             health=collector.health if collector is not None else None,
-            controller=controller)
+            controller=controller,
+            costs=None if fleet_mode else front.metrics.costs)
         print(f"monitor endpoints at {server.url} "
               "(/metrics /traces /slo /events /fleet /timeseries "
-              "/health /control)")
+              "/health /control /costs)")
     shared = (rng.randint(2, args.vocab, args.shared_prefix)
               .astype(np.int32) if args.shared_prefix else
               np.zeros((0,), np.int32))
@@ -495,6 +506,8 @@ def main() -> None:
         # --max-queue the bounded queue may bounce some (backpressure is
         # the submitter's signal — a real client would retry later)
         handles = []
+        tenants = [f"tenant{j}" for j in range(max(args.tenants, 1))] \
+            if args.tenants > 1 else ["default"]
         for i in range(args.requests - 1):
             prompt = np.concatenate([shared, rng.randint(
                 2, args.vocab, rng.randint(1, tail_max + 1))
@@ -502,7 +515,8 @@ def main() -> None:
             n_new = int(rng.randint(1, args.max_new + 1))
             key = jax.random.PRNGKey(100 + i)
             try:
-                h = client.submit(prompt, n_new, rng=key)
+                h = client.submit(prompt, n_new, rng=key,
+                                  tenant=tenants[i % len(tenants)])
                 handles.append(h)
                 parity_jobs.append((h, prompt, n_new, key))
             except QueueFullError:
@@ -559,8 +573,11 @@ def main() -> None:
                 "tokens_generated": fleet_rep["pooled"]["counters"].get(
                     "serving_tokens_total", 0),
             }
+            cost_rep = fleet_rep.get("costs")
         else:
             report = client.metrics.report()
+            # printed as its own table below, not as one mega-line
+            cost_rep = report.pop("costs", None)
 
     print(f"streamed request: {len(stream_toks)} tokens "
           f"(first few: {stream_toks[:8]})")
@@ -572,6 +589,21 @@ def main() -> None:
           "shed/failed)")
     for k, v in sorted(report.items()):
         print(f"  {k}: {v}")
+    if cost_rep:
+        # the tenant bill: who consumed the device, and how much of the
+        # measured time did useful work (the goodput breakdown)
+        dt = cost_rep["device_time"]
+        gp = cost_rep["goodput"]
+        print(f"cost accounting: measured={dt['measured_s']}s "
+              f"attributed={dt['attributed_s']}s over "
+              f"{dt['dispatches']} dispatches "
+              f"(conservation_error={dt['conservation_error']})")
+        print("  goodput: " + ", ".join(
+            f"{k}={v}" for k, v in gp.items()))
+        for tenant, row in sorted(cost_rep["tenants"].items()):
+            print(f"  tenant {tenant}: device={row['device_total_s']}s "
+                  f"{row['device_s']} kv_block_s={row['kv_block_s']} "
+                  f"queue_wait_s={row['queue_wait_s']}")
     if args.verify_parity:
         from chainermn_tpu.models import generate as solo_generate
 
@@ -646,6 +678,16 @@ def main() -> None:
                 ts_scraped = _json.loads(r.read())
             print(f"scraped /health: worst={scraped.get('worst')}; "
                   f"/timeseries: {ts_scraped.get('n_series', 0)} series")
+    if server is not None:
+        import json as _json
+        from urllib.request import urlopen
+
+        with urlopen(f"{server.url}/costs", timeout=10) as r:
+            cost_scraped = _json.loads(r.read())
+        if cost_scraped:
+            print(f"scraped /costs: {len(cost_scraped['tenants'])} "
+                  "tenant(s), conservation_error="
+                  f"{cost_scraped['device_time']['conservation_error']}")
     if server is not None:
         server.close()
     if args.prometheus:

@@ -48,7 +48,7 @@ _HIGHER = ("tokens_per_sec", "throughput", "speedup", "hit_rate",
            "max_concurrent", "parity", "bandwidth")
 _LOWER = ("_ms", "wall", "ttft", "tpot", "mttr", "lag", "overhead",
           "dip", "seconds", "preemption", "recompile", "eviction",
-          "read_amplification")
+          "read_amplification", "conservation")
 # flattened subtrees that are snapshots/config, not trajectory metrics
 _SKIP_KEYS = ("monitor", "tail", "cmd", "model", "trie", "kv_stats",
               "compile_counts", "critical_path", "health", "outcomes",

@@ -52,6 +52,7 @@ PINNED_METRICS = frozenset({
     "controller_scale_ups_total",
     "controller_target_replicas",
     "controller_ticks_total",
+    "cost_conservation_error",
     "deploy_swap_failures_total",
     "deploy_swap_seconds",
     "deploy_swaps_total",
@@ -70,6 +71,7 @@ PINNED_METRICS = frozenset({
     "fleet_reroutes_total",
     "fleet_route_fallbacks_total",
     "fleet_shed_total",
+    "goodput_fraction",
     "health_state",
     "kv_block_appends_total",
     "kv_blocks_free",
@@ -118,6 +120,8 @@ PINNED_METRICS = frozenset({
     "spec_tokens_proposed_total",
     "step_time_seconds",
     "steps_total",
+    "tenant_device_seconds_total",
+    "tenant_kv_block_seconds_total",
     "trace_phase_seconds",
     "trainer_failures_total",
     "trainer_mttr_seconds",
@@ -140,6 +144,7 @@ PINNED_EVENTS = frozenset({
     "controller_rebalance",
     "controller_scale_down",
     "controller_scale_up",
+    "cost_flush",
     "decode_step",
     "detector_cleared",
     "detector_fired",
@@ -161,6 +166,7 @@ PINNED_EVENTS = frozenset({
     "kv_append",
     "kv_preempt",
     "lock_contended",
+    "noisy_neighbor",
     "paged_kernel_fallback",
     "prefill",
     "prefix_evict",

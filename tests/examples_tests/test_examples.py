@@ -272,6 +272,28 @@ def test_serve_lm_health_fleet():
 
 
 @pytest.mark.slow  # another multi-second subprocess run: full-suite only, to keep tier-1 inside its timeout
+def test_serve_lm_tenant_costs_endpoint():
+    """ISSUE 17: ``--tenants 2`` labels the burst round-robin, the
+    per-tenant cost table (device seconds split by kind, conservation
+    check) prints at the end, and the demo's self-scrape proves /costs
+    serves the same JSON over a real socket (ephemeral --http-port 0)."""
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "8", "--slots", "2", "--max-new", "6",
+         "--prefill-len", "8", "--d-model", "32", "--layers", "1",
+         "--heads", "4", "--tenants", "2", "--http-port", "0"],
+    )
+    assert "8/8 requests served" in proc.stdout
+    assert "cost accounting: measured=" in proc.stdout
+    assert "conservation_error=0.0" in proc.stdout
+    assert "tenant tenant0:" in proc.stdout
+    assert "tenant tenant1:" in proc.stdout
+    assert "goodput: useful=" in proc.stdout
+    assert "scraped /costs:" in proc.stdout
+    assert "zero recompiles" in proc.stdout
+
+
+@pytest.mark.slow  # another multi-second subprocess run: full-suite only, to keep tier-1 inside its timeout
 def test_serve_lm_autoscale_canary():
     """ISSUE 16: ``--autoscale`` runs the closed-loop controller over
     the serving burst — queue pressure on the single starting replica

@@ -24,6 +24,7 @@ def test_resnet50_param_count():
     assert 25_000_000 < n < 26_000_000, n
 
 
+@pytest.mark.slow  # heavy vision compile: full-suite only, keeps tier-1 inside its timeout (googlenet precedent)
 def test_tiny_resnet_forward_backward():
     model = ResNet(stage_sizes=[1, 1], width=8, num_classes=5,
                    compute_dtype=jnp.float32)
@@ -86,6 +87,7 @@ def test_resnet18_uses_basic_blocks():
     assert y.shape == (1, 10)
 
 
+@pytest.mark.slow  # heavy vision compile: full-suite only, keeps tier-1 inside its timeout (googlenet precedent)
 def test_alexnet_forward():
     model = AlexNet(num_classes=10, compute_dtype=jnp.float32)
     x = jnp.ones((2, 224, 224, 3))

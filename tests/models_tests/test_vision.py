@@ -48,6 +48,7 @@ def test_googlenet_forward_backward_small():
     assert all(jnp.all(jnp.isfinite(l)) for l in jax.tree_util.tree_leaves(g))
 
 
+@pytest.mark.slow  # heavy vision compile: full-suite only, keeps tier-1 inside its timeout (googlenet precedent)
 def test_vgg16_forward_small():
     model = VGG16(num_classes=4, compute_dtype=jnp.float32)
     x = jnp.ones((1, 64, 64, 3))

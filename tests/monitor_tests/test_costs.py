@@ -1,0 +1,430 @@
+"""Cost-ledger unit fixtures (ISSUE 17): the splitting rules in
+isolation — bucketed-prefill padding shares, speculative accept/waste,
+refcount-split block-seconds, the preempt-and-replay double-booking
+guard — plus the registry fold, the fleet merge, and the noisy-neighbor
+sensor kit. Everything here is pure host arithmetic: no engine, no jax,
+no sleeps (intervals are passed in, never measured)."""
+
+import random
+
+import pytest
+
+from chainermn_tpu.monitor.costs import (
+    KINDS,
+    UNATTRIBUTED,
+    CostLedger,
+    NoisyNeighborDetector,
+    ShareOfTotal,
+    merge_cost_payloads,
+    standard_tenant_sensors,
+    tenant_block_key,
+    tenant_device_key,
+)
+from chainermn_tpu.monitor.events import EventLog
+from chainermn_tpu.monitor.registry import MetricsRegistry
+from chainermn_tpu.monitor.timeseries import TimeSeriesStore
+
+
+def _ledger(**kw):
+    return CostLedger(instance="i0", registry=MetricsRegistry(),
+                      events=EventLog(), **kw)
+
+
+# ---------------------------------------------------------------------- #
+# prefill: token-share split, padding rows                                #
+# ---------------------------------------------------------------------- #
+
+
+def test_prefill_splits_by_token_share_and_pads_empty_rows():
+    led = _ledger()
+    # 0.4s over 2 compiled rows; one member with 32 real of 64 tokens
+    out = led.record_prefill(0.4, bucket=64, batch_rows=2,
+                             members=[(1, "a", 32)])
+    assert out[("a", "useful")] == pytest.approx(0.1)
+    assert out[("a", "padding")] == pytest.approx(0.1)
+    assert out[(UNATTRIBUTED, "padding")] == pytest.approx(0.2)
+    assert sum(out.values()) == pytest.approx(0.4)
+    assert led.conservation_error < 1e-9
+
+
+def test_prefill_clamps_suffix_into_bucket():
+    led = _ledger()
+    # suffix > bucket clamps to all-useful; negative clamps to all-pad
+    out = led.record_prefill(0.2, bucket=8, batch_rows=2,
+                             members=[(1, "a", 99), (2, "b", -3)])
+    assert out[("a", "useful")] == pytest.approx(0.1)
+    assert ("a", "padding") not in out
+    assert out[("b", "padding")] == pytest.approx(0.1)
+    assert ("b", "useful") not in out
+    assert sum(out.values()) == pytest.approx(0.2)
+
+
+def test_prefill_batch_rows_floor_is_member_count():
+    led = _ledger()
+    # caller passing a stale batch_rows smaller than the group still
+    # conserves: rows floor at len(members)
+    out = led.record_prefill(0.3, bucket=4, batch_rows=1,
+                             members=[(1, "a", 4), (2, "a", 4), (3, "b", 4)])
+    assert out[("a", "useful")] == pytest.approx(0.2)
+    assert out[("b", "useful")] == pytest.approx(0.1)
+    assert sum(out.values()) == pytest.approx(0.3)
+
+
+# ---------------------------------------------------------------------- #
+# decode: even row split, speculative accept/waste, idle rows            #
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("committed,rejected,useful_frac", [
+    (1, 3, 0.25),   # accept_rate 0: only the bonus token commits
+    (3, 1, 0.75),   # partial accept
+    (4, 0, 1.0),    # full accept: nothing wasted
+])
+def test_decode_spec_split(committed, rejected, useful_frac):
+    led = _ledger()
+    out = led.record_decode(0.4, n_rows=4,
+                            rows=[(1, "a", committed, rejected)])
+    row_s = 0.1
+    assert out.get(("a", "useful"), 0.0) == pytest.approx(
+        row_s * useful_frac)
+    assert out.get(("a", "wasted"), 0.0) == pytest.approx(
+        row_s * (1.0 - useful_frac))
+    assert out[(UNATTRIBUTED, "idle")] == pytest.approx(0.3)
+    assert sum(out.values()) == pytest.approx(0.4)
+    assert led.conservation_error < 1e-9
+
+
+def test_decode_plain_rows_and_idle():
+    led = _ledger()
+    out = led.record_decode(0.2, n_rows=2, rows=[(1, "a", 1, 0),
+                                                 (2, "b", 1, 0)])
+    assert out[("a", "useful")] == pytest.approx(0.1)
+    assert out[("b", "useful")] == pytest.approx(0.1)
+    assert (UNATTRIBUTED, "idle") not in out
+    assert sum(out.values()) == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------- #
+# KV block-seconds: refcount split integral                              #
+# ---------------------------------------------------------------------- #
+
+
+def test_block_seconds_refcount_split_sums_to_pool_occupancy():
+    led = _ledger()
+    # a prefix block shared by 2 requests contributes 0.5 per holder:
+    # tenant a holds 2 private + half of one shared = 2.5 shares,
+    # tenant b holds half of the shared = 0.5 — pool occupancy 3 blocks
+    led.record_block_seconds(2.0, [("a", 2.5), ("b", 0.5)])
+    led.record_block_seconds(0.0, [("a", 99.0)])      # dt<=0 ignored
+    led.record_block_seconds(1.0, [("a", 0.0)])       # share<=0 ignored
+    rep = led.report()
+    assert rep["tenants"]["a"]["kv_block_s"] == pytest.approx(5.0)
+    assert rep["tenants"]["b"]["kv_block_s"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------- #
+# preempt-and-replay: the double-booking guard                           #
+# ---------------------------------------------------------------------- #
+
+
+def test_replay_prefill_books_once_then_reverts_to_useful():
+    led = _ledger()
+    led.note_preempt(1, "a", 0)
+    out = led.record_prefill(0.1, bucket=4, batch_rows=1,
+                             members=[(1, "a", 4)])
+    assert out == {("a", "replay"): pytest.approx(0.1)}
+    # the flag is consumed: the next prefill is useful again
+    out2 = led.record_prefill(0.1, bucket=4, batch_rows=1,
+                              members=[(1, "a", 4)])
+    assert out2 == {("a", "useful"): pytest.approx(0.1)}
+
+
+def test_replay_decode_debt_is_token_metered_and_drains_once():
+    led = _ledger()
+    led.note_preempt(1, "a", 3)   # 3 generated tokens discarded
+    # first decode commits 2 of the 3 owed: all of its useful share is
+    # replay
+    out = led.record_decode(0.1, n_rows=1, rows=[(1, "a", 2, 0)])
+    assert out == {("a", "replay"): pytest.approx(0.1)}
+    # second decode commits 2: 1 owed + 1 genuinely new
+    out = led.record_decode(0.1, n_rows=1, rows=[(1, "a", 2, 0)])
+    assert out[("a", "replay")] == pytest.approx(0.05)
+    assert out[("a", "useful")] == pytest.approx(0.05)
+    # debt fully drained: no more replay
+    out = led.record_decode(0.1, n_rows=1, rows=[(1, "a", 2, 0)])
+    assert out == {("a", "useful"): pytest.approx(0.1)}
+    assert led.conservation_error < 1e-9
+
+
+def test_second_preempt_adds_only_newly_discarded_tokens():
+    led = _ledger()
+    led.note_preempt(1, "a", 4)
+    # replays 2 of the 4, then gets preempted again having regenerated
+    # (and now re-discarded) those 2 — debt becomes 2 remaining + 2 new
+    led.record_decode(0.1, n_rows=1, rows=[(1, "a", 2, 0)])
+    led.note_preempt(1, "a", 2)
+    drained = 0.0
+    for _ in range(4):
+        out = led.record_decode(0.1, n_rows=1, rows=[(1, "a", 2, 0)])
+        drained += out.get(("a", "replay"), 0.0)
+    # 4 tokens of remaining debt over decodes of 2 committed each:
+    # exactly two more full-replay rounds, never a fifth
+    assert drained == pytest.approx(0.2)
+
+
+def test_finalize_clears_replay_state_and_is_idempotent():
+    led = _ledger()
+    led.note_preempt(1, "a", 5)
+    led.finalize(1)
+    led.finalize(1)
+    out = led.record_prefill(0.1, bucket=4, batch_rows=1,
+                             members=[(1, "a", 4)])
+    assert out == {("a", "useful"): pytest.approx(0.1)}
+    out = led.record_decode(0.1, n_rows=1, rows=[(1, "a", 2, 0)])
+    assert out == {("a", "useful"): pytest.approx(0.1)}
+
+
+# ---------------------------------------------------------------------- #
+# queue wait                                                              #
+# ---------------------------------------------------------------------- #
+
+
+def test_queue_wait_accumulates_and_ignores_nonpositive():
+    led = _ledger()
+    led.record_queue_wait("a", 0.25)
+    led.record_queue_wait("a", 0.75)
+    led.record_queue_wait("a", -1.0)
+    led.record_queue_wait("b", 0.0)
+    rep = led.report()
+    assert rep["tenants"]["a"]["queue_wait_s"] == pytest.approx(1.0)
+    assert "b" not in rep["tenants"]
+
+
+# ---------------------------------------------------------------------- #
+# flush: registry fold, goodput gauges, cost_flush event                 #
+# ---------------------------------------------------------------------- #
+
+
+def test_flush_folds_counters_gauges_and_emits_event_once():
+    reg, ev = MetricsRegistry(), EventLog()
+    led = CostLedger(instance="i0", registry=reg, events=ev,
+                     flush_event_every_s=3600.0)
+    led.record_prefill(0.4, bucket=64, batch_rows=2, members=[(1, "a", 32)])
+    led.record_block_seconds(2.0, [("a", 1.0)])
+    led.flush(force_event=True)
+    assert reg.counter("tenant_device_seconds_total",
+                       {"instance": "i0", "tenant": "a",
+                        "kind": "useful"}).value == pytest.approx(0.1)
+    assert reg.counter("tenant_kv_block_seconds_total",
+                       {"instance": "i0",
+                        "tenant": "a"}).value == pytest.approx(2.0)
+    fracs = {k: reg.gauge("goodput_fraction",
+                          {"instance": "i0", "kind": k}).value
+             for k in KINDS}
+    assert fracs["useful"] == pytest.approx(0.25)
+    assert fracs["padding"] == pytest.approx(0.75)
+    assert sum(fracs.values()) == pytest.approx(1.0)
+    assert reg.gauge("cost_conservation_error",
+                     {"instance": "i0"}).value == pytest.approx(0.0)
+    kinds = [e["kind"] for e in ev.tail()]
+    assert kinds.count("cost_flush") == 1
+    # idle flush: no new work, counters must not double-inc and the
+    # event is rate-limited away
+    led.flush()
+    assert reg.counter("tenant_device_seconds_total",
+                       {"instance": "i0", "tenant": "a",
+                        "kind": "useful"}).value == pytest.approx(0.1)
+    assert [e["kind"] for e in ev.tail()].count("cost_flush") == 1
+
+
+def test_series_key_helpers_match_registry_rendering():
+    reg = MetricsRegistry()
+    c = reg.counter("tenant_device_seconds_total",
+                    {"tenant": "a", "kind": "useful", "instance": "i0"})
+    assert c.key == tenant_device_key("i0", "a", "useful")
+    b = reg.counter("tenant_kv_block_seconds_total",
+                    {"tenant": "a", "instance": "i0"})
+    assert b.key == tenant_block_key("i0", "a")
+
+
+# ---------------------------------------------------------------------- #
+# report / merge / ranking                                               #
+# ---------------------------------------------------------------------- #
+
+
+def test_report_shape_and_goodput_partition():
+    led = _ledger()
+    led.record_prefill(0.4, bucket=64, batch_rows=2, members=[(1, "a", 32)])
+    led.record_decode(0.4, n_rows=4, rows=[(1, "a", 3, 1), (2, "b", 1, 0)])
+    rep = led.report()
+    assert set(rep) == {"tenants", "goodput", "device_time"}
+    assert set(rep["goodput"]) == set(KINDS)
+    assert sum(rep["goodput"].values()) == pytest.approx(1.0, abs=1e-5)
+    assert rep["device_time"]["dispatches"] == 2
+    assert rep["device_time"]["conservation_error"] == pytest.approx(0.0)
+    assert rep["device_time"]["max_dispatch_error"] == pytest.approx(0.0)
+    assert rep["device_time"]["attributed_s"] == pytest.approx(
+        rep["device_time"]["measured_s"])
+    assert UNATTRIBUTED in rep["tenants"]
+
+
+def test_merge_cost_payloads_pools_replicas():
+    a, b = _ledger(), _ledger()
+    a.record_prefill(0.4, bucket=4, batch_rows=1, members=[(1, "t0", 4)])
+    b.record_prefill(0.6, bucket=4, batch_rows=1, members=[(2, "t0", 4)])
+    b.record_decode(0.2, n_rows=2, rows=[(2, "t1", 1, 0)])
+    b.record_queue_wait("t1", 0.5)
+    merged = merge_cost_payloads([a.payload(), b.payload()])
+    assert merged["tenants"]["t0"]["device_s"]["useful"] == pytest.approx(1.0)
+    assert merged["tenants"]["t1"]["device_s"]["useful"] == pytest.approx(0.1)
+    assert merged["tenants"]["t1"]["queue_wait_s"] == pytest.approx(0.5)
+    assert merged["device_time"]["dispatches"] == 3
+    assert merged["device_time"]["conservation_error"] == pytest.approx(0.0)
+
+
+def test_top_tenant_excludes_unattributed():
+    led = _ledger()
+    assert led.top_tenant() is None
+    led.record_prefill(0.4, bucket=64, batch_rows=4, members=[(1, "a", 64)])
+    led.record_decode(0.4, n_rows=2, rows=[(2, "b", 1, 0)])
+    # "-" carries 0.3 padding + 0.2 idle but must never win the ranking
+    tenant, secs = led.top_tenant()
+    assert tenant == "b"
+    assert secs == pytest.approx(0.2)
+    assert UNATTRIBUTED not in led.tenant_device_seconds()
+
+
+# ---------------------------------------------------------------------- #
+# conservation property: fuzzed schedule                                 #
+# ---------------------------------------------------------------------- #
+
+
+def _fuzz_conservation(seed):
+    rng = random.Random(seed)
+    led = _ledger()
+    live = []
+    for i in range(300):
+        op = rng.random()
+        if op < 0.35 or not live:
+            rid = i
+            live.append((rid, rng.choice(["a", "b", "c"])))
+            members = [(r, t, rng.randint(0, 80))
+                       for r, t in rng.sample(live, min(len(live), 4))]
+            led.record_prefill(rng.uniform(1e-6, 0.5),
+                               bucket=rng.choice([16, 64]),
+                               batch_rows=rng.randint(1, 4),
+                               members=members)
+        elif op < 0.75:
+            rows = [(r, t, rng.randint(1, 5), rng.randint(0, 4))
+                    for r, t in rng.sample(live, min(len(live), 4))]
+            led.record_decode(rng.uniform(1e-6, 0.5),
+                              n_rows=rng.randint(1, 4), rows=rows)
+        elif op < 0.85:
+            rid, t = rng.choice(live)
+            led.note_preempt(rid, t, rng.randint(0, 12))
+        elif op < 0.95:
+            led.record_block_seconds(
+                rng.uniform(0.0, 0.1),
+                [(t, rng.uniform(0.0, 8.0)) for _, t in live[:3]])
+        else:
+            rid, _ = live.pop(rng.randrange(len(live)))
+            led.finalize(rid)
+        if rng.random() < 0.1:
+            led.flush()
+    led.flush(force_event=True)
+    pay = led.payload()
+    assert led.conservation_error < 1e-9
+    assert pay["max_dispatch_error"] < 1e-9
+    assert pay["dispatches"] > 0
+    # every attribution kind the ledger emitted is a known kind
+    assert {k.split("\x00")[1] for k in pay["device"]} <= set(KINDS)
+
+
+def test_conservation_fuzzed_schedule():
+    _fuzz_conservation(1234)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [7, 99, 2024])
+def test_conservation_fuzzed_schedule_soak(seed):
+    _fuzz_conservation(seed)
+
+
+# ---------------------------------------------------------------------- #
+# sensors: share signal + noisy-neighbor detector                        #
+# ---------------------------------------------------------------------- #
+
+
+def test_share_of_total_skips_until_total_positive():
+    store = TimeSeriesStore(maxlen=16)
+    sig = ShareOfTotal("r:a", ["r:a", "r:b"], name="share:a")
+    sig.evaluate(store, 1.0)                 # no numerator yet
+    assert store.last("share:a") is None
+    store.append("r:a", 2.0, 0.0)
+    store.append("r:b", 2.0, 0.0)
+    sig.evaluate(store, 2.0)                 # total 0 -> skipped
+    assert store.last("share:a") is None
+    store.append("r:a", 3.0, 3.0)
+    store.append("r:b", 3.0, 1.0)
+    sig.evaluate(store, 3.0)
+    assert store.last("share:a") == (3.0, pytest.approx(0.75))
+
+
+def test_noisy_neighbor_threshold_mode_names_tenant_on_rising_edge():
+    store, ev = TimeSeriesStore(maxlen=16), EventLog()
+    det = NoisyNeighborDetector("nn", "share:a", tenant="bulk",
+                                threshold=0.6)
+    v = det.evaluate(store, 1.0, events=ev)       # no data: not firing
+    assert v["firing"] is False and v["tenant"] == "bulk"
+    store.append("share:a", 2.0, 0.9)
+    v = det.evaluate(store, 2.0, events=ev)
+    assert v["firing"] is True
+    store.append("share:a", 3.0, 0.95)
+    det.evaluate(store, 3.0, events=ev)           # still firing: no re-emit
+    nn = [e for e in ev.tail() if e["kind"] == "noisy_neighbor"]
+    assert len(nn) == 1
+    assert nn[0]["tenant"] == "bulk"
+    assert nn[0]["detector"] == "nn"
+    assert nn[0]["series"] == "share:a"
+    # base-class edge machinery still ran alongside
+    assert any(e["kind"] == "detector_fired" for e in ev.tail())
+
+
+def test_noisy_neighbor_z_mode_fires_on_rate_spike():
+    store, ev = TimeSeriesStore(maxlen=256), EventLog()
+    det = NoisyNeighborDetector("nn", "r:a", tenant="bulk",
+                                z=3.0, baseline=32, min_points=8)
+    for i in range(32):
+        store.append("r:a", float(i), 1.0 + 0.01 * (i % 3))
+        assert det.evaluate(store, float(i), events=ev)["firing"] is False
+    store.append("r:a", 40.0, 50.0)
+    v = det.evaluate(store, 40.0, events=ev)
+    assert v["firing"] is True
+    assert v["tenant"] == "bulk"
+    assert [e["tenant"] for e in ev.tail()
+            if e["kind"] == "noisy_neighbor"] == ["bulk"]
+
+
+def test_standard_tenant_sensors_wiring():
+    tenants = ["bulk", "quiet"]
+    signals, detectors = standard_tenant_sensors(
+        "bulk", "i0", tenants=tenants, share_threshold=0.6, tag="t")
+    assert [s.name for s in signals] == ["tenant_device_share:t",
+                                         "tenant_block_share:t"]
+    assert signals[0].num == tenant_device_key("i0", "bulk",
+                                               "useful") + ":rate"
+    assert signals[0].siblings == [
+        tenant_device_key("i0", t, "useful") + ":rate" for t in tenants]
+    (det,) = detectors
+    assert det.name == "noisy_neighbor:t"
+    assert det.series == "tenant_device_share:t"
+    assert det.tenant == "bulk" and det.threshold == 0.6
+    # rate-threshold fallback watches the raw rate series
+    _, (det2,) = standard_tenant_sensors("bulk", "i0", rate_threshold=5.0)
+    assert det2.series == tenant_device_key("i0", "bulk",
+                                            "useful") + ":rate"
+    assert det2.threshold == 5.0
+    # open-world default: z-score drift, default tag
+    _, (det3,) = standard_tenant_sensors("bulk", "i0")
+    assert det3.name == "noisy_neighbor:bulk@i0"
+    assert det3.threshold is None

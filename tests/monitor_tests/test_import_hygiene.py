@@ -83,7 +83,7 @@ def _run_hygiene(pkg, pkg_name, required):
 
 def test_monitor_modules_never_import_extensions_at_module_level():
     _run_hygiene(monitor_pkg, "chainermn_tpu.monitor",
-                 ("trace", "slo", "http"))
+                 ("trace", "slo", "http", "costs"))
 
 
 def test_fleet_modules_never_import_extensions_at_module_level():

@@ -241,6 +241,29 @@ def test_bench_serving_mode_smoke():
     # every decision in the ring names its triggering signals
     assert all(d.get("signals") for d in fa["decisions"]
                if d["action"] in ("scale_up", "scale_down"))
+    # ---- the ISSUE-17 cost accounting (acceptance criterion) --------- #
+    ca = rec["cost_accounting"]
+    # conservation: attributed device-seconds match the measured time of
+    # every dispatch within ±10% (by construction it sits at float eps)
+    assert ca["conservation_error"] <= 0.10, ca
+    assert ca["max_dispatch_error"] <= 0.10, ca
+    assert ca["dispatches"] > 0
+    # the ledger's dict arithmetic is cheap (<2% production target; CI
+    # bound generous — millisecond CPU decodes under a shared runner)
+    assert ca["accounting_overhead_frac"] < 0.15, ca
+    assert ca["parity_on_vs_off"] is True
+    assert ca["recompiles_after_warmup"] == 0
+    # goodput fractions partition the measured time (padding/idle/etc.)
+    gp = ca["goodput"]
+    assert set(gp) == {"useful", "padding", "idle", "wasted", "replay"}
+    assert gp["useful"] > 0
+    assert abs(sum(gp.values()) - 1.0) < 0.02, gp
+    # the bursty tenant out-billed the quiet one, and the threshold
+    # detector fired deterministically NAMING it
+    assert ca["tenant_device_s"]["bulk"] > ca["tenant_device_s"]["quiet"]
+    assert ca["bulk_share"] is not None and ca["bulk_share"] > 0.6, ca
+    assert ca["noisy_neighbor_fired"] is True
+    assert ca["noisy_neighbor_tenant"] == "bulk"
 
 
 def _run_monitor_mode(extra_env):
