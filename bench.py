@@ -1547,6 +1547,144 @@ def serving_main() -> None:
             f"{ca['goodput']['useful']}, noisy_neighbor="
             f"{ca['noisy_neighbor_tenant']} "
             f"(share={ca['bulk_share']}), parity={ca_parity}")
+
+        # ---- overload fairness: classes + weighted DRR vs FIFO -------- #
+        # ISSUE 18 acceptance: drive the warm engine ~3x past its service
+        # rate (a bursty tenant's interactive stream plus a batch tier
+        # queued behind it). Plain FIFO makes the quiet tenant's
+        # interactive TTFT collapse behind the backlog; fair admission
+        # (strict interactive-before-batch + weighted DRR) holds it near
+        # the unloaded baseline. The scheduler-owned brownout ladder
+        # steps up under the sustained interactive backlog and fully
+        # unwinds as it drains. Both overload runs see the SAME arrival
+        # order, so token parity ON-vs-OFF proves admission order never
+        # changes a stream; the warm engine never recompiles.
+        from chainermn_tpu.serving.fairness import (
+            BrownoutPolicy,
+            FairAdmission,
+        )
+        from chainermn_tpu.serving.scheduler import RequestState
+
+        of_nq = max(2, n_requests // 6)     # quiet interactive jobs
+        of_rng = np.random.RandomState(18)
+
+        def of_prompt():
+            return of_rng.randint(
+                1, vocab, of_rng.randint(max(1, prefill_len // 2),
+                                         prefill_len + 1)).astype(np.int32)
+
+        quiet_jobs = [(of_prompt(), max_new, "quiet", "interactive")
+                      for _ in range(of_nq)]
+        burst_jobs = [(of_prompt(), max_new, "burst", "interactive")
+                      for _ in range(3 * of_nq)]
+        batch_jobs = [(of_prompt(), max_new, "burst", "batch")
+                      for _ in range(2 * of_nq)]
+        # the arrival order both overload runs share: the batch backlog
+        # is already queued, then the burst interleaves 3:1 with quiet
+        mixed = list(batch_jobs)
+        qi = iter(quiet_jobs)
+        for i, job in enumerate(burst_jobs):
+            mixed.append(job)
+            if i % 3 == 2:
+                nxt = next(qi, None)
+                if nxt is not None:
+                    mixed.append(nxt)
+        mixed.extend(qi)
+
+        def of_run(sched, jobs, track=None):
+            t_first = {}
+            reqs = []
+            for prompt, n, tenant, priority in jobs:
+                key = len(reqs)
+
+                def cb(tok, _k=key):
+                    t_first.setdefault(_k, time.perf_counter())
+                reqs.append(sched.submit(prompt, n, tenant=tenant,
+                                         priority=priority, stream_cb=cb))
+            max_level = 0
+            while sched.has_work:
+                sched.step()
+                if track is not None:
+                    max_level = max(max_level, track.level)
+            ttft = [t_first[i] - r.t_submit for i, r in enumerate(reqs)]
+            return reqs, ttft, max_level
+
+        def of_quiet_p99(jobs, ttft):
+            vals = [t for j, t in zip(jobs, ttft)
+                    if j[2] == "quiet" and j[3] == "interactive"]
+            return float(np.percentile(np.asarray(vals), 99))
+
+        of_counts = engine.compile_counts_detailed()
+        # unloaded baseline: the quiet tenant alone on the warm engine
+        s_of_base = FCFSScheduler(engine)
+        _, base_ttft, _ = of_run(s_of_base, quiet_jobs)
+        of_base_p99 = of_quiet_p99(quiet_jobs, base_ttft)
+        # FIFO under overload: the pre-PR-18 scheduler, byte-identical
+        s_of_fifo = FCFSScheduler(engine)
+        fifo_reqs, fifo_ttft, _ = of_run(s_of_fifo, mixed)
+        of_fifo_p99 = of_quiet_p99(mixed, fifo_ttft)
+        # fair admission + brownout under the SAME arrivals. max_level=2
+        # keeps L3's token cap and L4's shed out of play, so accepted
+        # requests are EXACTLY the FIFO run's (parity + nothing lost);
+        # quantum below typical request cost makes the 4:1 weights gate.
+        of_bo = BrownoutPolicy(
+            max_level=2, queue_high=float(max(2, n_slots // 2)),
+            up_after_s=0.01, down_after_s=0.05, cooldown_s=0.03)
+        of_fair = FairAdmission(
+            tenant_weights={"quiet": 4.0, "burst": 1.0},
+            quantum_tokens=2.0)
+        s_of_fair = FCFSScheduler(engine, fair=of_fair, brownout=of_bo)
+        fair_reqs, fair_ttft, of_max_level = of_run(s_of_fair, mixed,
+                                                    track=of_bo)
+        of_fair_p99 = of_quiet_p99(mixed, fair_ttft)
+        # idle + calm: sustained zero interactive depth unwinds the
+        # ladder one hysteresis window at a time
+        of_deadline = time.time() + 30.0
+        while of_bo.level > 0 and time.time() < of_deadline:
+            s_of_fair.step()
+            time.sleep(0.005)
+        of_parity = all(
+            bool(np.array_equal(a.output, b.output))
+            for a, b in zip(fair_reqs, fifo_reqs))
+        of_lost = not all(r.state is RequestState.DONE
+                          for r in fifo_reqs + fair_reqs)
+        assert engine.compile_counts_detailed() == of_counts, "recompiled!"
+        of_cp = s_of_fair.metrics._c_class_preempt
+        record["overload_fairness"] = {
+            "slots": n_slots,
+            "jobs": {"quiet_interactive": of_nq,
+                     "burst_interactive": 3 * of_nq,
+                     "batch": 2 * of_nq},
+            "overload_factor": round(6 * of_nq / max(of_nq, 1), 2),
+            "quiet_p99_unloaded": round(of_base_p99, 4),
+            "quiet_p99_fifo": round(of_fifo_p99, 4),
+            "quiet_p99_fair": round(of_fair_p99, 4),
+            "fifo_collapse_factor": round(
+                of_fifo_p99 / max(of_base_p99, 1e-9), 2),
+            "quiet_slowdown_factor": round(
+                of_fair_p99 / max(of_base_p99, 1e-9), 2),
+            "quiet_goodput_tokens": int(of_nq * max_new),
+            "brownout": {
+                "max_level": int(of_max_level),
+                "final_level": int(of_bo.level),
+                "steps": of_bo.to_json()["steps"],
+            },
+            "preempted_interactive": int(of_cp["interactive"].value),
+            "preempted_batch": int(of_cp["batch"].value),
+            "token_parity_on_vs_off": of_parity,
+            "no_request_lost": not of_lost,
+            "recompiles_after_warmup": 0,
+            "conservation_error": round(
+                s_of_fair.costs.conservation_error, 9),
+        }
+        of = record["overload_fairness"]
+        log(f"overload fairness: quiet TTFT p99 unloaded="
+            f"{of['quiet_p99_unloaded']}s fifo={of['quiet_p99_fifo']}s "
+            f"(x{of['fifo_collapse_factor']}) fair="
+            f"{of['quiet_p99_fair']}s (x{of['quiet_slowdown_factor']}), "
+            f"brownout {of['brownout']['max_level']}->"
+            f"{of['brownout']['final_level']}, parity={of_parity}, "
+            f"lost={of_lost}")
         from chainermn_tpu.monitor import snapshot as monitor_snapshot
 
         record["monitor"] = monitor_snapshot()

@@ -46,6 +46,10 @@ from chainermn_tpu.fleet.control import (
     FleetController,
     RebalancePolicy,
 )
+from chainermn_tpu.fleet.overload import (
+    RetryBudget,
+    TenantBreaker,
+)
 from chainermn_tpu.fleet.replica import (
     EngineReplica,
     ReplicaHang,
@@ -73,6 +77,8 @@ __all__ = [
     "ReplicaKilled",
     "ReplicaSnapshot",
     "ReplicaState",
+    "RetryBudget",
     "RouteDecision",
     "RoutingPolicy",
+    "TenantBreaker",
 ]

@@ -157,8 +157,26 @@ class FleetController:
     slo : SLOEngine, optional
         Burn-rate gate for both scale-up pressure and the canary bake.
     engine_factory : callable() -> ServingEngine, optional
-        Builds the engine for each scale-up (required when an
-        ``autoscale`` policy is given).
+        Builds the engine for each scale-up (an ``autoscale`` policy
+        needs this, ``snapshot``, or both).
+    snapshot : dict, optional
+        Durable spawn source for scale-ups, forwarded verbatim to
+        ``router.spawn_replica`` (keys ``checkpoint`` /
+        ``engine_factory`` / ``params_template``, optionally ``comm`` /
+        ``model``). When set, scale-up restores the new replica from
+        the fleet's persisted snapshot — the weights every survivor of
+        a crash would converge to — instead of whatever params the live
+        factory closure captured at construction time. A failed
+        snapshot load (corrupt file, injected fault) falls back to
+        ``engine_factory`` when one is available, recorded as
+        ``source="factory_fallback"`` on the decision.
+    brownout : BrownoutPolicy, optional
+        The serving tier's degradation ladder (shared with the replica
+        schedulers). When present, sustained pressure steps brownout UP
+        *before* spending a replica on scale-up — shedding load is
+        cheap and instant, capacity is slow and finite — and a spawned
+        replica turning ready steps it fully back DOWN
+        (``relieve("capacity_arrived")``).
     autoscale / canary / rebalance : policy dataclasses or None
         ``None`` disables that policy entirely.
     cadence_s / clock : like the Collector — ``start()`` runs
@@ -171,9 +189,11 @@ class FleetController:
 
     def __init__(self, router, collector, *, slo=None,
                  engine_factory: Optional[Callable] = None,
+                 snapshot: Optional[dict] = None,
                  autoscale: Optional[AutoscalePolicy] = None,
                  canary: Optional[CanaryPolicy] = None,
                  rebalance: Optional[RebalancePolicy] = None,
+                 brownout=None,
                  cadence_s: float = 0.5, clock=None,
                  sensor_kw: Optional[dict] = None,
                  publish_timeout_s: float = 60.0,
@@ -181,19 +201,22 @@ class FleetController:
                  registry=None, events=None) -> None:
         if cadence_s <= 0:
             raise ValueError(f"cadence_s must be > 0, got {cadence_s}")
-        if autoscale is not None and engine_factory is None:
+        if (autoscale is not None and engine_factory is None
+                and snapshot is None):
             raise ValueError(
-                "an autoscale policy needs engine_factory= to build "
-                "scale-up replicas")
+                "an autoscale policy needs engine_factory= or snapshot= "
+                "to build scale-up replicas")
         self.router = router
         self.collector = collector
         self.slo = slo
         self.autoscale = autoscale
         self.canary = canary
         self.rebalance = rebalance
+        self.brownout = brownout
         self.cadence_s = float(cadence_s)
         self.log = VersionLog()          # fleet-level deploy audit trail
         self._engine_factory = engine_factory
+        self._snapshot = dict(snapshot) if snapshot is not None else None
         self._sensor_kw = dict(sensor_kw or {})
         self._publish_timeout_s = float(publish_timeout_s)
         self._retire_timeout_s = float(retire_timeout_s)
@@ -395,7 +418,11 @@ class FleetController:
                 self._pressure_since = now
             elif (now - self._pressure_since >= p.up_after_s
                   and not in_cooldown):
-                self._scale_up(now, s, summary)
+                if (self.brownout is not None
+                        and not self.brownout.saturated):
+                    self._brownout_up(now, s, summary)
+                else:
+                    self._scale_up(now, s, summary)
         elif idle:
             self._pressure_since = None
             if self._idle_since is None:
@@ -422,9 +449,47 @@ class FleetController:
             return None
         return max(totals.items(), key=lambda kv: (kv[1], kv[0]))[0]
 
+    def _brownout_up(self, now: float, s: dict, summary: dict) -> None:
+        """Degrade before scaling: a brownout step is instant and free,
+        a replica is slow and finite. The step counts as a scale action
+        for cooldown purposes, so pressure must persist THROUGH the
+        shed before real capacity is spent (the ``brownout_step`` event
+        is emitted by the policy itself)."""
+        prev = self.brownout.level
+        self.brownout.step_up(
+            "controller:" + "+".join(s["pressure"]), now=now)
+        self._last_scale = now
+        self._pressure_since = None
+        action = {"action": "brownout", "direction": "up", "t": now,
+                  "level": self.brownout.level, "prev": prev,
+                  "signals": list(s["pressure"]),
+                  "queue_per_replica": round(s["queue_per_replica"], 3)}
+        summary["actions"].append(action)
+
+    def _spawn_scaled_replica(self) -> tuple:
+        """Scale-up spawn, snapshot-first: restore the new replica from
+        the fleet's durable snapshot when one is configured — the
+        crash-consistent weights — falling back to the live factory if
+        the restore fails (corrupt/injected fault) and a factory
+        exists. Returns ``(replica, source)``."""
+        if self._snapshot is not None:
+            try:
+                return (self.router.spawn_replica(
+                    wait_ready=False, **self._snapshot), "snapshot")
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                if self._engine_factory is None:
+                    raise
+                print("chainermn_tpu.fleet: snapshot spawn failed "
+                      f"({type(e).__name__}: {e}); falling back to "
+                      "engine_factory", file=sys.stderr, flush=True)
+                return (self.router.spawn_replica(
+                    engine=self._engine_factory(), wait_ready=False),
+                    "factory_fallback")
+        return (self.router.spawn_replica(
+            engine=self._engine_factory(), wait_ready=False), "factory")
+
     def _scale_up(self, now: float, s: dict, summary: dict) -> None:
-        replica = self.router.spawn_replica(
-            engine=self._engine_factory(), wait_ready=False)
+        replica, source = self._spawn_scaled_replica()
         self._last_scale = now
         self._pressure_since = None
         self._target = min(s["accepting"] + 1,
@@ -441,13 +506,13 @@ class FleetController:
         tt = self._top_tenant()
         tenant_kw = {} if tt is None else {"top_tenant": tt}
         action = {"action": "scale_up", "t": now,
-                  "replica": replica.replica_id,
+                  "replica": replica.replica_id, "source": source,
                   "signals": list(s["pressure"]),
                   "queue_per_replica": round(s["queue_per_replica"], 3),
                   "capacity": s["accepting"], **tenant_kw}
         summary["actions"].append(action)
         self._events.emit("controller_scale_up",
-                          replica=replica.replica_id,
+                          replica=replica.replica_id, source=source,
                           signals=list(s["pressure"]),
                           queue_per_replica=round(
                               s["queue_per_replica"], 3),
@@ -489,6 +554,15 @@ class FleetController:
             if not replica.ready.is_set():
                 continue
             self._pending_sync.remove(replica)
+            if (self.brownout is not None and self.brownout.level > 0):
+                # the capacity brownout was standing in for has arrived:
+                # unwind the whole ladder, not one step at a time
+                prev = self.brownout.level
+                self.brownout.relieve(now=summary["now"])
+                summary["actions"].append(
+                    {"action": "brownout", "direction": "relieve",
+                     "t": summary["now"], "level": self.brownout.level,
+                     "prev": prev, "replica": replica.replica_id})
             if not replica.accepting or self._params_current is None:
                 continue
             self.router.publish(self._params_current,
@@ -707,6 +781,8 @@ class FleetController:
                        if self.canary is not None else None),
             "rebalance": (dict(asdict(self.rebalance), weights=weights)
                           if self.rebalance is not None else None),
+            "brownout": (self.brownout.to_json()
+                         if self.brownout is not None else None),
             "versions": {
                 "current": {"version": cur.version, "source": cur.source,
                             "step": cur.step},

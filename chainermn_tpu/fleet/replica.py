@@ -121,7 +121,8 @@ class EngineReplica:
                  retry=None,
                  on_failure: Optional[Callable] = None,
                  labels: Optional[dict] = None,
-                 autostart: bool = True) -> None:
+                 autostart: bool = True,
+                 fair=None, tenant_weights=None, brownout=None) -> None:
         from chainermn_tpu.serving.metrics import ServingMetrics
         from chainermn_tpu.serving.scheduler import FCFSScheduler
 
@@ -132,7 +133,8 @@ class EngineReplica:
         # (in-flight still errors loudly inside the scheduler first)
         self.scheduler = FCFSScheduler(
             engine, eos_id=eos_id, metrics=self.metrics, retry=retry,
-            restart_on_error=False)
+            restart_on_error=False, fair=fair,
+            tenant_weights=tenant_weights, brownout=brownout)
         self.max_restarts = int(max_restarts)
         self.restarts = 0
         self._idle_wait_s = idle_wait_s
@@ -191,7 +193,8 @@ class EngineReplica:
             self._thread.start()
 
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
-               stream_cb=None, deadline_s=None, tenant: str = "default"):
+               stream_cb=None, deadline_s=None, tenant: str = "default",
+               priority: str = "interactive"):
         """Enqueue onto this replica's scheduler (thread-safe) and wake
         the drive loop. The router owns the routing decision; this is
         mechanism only."""
@@ -203,7 +206,7 @@ class EngineReplica:
         req = self.scheduler.submit(prompt, max_new_tokens, rng=rng,
                                     stream_cb=stream_cb,
                                     deadline_s=deadline_s,
-                                    tenant=tenant)
+                                    tenant=tenant, priority=priority)
         self._work.set()
         return req
 

@@ -70,7 +70,12 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from chainermn_tpu.analysis import sanitizer
-from chainermn_tpu.fleet.replica import EngineReplica, ReplicaState
+from chainermn_tpu.fleet.overload import RetryBudget, TenantBreaker
+from chainermn_tpu.fleet.replica import (
+    EngineReplica,
+    ReplicaKilled,
+    ReplicaState,
+)
 from chainermn_tpu.fleet.routing import (
     FleetTrie,
     RouteDecision,
@@ -102,7 +107,8 @@ class FleetRequest:
 
     def __init__(self, router: "FleetRouter", fid: int, prompt,
                  max_new_tokens: int, rng, stream_cb, deadline_s,
-                 tenant: str = "default") -> None:
+                 tenant: str = "default",
+                 priority: str = "interactive") -> None:
         self._router = router
         self.id = fid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -113,6 +119,9 @@ class FleetRequest:
         # cost-attribution label: survives re-routes with the handle, so
         # a replayed binding bills the same tenant on the new replica
         self.tenant = str(tenant)
+        # admission class: survives re-routes the same way, so a
+        # replayed batch request stays batch on the new replica
+        self.priority = str(priority)
         self.t_submit = time.perf_counter()
         self.t_deadline = (self.t_submit + float(deadline_s)
                            if deadline_s is not None else None)
@@ -128,6 +137,13 @@ class FleetRequest:
     @property
     def finished(self) -> bool:
         return self._terminal.is_set()
+
+    @property
+    def retry_after_s(self) -> Optional[float]:
+        """The structured backpressure hint riding a shed/rejected
+        request's stored error (``QueueFullError`` / deadline shed), or
+        None — a well-behaved client waits this long before retrying."""
+        return getattr(self.error, "retry_after_s", None)
 
     @property
     def state(self):
@@ -222,7 +238,10 @@ class FleetRouter:
                  max_reroutes: Optional[int] = None,
                  policy: Optional[RoutingPolicy] = None,
                  retry=None, idle_wait_s: float = 0.02,
-                 autostart: bool = True) -> None:
+                 autostart: bool = True,
+                 retry_budget: Optional[RetryBudget] = None,
+                 breaker: Optional[TenantBreaker] = None,
+                 fair=None, tenant_weights=None, brownout=None) -> None:
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if max_queue is not None and max_queue < 1:
@@ -261,7 +280,12 @@ class FleetRouter:
         # replicas added later (spawn_replica) are built with the same
         # configuration as the constructor's set
         self._replica_cfg = dict(eos_id=eos_id, max_restarts=max_restarts,
-                                 retry=retry, idle_wait_s=idle_wait_s)
+                                 retry=retry, idle_wait_s=idle_wait_s,
+                                 fair=fair, tenant_weights=tenant_weights,
+                                 brownout=brownout)
+        # fleet-edge overload guards (None = feature off, zero overhead)
+        self.retry_budget = retry_budget
+        self.breaker = breaker
         self._labels = labels
         # replicas currently inside a publish fence: routing steers new
         # work away from them (unless nothing else is healthy)
@@ -384,13 +408,51 @@ class FleetRouter:
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
                stream_cb: Optional[Callable[[int], None]] = None,
                deadline_s: Optional[float] = None,
-               tenant: str = "default") -> FleetRequest:
+               tenant: str = "default", priority: str = "interactive",
+               retrying: bool = False) -> FleetRequest:
         """Route and enqueue one request; returns immediately. Raises
         ``QueueFullError`` when the fleet-wide queue bound is hit
-        (counted as a fleet shed) and ``RuntimeError`` when no replica
-        is accepting work."""
+        (counted as a fleet shed, ``retry_after_s`` hint attached), when
+        ``tenant``'s circuit breaker is open, or when ``retrying=True``
+        and the tenant's retry budget is dry; ``RuntimeError`` when no
+        replica is accepting work. ``retrying`` is the client's honesty
+        bit — mark resubmissions of previously-shed work so the budget
+        can bound retry-storm amplification at the edge."""
+        from chainermn_tpu.resilience.cutpoints import FLEET_BREAKER
+        from chainermn_tpu.resilience.faults import inject
         from chainermn_tpu.serving.scheduler import QueueFullError
 
+        tenant = str(tenant)
+        if self.breaker is not None or self.retry_budget is not None:
+            # chaos boundary: a fault armed here fails CLOSED — the one
+            # probed submission is refused, the fleet itself unharmed
+            try:
+                inject(FLEET_BREAKER, tenant=tenant, retrying=retrying)
+            except Exception as e:
+                self._c_shed.inc()
+                self._events.emit("fleet_shed", reason="breaker_fault",
+                                  tenant=tenant)
+                raise QueueFullError(
+                    f"tenant {tenant} refused at breaker cut-point: {e}",
+                    retry_after_s=0.1) from e
+        if self.breaker is not None and self.breaker.is_open(tenant):
+            hint = self.breaker.retry_after(tenant) or self.breaker.open_s
+            self._c_shed.inc()
+            self._events.emit("fleet_shed", reason="breaker_open",
+                              tenant=tenant, retry_after_s=hint)
+            raise QueueFullError(
+                f"tenant {tenant} circuit breaker is open "
+                f"(sustained shed rate); retry after {hint}s",
+                retry_after_s=hint)
+        if (retrying and self.retry_budget is not None
+                and not self.retry_budget.allow(tenant)):
+            self._c_shed.inc()
+            self._events.emit("fleet_shed", reason="retry_budget",
+                              tenant=tenant)
+            raise QueueFullError(
+                f"tenant {tenant} retry budget exhausted; back off",
+                retry_after_s=round(1.0 / max(
+                    self.retry_budget.rate_per_s, 1e-6), 3))
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         with self._lock:
@@ -401,17 +463,23 @@ class FleetRouter:
                 raise RuntimeError(
                     "no replica accepting work (all quarantined/stopped)")
             if self._policy.overloaded(snaps, self.max_queue):
+                depth = sum(s.queue_depth for s in snaps)
+                hint = round(0.05 + 0.01 * depth, 3)
                 self._c_shed.inc()
                 self._events.emit(
                     "fleet_shed", reason="queue_full",
-                    queue_depth=sum(s.queue_depth for s in snaps))
+                    queue_depth=depth, tenant=tenant)
+                if self.breaker is not None:
+                    self.breaker.record_shed(tenant)
                 raise QueueFullError(
                     f"fleet admission queue full ({self.max_queue} queued "
-                    f"across {self.capacity} replicas); retry later"
+                    f"across {self.capacity} replicas); retry later",
+                    retry_after_s=hint,
                 )
             fid = next(self._ids)
             fr = FleetRequest(self, fid, prompt, max_new_tokens, rng,
-                              stream_cb, deadline_s, tenant=tenant)
+                              stream_cb, deadline_s, tenant=tenant,
+                              priority=priority)
             t0 = time.perf_counter()
             decision = self._route_locked(fr.prompt, snaps)
             self._bind_locked(fr, decision, t0)
@@ -422,11 +490,13 @@ class FleetRouter:
     def generate(self, prompt, max_new_tokens: int, *, rng=None,
                  timeout: Optional[float] = None,
                  deadline_s: Optional[float] = None,
-                 tenant: str = "default") -> np.ndarray:
+                 tenant: str = "default",
+                 priority: str = "interactive") -> np.ndarray:
         """Blocking single-request decode through the fleet — the
         ``ServingClient.generate`` shape."""
         fr = self.submit(prompt, max_new_tokens, rng=rng,
-                         deadline_s=deadline_s, tenant=tenant)
+                         deadline_s=deadline_s, tenant=tenant,
+                         priority=priority)
         if not fr.wait(timeout):
             self.cancel(fr)
             raise TimeoutError(
@@ -527,7 +597,7 @@ class FleetRouter:
             remaining = fr.t_deadline - time.perf_counter()
         inner = replica.submit(fr.prompt, fr.max_new_tokens, rng=fr.rng,
                                stream_cb=relay, deadline_s=remaining,
-                               tenant=fr.tenant)
+                               tenant=fr.tenant, priority=fr.priority)
         t1 = time.perf_counter()
         inner.trace.add_span("route", t0, t1, replica=decision.replica_id,
                              affinity="hit" if decision.affinity_hit
@@ -586,6 +656,8 @@ class FleetRouter:
                 return
             st = inner.state
             if st is RequestState.DONE:
+                if self.breaker is not None:
+                    self.breaker.record_ok(fr.tenant)
                 self._finalize_locked(fr, st, None)
                 return
             if st is RequestState.CANCELLED:
@@ -599,6 +671,8 @@ class FleetRouter:
                 # IS the fleet verdict (PR 3 semantics pass through)
                 if isinstance(err, DeadlineExceededError):
                     self._c_shed.inc()
+                    if self.breaker is not None:
+                        self.breaker.record_shed(fr.tenant)
                 self._finalize_locked(fr, st, err)
                 return
             # engine failure: replay on a healthy replica if budgets allow
@@ -906,10 +980,19 @@ class FleetRouter:
             replicas.get(str(rid), {})["admission_weight"] = w
         health = hm.report() if hm is not None else None
         control = ctrl.report() if ctrl is not None else None
+        overload = None
+        if self.retry_budget is not None or self.breaker is not None:
+            overload = {
+                "retry_budget": (self.retry_budget.to_json()
+                                 if self.retry_budget is not None else None),
+                "breaker": (self.breaker.to_json()
+                            if self.breaker is not None else None),
+            }
         return {
             "health": health,
             "control": control,
             "costs": costs,
+            "overload": overload,
             "replicas": replicas,
             "capacity": self.capacity,
             "n_replicas": len(self.replicas),

@@ -70,6 +70,11 @@ METRIC_NAMES = frozenset({
     "serving_requests_submitted_total",
     "serving_scheduler_restarts_total",
     "serving_slot_occupancy",
+    # overload robustness (priority classes + fairness + brownout)
+    "brownout_level",
+    "serving_class_preemptions_total",
+    "serving_class_queue_depth",
+    "serving_tenant_sheds_total",
     "serving_tokens_total",
     "serving_tpot_seconds",
     "serving_ttft_seconds",
@@ -89,6 +94,9 @@ METRIC_NAMES = frozenset({
     "fleet_reroutes_total",
     "fleet_route_fallbacks_total",
     "fleet_shed_total",
+    # fleet edge overload protection (retry budgets + circuit breaker)
+    "fleet_breaker_state",
+    "fleet_retry_denied_total",
     # control plane (autoscaler + canary deploys + rebalancing)
     "canary_deploys_total",
     "canary_promotes_total",
@@ -160,6 +168,9 @@ EVENT_KINDS = frozenset({
     "submit",
     "swap_fence",
     # fleet / deploy
+    "breaker_close",
+    "breaker_open",
+    "brownout_step",
     "fleet_publish",
     "fleet_replica_error",
     "fleet_replica_quarantine",

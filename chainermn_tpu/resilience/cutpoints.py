@@ -40,6 +40,7 @@ COMM_ALLGATHER_OBJ = "comm.allgather_obj"
 # -- serving -------------------------------------------------------------- #
 SERVING_PREFILL = "serving.prefill"
 SERVING_PREFILL_BATCH = "serving.prefill_batch"
+SERVING_ADMIT_FAIR = "serving.admit_fair"
 SERVING_DECODE = "serving.decode"
 SERVING_KV_APPEND = "serving.kv_append"
 SERVING_PREFIX_COPY = "serving.prefix_copy"
@@ -48,6 +49,7 @@ SERVING_SPEC_VERIFY = "serving.spec_verify"
 # -- fleet / deploy ------------------------------------------------------- #
 FLEET_ROUTE = "fleet.route"
 FLEET_REPLICA = "fleet.replica"
+FLEET_BREAKER = "fleet.breaker"
 DEPLOY_PUBLISH = "deploy.publish"
 DEPLOY_RESHARD = "deploy.reshard"
 
@@ -74,12 +76,14 @@ ALL_CUTPOINTS = (
     COMM_ALLGATHER_OBJ,
     SERVING_PREFILL,
     SERVING_PREFILL_BATCH,
+    SERVING_ADMIT_FAIR,
     SERVING_DECODE,
     SERVING_KV_APPEND,
     SERVING_PREFIX_COPY,
     SERVING_SPEC_VERIFY,
     FLEET_ROUTE,
     FLEET_REPLICA,
+    FLEET_BREAKER,
     DEPLOY_PUBLISH,
     DEPLOY_RESHARD,
 )
@@ -94,10 +98,12 @@ __all__ = [
     "DEPLOY_PUBLISH",
     "DEPLOY_RESHARD",
     "DYNAMIC_PREFIXES",
+    "FLEET_BREAKER",
     "FLEET_REPLICA",
     "FLEET_ROUTE",
     "OBJSTORE_GET",
     "OBJSTORE_PUT",
+    "SERVING_ADMIT_FAIR",
     "SERVING_DECODE",
     "SERVING_KV_APPEND",
     "SERVING_PREFILL",

@@ -50,6 +50,10 @@ from chainermn_tpu.serving.engine import (
     EngineStateError,
     ServingEngine,
 )
+from chainermn_tpu.serving.fairness import (
+    BrownoutPolicy,
+    FairAdmission,
+)
 from chainermn_tpu.serving.metrics import ServingMetrics
 from chainermn_tpu.serving.prefix_cache import (
     BlockPool,
@@ -69,10 +73,12 @@ from chainermn_tpu.serving.speculative import SpeculativeConfig
 __all__ = [
     "AdmitPlan",
     "BlockPool",
+    "BrownoutPolicy",
     "DeadlineExceededError",
     "EngineFailed",
     "EngineStateError",
     "FCFSScheduler",
+    "FairAdmission",
     "PrefixCacheIndex",
     "PrefixMatch",
     "QueueFullError",

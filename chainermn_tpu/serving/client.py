@@ -44,12 +44,14 @@ class ServingClient:
                  max_queue: Optional[int] = None,
                  default_deadline_s: Optional[float] = None,
                  retry=None, restart_on_error: bool = True,
-                 max_restarts: int = 8) -> None:
+                 max_restarts: int = 8, fair=None, tenant_weights=None,
+                 brownout=None) -> None:
         self.engine = engine
         self.scheduler = FCFSScheduler(
             engine, eos_id=eos_id, max_queue=max_queue,
             default_deadline_s=default_deadline_s, retry=retry,
-            restart_on_error=restart_on_error, max_restarts=max_restarts)
+            restart_on_error=restart_on_error, max_restarts=max_restarts,
+            fair=fair, tenant_weights=tenant_weights, brownout=brownout)
         self.metrics = self.scheduler.metrics
         self._work = threading.Event()
         self._stop = threading.Event()
@@ -66,14 +68,17 @@ class ServingClient:
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
                stream_cb: Optional[Callable[[int], None]] = None,
                deadline_s: Optional[float] = None,
-               tenant: str = "default") -> Request:
+               tenant: str = "default",
+               priority: str = "interactive") -> Request:
         """Enqueue a request; returns immediately. ``stream_cb`` (if set)
         is invoked from the engine thread once per generated token.
         ``tenant`` labels the request for the cost ledger's per-tenant
-        attribution; it never affects scheduling. Raises
-        ``QueueFullError`` in the calling thread when the bounded
-        admission queue (``max_queue``) is at capacity — backpressure is
-        the submitter's signal, not a queued request's problem."""
+        attribution (and, with fair admission on, keys its DRR budget);
+        ``priority`` picks the admission class (``"interactive"`` /
+        ``"batch"``). Raises ``QueueFullError`` in the calling thread
+        when the bounded admission queue (``max_queue``) is at capacity
+        — backpressure is the submitter's signal, not a queued request's
+        problem; its ``retry_after_s`` is the structured wait hint."""
         if self._failure is not None:
             raise RuntimeError("serving engine failed") from self._failure
         if self._stop.is_set():
@@ -81,21 +86,23 @@ class ServingClient:
         req = self.scheduler.submit(prompt, max_new_tokens, rng=rng,
                                     stream_cb=stream_cb,
                                     deadline_s=deadline_s,
-                                    tenant=tenant)
+                                    tenant=tenant, priority=priority)
         self._work.set()
         return req
 
     def generate(self, prompt, max_new_tokens: int, *, rng=None,
                  timeout: Optional[float] = None,
                  deadline_s: Optional[float] = None,
-                 tenant: str = "default") -> np.ndarray:
+                 tenant: str = "default",
+                 priority: str = "interactive") -> np.ndarray:
         """Blocking single-request decode: ``prompt + generated`` tokens,
         the :func:`chainermn_tpu.models.generate`-shaped result. A shed
         or engine-failed (ERRORED) request re-raises its stored exception
         here, in the caller's thread — degradation is loud, never a
-        silent hang."""
+        silent hang (a shed's ``retry_after_s`` rides the exception)."""
         req = self.submit(prompt, max_new_tokens, rng=rng,
-                          deadline_s=deadline_s, tenant=tenant)
+                          deadline_s=deadline_s, tenant=tenant,
+                          priority=priority)
         if not req.wait(timeout):
             self.cancel(req)
             raise TimeoutError(

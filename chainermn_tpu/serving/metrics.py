@@ -108,6 +108,19 @@ class ServingMetrics:
         self._c_spec_accepted = reg.counter(
             "spec_tokens_accepted_total", labels)
         self._h_spec_accept = reg.histogram("spec_accept_length", labels)
+        # overload robustness (PR 18): per-class queue depth (the
+        # batch-behind-interactive split), class-labelled preemptions
+        # (did batch really evict first?), and per-tenant brownout sheds
+        self._g_class_queue = {
+            cls: reg.gauge("serving_class_queue_depth",
+                           dict(labels, priority=cls))
+            for cls in ("interactive", "batch")
+        }
+        self._c_class_preempt = {
+            cls: reg.counter("serving_class_preemptions_total",
+                             dict(labels, priority=cls))
+            for cls in ("interactive", "batch")
+        }
         self._t_first_token: Optional[float] = None
         self._t_last_token: Optional[float] = None
         # EWMA TTFT (alpha=0.2): the routing layer's cheap "how slow is
@@ -183,10 +196,22 @@ class ServingMetrics:
         self._g_kv_used.set(in_use)
         self._g_kv_free.set(free)
 
-    def record_preemption(self) -> None:
+    def record_preemption(self, priority: Optional[str] = None) -> None:
         """A decoding request was evicted back to the queue (block pool
-        dry, or an injected ``serving.kv_append`` fault contained)."""
+        dry, or an injected ``serving.kv_append`` fault contained).
+        ``priority`` feeds the per-class split — the batch-preempts-
+        first contract is asserted against these counters."""
         self._c_preempt.inc()
+        if priority in self._c_class_preempt:
+            self._c_class_preempt[priority].inc()
+
+    def record_tenant_shed(self, tenant: str) -> None:
+        """A brownout L4 shed dropped one of ``tenant``'s queued
+        requests (lazily-created per-tenant counter, same pattern as the
+        ``trace_phase_seconds`` labelled histograms)."""
+        self._registry.counter(
+            "serving_tenant_sheds_total",
+            dict(self._labels, tenant=str(tenant))).inc()
 
     def record_request_blocks(self, n_blocks: int) -> None:
         """Store blocks a retiring request's table referenced."""
@@ -220,11 +245,14 @@ class ServingMetrics:
                 or total > self._worst_trace.get("total_s", 0.0)):
             self._worst_trace = dict(breakdown, req=req_id)
 
-    def record_step(self, queue_depth: int, active_slots: int) -> None:
+    def record_step(self, queue_depth: int, active_slots: int,
+                    batch_depth: int = 0) -> None:
         self._h_queue.observe(queue_depth)
         self._h_occ.observe(active_slots / self.n_slots)
         self._g_queue.set(queue_depth)
         self._g_active.set(active_slots)
+        self._g_class_queue["batch"].set(batch_depth)
+        self._g_class_queue["interactive"].set(queue_depth - batch_depth)
 
     def _record_token_time(self, t: float) -> None:
         if self._t_first_token is None:

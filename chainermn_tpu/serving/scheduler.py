@@ -98,17 +98,40 @@ from chainermn_tpu.monitor import annotate
 from chainermn_tpu.monitor._state import get_event_log
 from chainermn_tpu.monitor.costs import CostLedger
 from chainermn_tpu.monitor.trace import NULL_TRACE, get_tracer
+from chainermn_tpu.resilience.cutpoints import SERVING_ADMIT_FAIR
+from chainermn_tpu.resilience.faults import inject
 from chainermn_tpu.resilience.retry import RetryPolicy
 from chainermn_tpu.serving.engine import EngineStateError
+from chainermn_tpu.serving.fairness import (
+    BrownoutPolicy,
+    FairAdmission,
+    PRIORITY_CLASSES,
+)
 from chainermn_tpu.serving.metrics import ServingMetrics
 
 
 class QueueFullError(RuntimeError):
-    """Submission rejected: the bounded admission queue is at capacity."""
+    """Submission rejected: the bounded admission queue is at capacity.
+
+    ``retry_after_s`` is the machine-readable backpressure hint (scaled
+    by queue depth at rejection time) a well-behaved client should wait
+    before retrying — the fleet edge surfaces it end to end."""
+
+    def __init__(self, msg: str = "", *,
+                 retry_after_s: Optional[float] = None) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class DeadlineExceededError(TimeoutError):
-    """The request was still queued past its deadline and was shed."""
+    """The request spent its deadline queued (or decoding) and was shed.
+    Carries the same structured ``retry_after_s`` hint as
+    :class:`QueueFullError`."""
+
+    def __init__(self, msg: str = "", *,
+                 retry_after_s: Optional[float] = None) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class RequestState(enum.Enum):
@@ -125,19 +148,29 @@ class EngineFailed(RuntimeError):
     original engine exception is the ``__cause__``)."""
 
 
-@dataclass
+@dataclass(eq=False)
 class Request:
     """One inference request and its full lifecycle state. Created by
     :meth:`FCFSScheduler.submit`; treat as read-only outside the scheduler
-    (``wait()``/``output``/``stream()`` are the consumer surface)."""
+    (``wait()``/``output``/``stream()`` are the consumer surface).
+
+    ``eq=False``: requests compare by identity. The generated
+    field-wise ``__eq__`` would compare ndarray prompts (ambiguous
+    truth value) the moment ``deque.remove`` / ``in`` walks past a
+    same-shape neighbor — fair admission removes mid-queue elements, so
+    identity semantics are load-bearing, not just faster."""
 
     prompt: np.ndarray
     max_new_tokens: int
     rng: object = None                 # per-request PRNG key (solo-parity)
     stream_cb: Optional[Callable[[int], None]] = None
     # cost-attribution label (PR 17): rides the request end to end and
-    # keys the ledger's per-tenant aggregates; never affects scheduling
+    # keys the ledger's per-tenant aggregates; with fair admission on it
+    # also keys the DRR budget this request draws from
     tenant: str = "default"
+    # admission class (PR 18): "interactive" admits first and is
+    # preempted last; "batch" only admits once interactive is drained
+    priority: str = "interactive"
     id: int = -1
     state: RequestState = RequestState.QUEUED
     slot: int = -1
@@ -265,7 +298,9 @@ class FCFSScheduler:
                  restart_on_error: bool = True,
                  max_restarts: int = 8,
                  max_prefills_per_step: Optional[int] = None,
-                 tracer=None, cost_accounting: bool = True) -> None:
+                 tracer=None, cost_accounting: bool = True,
+                 fair=None, tenant_weights=None,
+                 brownout: Optional[BrownoutPolicy] = None) -> None:
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.engine = engine
@@ -302,6 +337,21 @@ class FCFSScheduler:
         # sampling (and forced retention on shed/error) decides what the
         # ring keeps. NULL_TRACE when tracing is disabled.
         self._tracer = tracer if tracer is not None else get_tracer()
+        # weighted-fair admission (PR 18): OFF by default — plain FIFO,
+        # exactly as before. ``fair=True`` (or passing tenant_weights)
+        # turns on class-ordered weighted-DRR selection; an existing
+        # FairAdmission instance is accepted for sharing/inspection.
+        if fair is None:
+            fair = tenant_weights is not None
+        if isinstance(fair, FairAdmission):
+            self._fair: Optional[FairAdmission] = fair
+        elif fair:
+            self._fair = FairAdmission(tenant_weights=tenant_weights)
+        else:
+            self._fair = None
+        # brownout ladder (PR 18): consulted every step when present —
+        # pauses batch, forces single-token decode, caps max_new, sheds
+        self._brownout = brownout
         self._lock = sanitizer.make_lock("FCFSScheduler._lock")
         # sanitizer-guarded: mutating either without _lock held raises
         # when the runtime sanitizer is on (lock-discipline, enforced)
@@ -319,16 +369,21 @@ class FCFSScheduler:
     def submit(self, prompt, max_new_tokens: int, *, rng=None,
                stream_cb: Optional[Callable[[int], None]] = None,
                deadline_s: Optional[float] = None,
-               tenant: str = "default") -> Request:
+               tenant: str = "default",
+               priority: str = "interactive") -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.engine.validate_request(len(prompt), max_new_tokens)
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"priority must be one of {PRIORITY_CLASSES}, "
+                f"got {priority!r}")
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             rng=rng if rng is not None else jax.random.PRNGKey(0),
             stream_cb=stream_cb, deadline_s=deadline_s,
-            tenant=str(tenant),
+            tenant=str(tenant), priority=str(priority),
         )
         req.t_submit = time.perf_counter()
         req._t_enqueue = req.t_submit
@@ -342,7 +397,8 @@ class FCFSScheduler:
                                   queue_depth=len(self._queue))
                 raise QueueFullError(
                     f"admission queue full ({self.max_queue} queued); "
-                    "retry later or raise max_queue"
+                    "retry later or raise max_queue",
+                    retry_after_s=self._retry_after_locked(),
                 )
             req.id = next(self._ids)
             self._queue.append(req)
@@ -487,6 +543,7 @@ class FCFSScheduler:
         decode step, so a retirement's slot never sits idle for a step."""
         emitted = 0
         self._shed_expired()
+        self._policy_tick()
         # 0. version fence: while a swap is pending, admissions pause so
         # every in-flight request finishes on the weights it started
         # with; once the pool drains the swap runs HERE, between device
@@ -524,9 +581,19 @@ class FCFSScheduler:
         # GIL-atomic snapshot for cost attribution (same contract as
         # _flight_ctx): who occupied which slot when the decode launched
         rows_snapshot = list(self._by_slot.items())  # graftlint: unguarded-ok
+        # brownout L2: bypass decode_window / speculative rounds and run
+        # the always-warmed single-token decode step — less work per
+        # call, zero recompiles (warmup traces _decode_fn regardless)
+        force_single = (self._brownout is not None
+                        and self._brownout.force_single_token)
         t_dec0 = time.perf_counter()
         try:
-            decoded = self.engine.decode_round(ctx=self._flight_ctx())
+            if force_single:
+                decoded = {
+                    slot: [tok] for slot, tok in
+                    self.engine.decode_step(ctx=self._flight_ctx()).items()}
+            else:
+                decoded = self.engine.decode_round(ctx=self._flight_ctx())
         except Exception as e:  # noqa: BLE001 — degradation boundary
             if not self._engine_failure(e):
                 raise
@@ -535,9 +602,12 @@ class FCFSScheduler:
         if self.costs is not None and rows_snapshot and decoded:
             # split the shared decode call across the n_slots rows the
             # compiled program actually ran; slots with no request book
-            # as `idle`, rejected speculative drafts as `wasted`
+            # as `idle`, rejected speculative drafts as `wasted`.
+            # Under brownout L2 the speculative window never ran, so the
+            # (stale) last_spec_slots must not attribute draft cost here.
             spec_info = (self.engine.last_spec_slots
-                         if getattr(self.engine, "spec_enabled", False)
+                         if (not force_single
+                             and getattr(self.engine, "spec_enabled", False))
                          else {})
             rows = []
             for slot, req in rows_snapshot:
@@ -587,7 +657,12 @@ class FCFSScheduler:
         # delivered (off the TTFT path) and before the next step can
         # reuse a donor slot
         self.engine.flush_inserts()
-        self.metrics.record_step(self.queue_depth, self.engine.active_slots)
+        with self._lock:
+            depth = len(self._queue)
+            batch_depth = sum(1 for r in self._queue
+                              if r.priority == "batch")
+        self.metrics.record_step(depth, self.engine.active_slots,
+                                 batch_depth=batch_depth)
         if getattr(self.engine, "paged", False):
             self.metrics.record_kv_pool(*self.engine.kv_pool_stats())
         if self.costs is not None:
@@ -623,11 +698,19 @@ class FCFSScheduler:
         paged = getattr(eng, "paged", False)
         cap = min(eng.prefill_batch, len(eng.free_slots))
         with self._lock:
-            if not self._queue:
-                return []
-            head = self._queue.popleft()
-            head.state = RequestState.PREFILL
+            head = self._pop_head_locked()
+        if head is None:
+            return []
         self._span_to_admit(head)
+        # chaos boundary: an injected fault at the fair-admit pick fails
+        # ONLY the picked request (terminal ERRORED, no stranded waiter)
+        # — every decoding slot keeps decoding, the queue keeps serving
+        try:
+            inject(SERVING_ADMIT_FAIR, req=head.id, tenant=head.tenant,
+                   priority=head.priority)
+        except Exception as e:  # noqa: BLE001 — containment boundary
+            self._fail_group([head], e)
+            return []
         plan = eng.plan_admission(head.prompt, head.rng,
                                   max_new=head.max_new_tokens)
         # block-budget admission (paged): admit only what free + evictable
@@ -650,6 +733,11 @@ class FCFSScheduler:
             candidates = list(self._queue)
         scored = []
         for idx, req in enumerate(candidates):
+            # companions ride the head's class: a batch request must not
+            # slip into an interactive group (it would dodge both the
+            # batch-after-interactive gate and brownout's batch pause)
+            if req.priority != head.priority:
+                continue
             p = eng.plan_admission(req.prompt, req.rng,
                                    max_new=req.max_new_tokens)
             if p.bucket != plan.bucket:
@@ -677,6 +765,32 @@ class FCFSScheduler:
             else:
                 eng.cancel_plan(p)
         return group
+
+    def _pop_head_locked(self) -> Optional[Request]:
+        """Pick + remove the next admission candidate (lock held by the
+        caller). Plain FIFO ``popleft`` by default — byte-identical to
+        the pre-fairness scheduler; with fair admission on, the
+        class-ordered weighted-DRR policy picks instead. Brownout L1
+        holds the ``batch`` class back on both paths."""
+        if not self._queue:
+            return None
+        allow_batch = not (self._brownout is not None
+                           and self._brownout.pause_batch)
+        if self._fair is not None:
+            head = self._fair.select(self._queue, allow_batch=allow_batch)
+            if head is None:
+                return None
+            self._queue.remove(head)
+        elif allow_batch:
+            head = self._queue.popleft()
+        else:
+            head = next((r for r in self._queue
+                         if r.priority != "batch"), None)
+            if head is None:
+                return None
+            self._queue.remove(head)
+        head.state = RequestState.PREFILL
+        return head
 
     def _defer_admission(self, req: Request, plan, need: int,
                          available: int) -> None:
@@ -901,10 +1015,22 @@ class FCFSScheduler:
                     # re-check: a multi-token round (speculative window /
                     # decode_window) can span MORE than one new block
                     continue
-                victim = max(self._by_slot.values(), key=lambda r: r.id)
+                victim = max(self._by_slot.values(), key=self._preempt_key)
                 self._preempt(victim, reason="kv_pool_dry")
                 if victim is req:
                     break   # we were the lowest priority ourselves
+
+    def _preempt_key(self, req: Request) -> tuple:
+        """Victim ordering when blocks run dry (max = evicted first):
+        ``batch`` before any ``interactive``, then the tenant with the
+        largest measured device-second share (the noisy neighbor pays
+        first), then recency (highest id) — (class, overshare, recency).
+        Without fair admission the share term is 0 and this reduces to
+        (class, recency); without classes it is exactly the old
+        newest-first rule."""
+        share = (self._fair.tenant_share(req.tenant)
+                 if self._fair is not None else 0.0)
+        return (req.priority == "batch", share, req.id)
 
     def _preempt(self, req: Request, reason: str) -> None:
         """Evict a decoding request back to QUEUED: its slot and blocks
@@ -937,13 +1063,14 @@ class FCFSScheduler:
             else:
                 idx = len(self._queue)
             self._queue.insert(idx, req)
-        self.metrics.record_preemption()
+        self.metrics.record_preemption(priority=req.priority)
         req._t_enqueue = time.perf_counter()
         if req._span_admit is not None:
             req.trace.end_span(req._span_admit)
             req._span_admit = None
         req._span_queue = req.trace.start_span("queue")
         self._events.emit("kv_preempt", req=req.id, reason=reason,
+                          priority=req.priority, tenant=req.tenant,
                           queue_depth=self.queue_depth,
                           **self._trace_label(req))
 
@@ -952,29 +1079,53 @@ class FCFSScheduler:
     # ------------------------------------------------------------------ #
 
     def _shed_expired(self) -> None:
-        """Fail QUEUED requests past their deadline (terminal ERRORED with
+        """Fail requests past their deadline (terminal ERRORED with
         DeadlineExceededError stored) — work that can no longer meet its
-        deadline must not consume a slot another request could use."""
+        deadline must not consume a slot another request could use. Both
+        sides are swept: QUEUED requests are dropped from the queue, and
+        a DECODING request past its deadline is retired at this step
+        boundary with its slot + blocks freed — before this fix it kept
+        burning device time to finish an answer nobody would read. The
+        retirement happens strictly BETWEEN engine steps, so surviving
+        slots' token streams (and replay parity) are untouched."""
         now = time.perf_counter()
         expired: list[Request] = []
+        decode_expired: list[Request] = []
         with self._lock:
-            if not self._queue:
+            if not self._queue and not self._by_slot:
                 return
-            keep: deque[Request] = deque()
-            for req in self._queue:
-                if req.t_deadline is not None and now >= req.t_deadline:
-                    req.error = DeadlineExceededError(
-                        f"request {req.id} spent its {req.deadline_s}s "
-                        "deadline in the admission queue"
-                    )
-                    req.state = RequestState.ERRORED
-                    self.metrics.record_shed()
-                    expired.append(req)
-                else:
-                    keep.append(req)
-            self._queue = sanitizer.guarded(
-                keep, lock=self._lock, name="FCFSScheduler._queue")
-        for req in expired:
+            hint = self._retry_after_locked()
+            if self._queue:
+                keep: deque[Request] = deque()
+                for req in self._queue:
+                    if req.t_deadline is not None and now >= req.t_deadline:
+                        req.error = DeadlineExceededError(
+                            f"request {req.id} spent its {req.deadline_s}s "
+                            "deadline in the admission queue",
+                            retry_after_s=hint,
+                        )
+                        req.state = RequestState.ERRORED
+                        self.metrics.record_shed()
+                        expired.append(req)
+                    else:
+                        keep.append(req)
+                self._queue = sanitizer.guarded(
+                    keep, lock=self._lock, name="FCFSScheduler._queue")
+            for slot in sorted(self._by_slot):
+                req = self._by_slot[slot]
+                if req.t_deadline is None or now < req.t_deadline:
+                    continue
+                self.engine.release(slot)
+                self._by_slot.pop(slot, None)
+                req.error = DeadlineExceededError(
+                    f"request {req.id} passed its {req.deadline_s}s "
+                    f"deadline after {len(req.tokens)} decoded token(s)",
+                    retry_after_s=hint,
+                )
+                req.state = RequestState.ERRORED
+                self.metrics.record_shed()
+                decode_expired.append(req)
+        for req in expired + decode_expired:
             if self.costs is not None:
                 self.costs.finalize(req.id)
             # deadline-missed traces are retained regardless of sampling
@@ -983,7 +1134,80 @@ class FCFSScheduler:
             req.trace.mark_deadline_miss()
             req.trace.finish(reason="shed")
             self._events.emit("shed", req=req.id,
+                              where=("decode" if req in decode_expired
+                                     else "queue"),
                               waited_s=round(now - req.t_submit, 6),
+                              **self._trace_label(req))
+            req._done.set()
+
+    def _retry_after_locked(self) -> float:
+        """The structured backpressure hint attached to rejections and
+        sheds: scales with queue depth so a deeper backlog pushes
+        retries further out (the fleet edge's retry budget and breaker
+        honor it end to end)."""
+        return round(0.05 + 0.01 * len(self._queue), 3)
+
+    def _policy_tick(self) -> None:
+        """Once per step, before admissions: feed the fair-admission
+        policy the ledger's measured per-tenant device-seconds (the
+        noisy-neighbor weight shrink), let a self-driving brownout
+        policy observe queue pressure, and execute the L4 shed when the
+        ladder is that deep."""
+        if self._fair is not None and self.costs is not None:
+            self._fair.set_shares(self.costs.tenant_device_seconds())
+        bo = self._brownout
+        if bo is None:
+            return
+        # pressure = INTERACTIVE depth only: a paused batch backlog must
+        # not hold the ladder up (L1 pauses batch — counting it would
+        # make the level self-sustaining and the queue never drain)
+        with self._lock:
+            depth = sum(1 for r in self._queue if r.priority != "batch")
+        bo.auto_observe(depth)
+        if bo.shed_lowest:
+            self._brownout_shed()
+
+    def _brownout_shed(self) -> None:
+        """Brownout L4: shed the lowest-effective-weight tenant's QUEUED
+        work with a Retry-After hint (terminal QueueFullError — the
+        client-visible contract is identical to an admission-queue
+        rejection, plus the hint). In-flight work is never touched: the
+        shed frees queue pressure, not slots."""
+        with self._lock:
+            tenants = sorted({r.tenant for r in self._queue})
+        if not tenants:
+            return
+        if self._fair is not None:
+            victim_tenant = self._fair.lowest_weight_tenant(tenants)
+        else:
+            victim_tenant = tenants[0]
+        dropped: list[Request] = []
+        with self._lock:
+            hint = max(self._retry_after_locked(),
+                       float(self._brownout.down_after_s))
+            keep: deque[Request] = deque()
+            for req in self._queue:
+                if req.tenant == victim_tenant:
+                    req.error = QueueFullError(
+                        f"request {req.id} shed by brownout L4 "
+                        f"(tenant {victim_tenant})",
+                        retry_after_s=round(hint, 3),
+                    )
+                    req.state = RequestState.ERRORED
+                    self.metrics.record_shed()
+                    dropped.append(req)
+                else:
+                    keep.append(req)
+            self._queue = sanitizer.guarded(
+                keep, lock=self._lock, name="FCFSScheduler._queue")
+        for req in dropped:
+            if self.costs is not None:
+                self.costs.finalize(req.id)
+            self.metrics.record_tenant_shed(req.tenant)
+            req.trace.finish(reason="shed")
+            self._events.emit("shed", req=req.id, where="brownout",
+                              tenant=req.tenant,
+                              retry_after_s=req.error.retry_after_s,
                               **self._trace_label(req))
             req._done.set()
 
@@ -1048,7 +1272,15 @@ class FCFSScheduler:
             except Exception:
                 pass  # a consumer's callback must not kill the engine loop
         hit_eos = self.eos_id is not None and int(tok) == self.eos_id
-        if hit_eos or len(req.tokens) >= req.max_new_tokens:
+        # brownout L3: the effective max_new ceiling tightens for
+        # in-flight and future requests alike — early retirement yields
+        # a PREFIX of the request's full token stream (determinism kept)
+        limit = req.max_new_tokens
+        if self._brownout is not None:
+            cap = self._brownout.effective_max_new_cap
+            if cap is not None:
+                limit = min(limit, cap)
+        if hit_eos or len(req.tokens) >= limit:
             self._retire(req, "eos" if hit_eos else "length")
 
     def _retire(self, req: Request, reason: str) -> None:

@@ -84,6 +84,18 @@ and promote fleet-wide (or auto-rollback on regression), with the
 controller's decision ring and version history printed at the end and
 served live at ``/control`` with ``--http-port``.
 
+And overload robustness (ISSUE 18): ``--priority mixed`` labels every
+other burst request ``batch`` (``batch`` runs only when the interactive
+queue is drained, and is preempted FIRST when the KV pool runs dry),
+``--tenant-weights "tenant0=4,tenant1=1"`` turns on weighted
+deficit-round-robin admission over the ``--tenants`` labels (weights
+shrink automatically for tenants over their measured device-second
+share), and ``--brownout N`` arms the degradation ladder up to level N —
+sustained interactive backlog steps pause-batch -> single-token decode ->
+max-new cap -> shed-lowest-weight-tenant, each step edge-logged and fully
+reversible once the queue drains; the episode (levels hit, steps, final
+level) prints at the end next to the per-tenant cost table.
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -304,6 +316,30 @@ def main() -> None:
                          "--http-port the /costs endpoint serves the "
                          "same JSON live (1: everything bills to "
                          "'default')")
+    ap.add_argument("--priority", choices=("interactive", "batch", "mixed"),
+                    default="interactive",
+                    help="admission class for the burst's requests "
+                         "(ISSUE 18): 'batch' marks them all "
+                         "best-effort (admitted only when the "
+                         "interactive queue is drained, preempted first "
+                         "when KV runs dry), 'mixed' alternates the two "
+                         "classes request by request")
+    ap.add_argument("--tenant-weights", default="",
+                    help="weighted-fair tenant admission (ISSUE 18): "
+                         "comma-separated 'name=weight' pairs over the "
+                         "--tenants labels (e.g. 'tenant0=4,tenant1=1') "
+                         "— admission runs deficit-round-robin over "
+                         "per-tenant token budgets, and a tenant over "
+                         "its measured device-second share has its "
+                         "effective weight shrunk (empty: FIFO within "
+                         "each class)")
+    ap.add_argument("--brownout", type=int, default=0,
+                    help="arm the brownout degradation ladder up to "
+                         "this level (1: pause batch, 2: +single-token "
+                         "decode, 3: +max-new cap, 4: +shed lowest-"
+                         "weight tenant); sustained interactive backlog "
+                         "steps up, a drained queue steps back down, "
+                         "and the episode prints at the end (0: off)")
     args = ap.parse_args()
 
     comm = chainermn_tpu.create_communicator("tpu") if args.tensor_parallel \
@@ -393,6 +429,27 @@ def main() -> None:
     if args.canary and not args.autoscale:
         raise SystemExit("--canary deploys through the controller; add "
                          "--autoscale")
+    # overload robustness (ISSUE 18): weighted-fair admission + the
+    # brownout ladder ride the same scheduler kwargs in both the
+    # single-engine client and every fleet replica
+    fair_kw = {}
+    if args.tenant_weights:
+        weights = {}
+        for pair in args.tenant_weights.split(","):
+            name, _, w = pair.partition("=")
+            if not w:
+                raise SystemExit(f"--tenant-weights: '{pair}' is not "
+                                 "name=weight")
+            weights[name.strip()] = float(w)
+        fair_kw = dict(fair=True, tenant_weights=weights)
+    brownout_policy = None
+    if args.brownout:
+        from chainermn_tpu.serving.fairness import BrownoutPolicy
+
+        brownout_policy = BrownoutPolicy(
+            max_level=args.brownout, queue_high=float(args.slots),
+            up_after_s=0.05, down_after_s=0.2, cooldown_s=0.1)
+        fair_kw["brownout"] = brownout_policy
     fleet_mode = args.replicas > 1 or args.autoscale
     n_start = (max(args.replicas, args.min_replicas) if args.autoscale
                else args.replicas)
@@ -405,14 +462,16 @@ def main() -> None:
         engine = engines[0]
         front = FleetRouter(engines, eos_id=eos, affinity=args.affinity,
                             max_queue=args.max_queue or None,
-                            default_deadline_s=args.deadline or None)
+                            default_deadline_s=args.deadline or None,
+                            **fair_kw)
         front.wait_ready(600)   # every replica warm, off the burst clock
     else:
         engine = ServingEngine(model, params, **engine_kw)
         engine.warmup()   # every bucket + decode compile once, off the burst
         front = ServingClient(engine, eos_id=eos,
                               max_queue=args.max_queue or None,
-                              default_deadline_s=args.deadline or None)
+                              default_deadline_s=args.deadline or None,
+                              **fair_kw)
 
     collector = None
     if args.health or args.autoscale:
@@ -514,9 +573,13 @@ def main() -> None:
                 .astype(np.int32)])
             n_new = int(rng.randint(1, args.max_new + 1))
             key = jax.random.PRNGKey(100 + i)
+            prio = ("batch" if args.priority == "batch"
+                    or (args.priority == "mixed" and i % 2 == 1)
+                    else "interactive")
             try:
                 h = client.submit(prompt, n_new, rng=key,
-                                  tenant=tenants[i % len(tenants)])
+                                  tenant=tenants[i % len(tenants)],
+                                  priority=prio)
                 handles.append(h)
                 parity_jobs.append((h, prompt, n_new, key))
             except QueueFullError:
@@ -604,6 +667,11 @@ def main() -> None:
             print(f"  tenant {tenant}: device={row['device_total_s']}s "
                   f"{row['device_s']} kv_block_s={row['kv_block_s']} "
                   f"queue_wait_s={row['queue_wait_s']}")
+    if brownout_policy is not None:
+        bj = brownout_policy.to_json()
+        print(f"brownout episode: steps={bj['steps']} "
+              f"final_level={bj['level']} ({bj['action']}) "
+              f"last_reason={bj['last_reason']}")
     if args.verify_parity:
         from chainermn_tpu.models import generate as solo_generate
 

@@ -45,7 +45,7 @@ DEFAULT_TOLERANCE = 0.25
 # patterns first (so "ttft_p50_speedup" reads as a speedup, not a TTFT)
 _HIGHER = ("tokens_per_sec", "throughput", "speedup", "hit_rate",
            "accept_rate", "gain", "gbps", "mfu", "tflops", "value",
-           "max_concurrent", "parity", "bandwidth")
+           "max_concurrent", "parity", "bandwidth", "goodput")
 _LOWER = ("_ms", "wall", "ttft", "tpot", "mttr", "lag", "overhead",
           "dip", "seconds", "preemption", "recompile", "eviction",
           "read_amplification", "conservation")

@@ -54,6 +54,7 @@ def test_train_mnist_model_parallel():
     assert "epoch   1" in proc.stdout
 
 
+@pytest.mark.slow  # ~5s fused variant; plain model-parallel mnist stays tier-1 — keep tier-1 inside its timeout
 def test_train_mnist_model_parallel_fused():
     proc = run_example(
         "mnist/train_mnist_model_parallel.py", TINY_MNIST + ["--fused"]
@@ -82,6 +83,7 @@ def test_seq2seq_model_parallel():
     assert "epoch   2" in proc.stdout
 
 
+@pytest.mark.slow  # ~12s; plain seq2seq + model-parallel examples stay tier-1 — keep tier-1 inside its timeout
 def test_seq2seq_hybrid_dp_mp():
     proc = run_example("seq2seq/seq2seq.py", TINY_SEQ2SEQ + ["--hybrid"],
                        n_devices=4)
@@ -99,6 +101,7 @@ def test_parallel_convolution():
     assert "epoch   2" in proc.stdout
 
 
+@pytest.mark.slow  # ~9s; MoE trains tier-1 in models_tests + gshard sharded — keep tier-1 inside its timeout
 def test_train_lm_moe():
     proc = run_example(
         "lm/train_lm.py",
@@ -108,6 +111,7 @@ def test_train_lm_moe():
     assert "done: 25 iterations" in proc.stdout
 
 
+@pytest.mark.slow  # ~8s; SP train step has tier-1 parity in models_tests — keep tier-1 inside its timeout
 def test_train_lm_sequence_parallel():
     proc = run_example(
         "lm/train_lm.py",
@@ -117,6 +121,7 @@ def test_train_lm_sequence_parallel():
     assert "done: 25 iterations" in proc.stdout
 
 
+@pytest.mark.slow  # ~6s; TP train parity stays tier-1 in parallel_tests; serve_lm TP example stays — keep tier-1 inside its timeout
 def test_train_lm_tensor_parallel():
     proc = run_example(
         "lm/train_lm.py",
@@ -126,6 +131,7 @@ def test_train_lm_tensor_parallel():
     assert "done: 25 iterations" in proc.stdout
 
 
+@pytest.mark.slow  # ~10s; gspmd step parity stays tier-1 in parallel_tests — keep tier-1 inside its timeout
 def test_train_lm_gspmd():
     proc = run_example(
         "lm/train_lm.py",
@@ -136,6 +142,7 @@ def test_train_lm_gspmd():
     assert "done: loss" in proc.stdout
 
 
+@pytest.mark.slow  # ~11s; pipeline schedule learns tier-1 in ops_tests — keep tier-1 inside its timeout
 def test_train_lm_pipeline():
     proc = run_example(
         "lm/train_lm.py",
@@ -159,6 +166,7 @@ def test_serve_lm():
     assert "zero recompiles" in proc.stdout
 
 
+@pytest.mark.slow  # ~7s; paged-KV parity stays tier-1 in serving_tests — keep tier-1 inside its timeout
 def test_serve_lm_paged_kv():
     proc = run_example(
         "lm/serve_lm.py",
@@ -208,6 +216,7 @@ def test_serve_lm_speculate_needs_greedy():
     assert "--temperature 0" in proc.stderr
 
 
+@pytest.mark.slow  # ~13s; fleet routing covered tier-1 by fleet_tests + bench serving record — keep tier-1 inside its timeout
 def test_serve_lm_fleet():
     """ISSUE 8: two replicas behind the FleetRouter serve interleaved
     shared-prefix traffic with token parity vs solo generate() — both
@@ -290,6 +299,38 @@ def test_serve_lm_tenant_costs_endpoint():
     assert "tenant tenant1:" in proc.stdout
     assert "goodput: useful=" in proc.stdout
     assert "scraped /costs:" in proc.stdout
+    assert "zero recompiles" in proc.stdout
+
+
+@pytest.mark.slow  # another multi-second subprocess run: full-suite only, to keep tier-1 inside its timeout
+def test_serve_lm_overload_brownout():
+    """ISSUE 18: overload robustness through the demo — a mixed
+    interactive/batch burst from two DRR-weighted tenants on ONE slot
+    drives a real brownout episode (the sustained interactive backlog
+    steps the ladder up; the drained queue steps it all the way back
+    down before the paused batch tier can finish), and the per-tenant
+    cost table still conserves every device-second."""
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "24", "--slots", "1", "--max-new", "12",
+         "--prefill-len", "8", "--d-model", "32", "--layers", "1",
+         "--heads", "4", "--tenants", "2", "--priority", "mixed",
+         "--tenant-weights", "tenant0=4,tenant1=1", "--brownout", "2"],
+    )
+    assert "24/24 requests served" in proc.stdout
+    # the ladder stepped up at least once and fully unwound: batch-class
+    # work can only have completed at level 0 (level >= 1 pauses it)
+    episode = [ln for ln in proc.stdout.splitlines()
+               if ln.startswith("brownout episode:")]
+    assert episode, proc.stdout
+    assert "final_level=0 (healthy)" in episode[0], episode[0]
+    steps = int(episode[0].split("steps=")[1].split()[0])
+    assert steps >= 2, episode[0]
+    # the per-tenant bill prints next to it, conservation intact
+    assert "cost accounting: measured=" in proc.stdout
+    assert "conservation_error=0.0" in proc.stdout
+    assert "tenant tenant0:" in proc.stdout
+    assert "tenant tenant1:" in proc.stdout
     assert "zero recompiles" in proc.stdout
 
 

@@ -1,0 +1,340 @@
+"""Fleet-edge overload guards (PR 18): per-tenant retry budgets, the
+per-tenant circuit breaker, the ``fleet.breaker`` chaos cut-point, the
+controller's brownout-before-scale-up preference, and snapshot-first
+scale-up spawns with factory fallback.
+
+Unit tests drive the guards with deterministic clocks; integration
+tests put them on a real router and assert the containment contracts —
+an open breaker refuses ONLY its tenant, a chaos fault at the breaker
+cut-point fails closed (one refused submission, fleet unharmed), and a
+poisoned snapshot load degrades to the live engine factory instead of
+failing the scale-up.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.extensions.sharded_checkpoint import ShardedCheckpointer
+from chainermn_tpu.fleet import (
+    AutoscalePolicy,
+    FleetController,
+    FleetRouter,
+    RetryBudget,
+    TenantBreaker,
+)
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.monitor._state import get_event_log
+from chainermn_tpu.monitor.health import fleet_health
+from chainermn_tpu.resilience.cutpoints import (
+    FLEET_BREAKER,
+    SHARDED_CHECKPOINT_LOAD,
+)
+from chainermn_tpu.resilience.faults import FaultInjector
+from chainermn_tpu.serving import QueueFullError, RequestState, ServingEngine
+from chainermn_tpu.serving.fairness import BrownoutPolicy
+
+NEVER = 1e9
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=1,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make_engine(lm, params):
+    return ServingEngine(lm, params, n_slots=2, prefill_len=6,
+                         cache_len=32)
+
+
+def solo(lm, params, prompt, n):
+    return np.asarray(generate(lm, params,
+                               jnp.asarray([prompt], jnp.int32), n)[0])
+
+
+# --------------------------------------------------------------------- #
+# RetryBudget units (deterministic clock)                                #
+# --------------------------------------------------------------------- #
+
+def test_retry_budget_token_bucket():
+    rb = RetryBudget(rate_per_s=1.0, burst=2.0)
+    assert rb.allow("t", now=0.0)
+    assert rb.allow("t", now=0.0)
+    assert not rb.allow("t", now=0.0)     # bucket dry
+    assert rb.allow("u", now=0.0)         # per-tenant: u untouched
+    assert rb.allow("t", now=1.5)         # refilled at rate_per_s
+    assert not rb.allow("t", now=1.6)
+    j = rb.to_json()
+    assert j["denied"]["t"] == 2
+    assert j["tokens"]["t"] < 1.0
+    with pytest.raises(ValueError, match="burst"):
+        RetryBudget(burst=0.5)
+
+
+# --------------------------------------------------------------------- #
+# TenantBreaker units (deterministic clock)                              #
+# --------------------------------------------------------------------- #
+
+def test_breaker_trips_on_sustained_shed_rate_and_half_opens():
+    br = TenantBreaker(window_s=10.0, shed_threshold=0.5,
+                       min_samples=4, open_s=2.0)
+    br.record_ok("bursty", now=0.0)
+    br.record_shed("bursty", now=1.0)
+    br.record_ok("bursty", now=2.0)
+    assert not br.is_open("bursty", now=2.0)    # 1/3 below threshold
+    br.record_shed("bursty", now=3.0)           # 2/4 = threshold: trips
+    assert br.is_open("bursty", now=3.5)
+    assert not br.is_open("quiet", now=3.5)     # per-tenant isolation
+    assert 0.0 < br.retry_after("bursty", now=3.5) <= 2.0
+    opens = [e for e in get_event_log().tail(32)
+             if e["kind"] == "breaker_open"]
+    assert opens and opens[-1]["tenant"] == "bursty"
+    assert opens[-1]["reason"] == "shed_rate"
+    # past open_s the breaker half-opens: closed, window cleared so the
+    # STALE sheds cannot instantly re-trip it
+    assert not br.is_open("bursty", now=5.5)
+    closes = [e for e in get_event_log().tail(32)
+              if e["kind"] == "breaker_close"]
+    assert closes and closes[-1]["tenant"] == "bursty"
+    br.record_shed("bursty", now=6.0)
+    assert not br.is_open("bursty", now=6.0)    # below min_samples again
+    assert br.to_json()["trips"]["bursty"] == 1
+
+
+def test_breaker_noisy_feed_tightens_threshold():
+    br = TenantBreaker(window_s=10.0, shed_threshold=0.8,
+                       min_samples=4, noisy_factor=0.5)
+    br.note_noisy("hog")
+    for t, shed in enumerate([True, True, True, False]):
+        (br.record_shed if shed else br.record_ok)("hog", now=float(t))
+        (br.record_shed if shed else br.record_ok)("calm", now=float(t))
+    # 3/4 = 0.75: below calm's 0.8 threshold, above hog's tightened 0.4
+    assert br.is_open("hog", now=4.0)
+    assert not br.is_open("calm", now=4.0)
+    assert "hog" in br.to_json()["noisy"]
+
+
+def test_breaker_force_open_names_one_tenant():
+    br = TenantBreaker(open_s=5.0)
+    br.force_open("bursty", now=0.0)
+    assert br.is_open("bursty", now=1.0)
+    assert not br.is_open("anyone_else", now=1.0)
+    assert br.retry_after("bursty", now=1.0) == pytest.approx(4.0)
+
+
+# --------------------------------------------------------------------- #
+# router integration                                                     #
+# --------------------------------------------------------------------- #
+
+def test_router_breaker_refuses_open_tenant_only(lm_and_params):
+    """An open breaker refuses its tenant instantly with a structured
+    retry_after_s; the quiet tenant's traffic is untouched and still
+    token-exact."""
+    lm, params = lm_and_params
+    br = TenantBreaker(open_s=30.0)
+    with FleetRouter([make_engine(lm, params)], breaker=br) as router:
+        assert router.wait_ready(300)
+        br.force_open("bursty")
+        with pytest.raises(QueueFullError) as exc:
+            router.submit(np.array([1, 2], np.int32), 3, tenant="bursty")
+        assert exc.value.retry_after_s is not None
+        assert exc.value.retry_after_s > 0.0
+        fr = router.submit(np.array([1, 2], np.int32), 3, tenant="quiet")
+        assert fr.wait(timeout=120) and fr.state is RequestState.DONE
+        np.testing.assert_array_equal(
+            fr.output, solo(lm, params, [1, 2], 3))
+        rep = router.fleet_report()
+        assert "bursty" in rep["overload"]["breaker"]["open"]
+        assert rep["shed_total"] >= 1
+        sheds = [e for e in get_event_log().tail(64)
+                 if e["kind"] == "fleet_shed"
+                 and e.get("reason") == "breaker_open"]
+        assert sheds and sheds[-1]["tenant"] == "bursty"
+
+
+def test_router_retry_budget_bounds_marked_retries(lm_and_params):
+    """Only ``retrying=True`` submissions spend budget; a dry bucket
+    refuses THEM with a rate-derived hint while fresh work flows."""
+    lm, params = lm_and_params
+    rb = RetryBudget(rate_per_s=0.001, burst=1.0)
+    with FleetRouter([make_engine(lm, params)],
+                     retry_budget=rb) as router:
+        assert router.wait_ready(300)
+        ok = router.submit(np.array([1, 2], np.int32), 2,
+                           tenant="t", retrying=True)
+        assert ok.wait(timeout=120)
+        with pytest.raises(QueueFullError) as exc:
+            router.submit(np.array([1, 2], np.int32), 2,
+                          tenant="t", retrying=True)
+        assert exc.value.retry_after_s == pytest.approx(1000.0)
+        fresh = router.submit(np.array([3, 4], np.int32), 2, tenant="t")
+        assert fresh.wait(timeout=120)
+        assert fresh.state is RequestState.DONE
+        assert rb.to_json()["denied"]["t"] == 1
+
+
+def test_fleet_breaker_chaos_cell_fails_closed(lm_and_params):
+    """A fault armed at the ``fleet.breaker`` cut-point refuses exactly
+    the probed submission (QueueFullError with a hint) — the fleet
+    itself is unharmed and the next submission serves normally."""
+    lm, params = lm_and_params
+    with FleetRouter([make_engine(lm, params)],
+                     breaker=TenantBreaker()) as router:
+        assert router.wait_ready(300)
+        inj = FaultInjector(seed=0).install()
+        try:
+            inj.arm(FLEET_BREAKER, kind="raise", times=1)
+            with pytest.raises(QueueFullError, match="breaker cut-point"):
+                router.submit(np.array([1, 2], np.int32), 2, tenant="t")
+        finally:
+            inj.uninstall()
+        fr = router.submit(np.array([1, 2], np.int32), 3, tenant="t")
+        assert fr.wait(timeout=120) and fr.state is RequestState.DONE
+        np.testing.assert_array_equal(
+            fr.output, solo(lm, params, [1, 2], 3))
+        assert router.capacity == 1
+
+
+# --------------------------------------------------------------------- #
+# controller: brownout-before-scale-up + snapshot-first spawns           #
+# --------------------------------------------------------------------- #
+
+def _pressure(router, n=6):
+    return [router.submit(np.array([1 + i, 2], np.int32), 2)
+            for i in range(n)]
+
+
+def _actions(summary):
+    return [a["action"] for a in summary["actions"]]
+
+
+def test_controller_prefers_brownout_then_scales_then_relieves(
+        lm_and_params):
+    """Sustained pressure steps brownout UP first (free, instant); only
+    once the ladder saturates does a replica spawn — and the moment it
+    is ready, the whole ladder unwinds (``capacity_arrived``)."""
+    lm, params = lm_and_params
+    with FleetRouter([make_engine(lm, params)],
+                     autostart=False) as router:
+        col = fleet_health(router, stall_timeout_s=60.0)
+        bo = BrownoutPolicy(queue_high=None, max_level=1)
+        ctrl = FleetController(
+            router, col,
+            engine_factory=lambda: make_engine(lm, params),
+            autoscale=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                      queue_high=2.0, up_after_s=1.0,
+                                      down_after_s=NEVER, cooldown_s=0.0),
+            brownout=bo,
+            sensor_kw=dict(stall_timeout_s=60.0))
+        frs = _pressure(router)
+        col.tick(now=1.0)
+        s1 = ctrl.tick(now=1.0)
+        assert s1["actions"] == []          # breach seen, not sustained
+        col.tick(now=2.5)
+        s2 = ctrl.tick(now=2.5)
+        # degrade BEFORE spending capacity
+        assert _actions(s2) == ["brownout"]
+        assert s2["actions"][0]["direction"] == "up"
+        assert bo.level == 1 and len(router.replicas) == 1
+        # pressure persists through the shed; the brownout step reset
+        # the hysteresis clock, so it must SUSTAIN again before capacity
+        # is spent — then, ladder saturated, a replica spawns
+        col.tick(now=4.0)
+        s3 = ctrl.tick(now=4.0)
+        assert s3["actions"] == []
+        col.tick(now=5.5)
+        s4 = ctrl.tick(now=5.5)
+        assert _actions(s4) == ["scale_up"]
+        assert s4["actions"][0]["source"] == "factory"
+        assert len(router.replicas) == 2
+        # capacity arrives: the ladder fully unwinds on a later tick
+        router.start()
+        assert router.wait_ready(300)
+        deadline = time.monotonic() + 60
+        relieved = None
+        t = 5.0
+        while relieved is None and time.monotonic() < deadline:
+            col.tick(now=t)
+            s = ctrl.tick(now=t)
+            relieved = next((a for a in s["actions"]
+                             if a.get("direction") == "relieve"), None)
+            t += 0.5
+            time.sleep(0.01)
+        assert relieved is not None and bo.level == 0
+        assert ctrl.report()["brownout"]["level"] == 0
+        for fr in frs:
+            assert fr.wait(timeout=120)
+        assert all(fr.state is RequestState.DONE for fr in frs)
+
+
+def test_scale_up_spawns_from_snapshot_with_factory_fallback(
+        lm_and_params, tmp_path):
+    """Scale-up restores the new replica from the fleet's persisted
+    snapshot (``source="snapshot"``); with a fault armed at the
+    checkpoint-load cut-point the SAME configuration degrades to the
+    live engine factory (``source="factory_fallback"``) instead of
+    failing the scale-up."""
+    lm, params = lm_and_params
+    cp = ShardedCheckpointer(str(tmp_path / "fleet_ckpt"))
+    cp.save(7, {"params": params})
+    template = jax.tree_util.tree_map(jnp.zeros_like, params)
+    snapshot = dict(checkpoint=cp,
+                    engine_factory=lambda p: make_engine(lm, p),
+                    params_template=template)
+
+    def run_scale_up(router):
+        col = fleet_health(router, stall_timeout_s=60.0)
+        ctrl = FleetController(
+            router, col,
+            engine_factory=lambda: make_engine(lm, params),
+            snapshot=snapshot,
+            autoscale=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                      queue_high=2.0, up_after_s=1.0,
+                                      down_after_s=NEVER, cooldown_s=0.0),
+            sensor_kw=dict(stall_timeout_s=60.0))
+        frs = _pressure(router)
+        col.tick(now=1.0)
+        ctrl.tick(now=1.0)
+        col.tick(now=2.5)
+        s = ctrl.tick(now=2.5)
+        assert _actions(s) == ["scale_up"]
+        return frs, s["actions"][0]
+
+    # clean path: the snapshot is the source
+    with FleetRouter([make_engine(lm, params)],
+                     autostart=False) as router:
+        frs, action = run_scale_up(router)
+        assert action["source"] == "snapshot"
+        assert len(router.replicas) == 2
+        router.start()
+        assert router.wait_ready(300)
+        for fr in frs:
+            assert fr.wait(timeout=120)
+            assert fr.state is RequestState.DONE
+        ups = [e for e in get_event_log().tail(64)
+               if e["kind"] == "controller_scale_up"]
+        assert ups and ups[-1]["source"] == "snapshot"
+
+    # chaos cell: poisoned snapshot load -> factory fallback
+    with FleetRouter([make_engine(lm, params)],
+                     autostart=False) as router:
+        inj = FaultInjector(seed=0).install()
+        try:
+            inj.arm(SHARDED_CHECKPOINT_LOAD, kind="raise", times=1)
+            frs, action = run_scale_up(router)
+        finally:
+            inj.uninstall()
+        assert action["source"] == "factory_fallback"
+        assert len(router.replicas) == 2
+        router.start()
+        assert router.wait_ready(300)
+        for fr in frs:
+            assert fr.wait(timeout=120)
+            assert fr.state is RequestState.DONE

@@ -68,7 +68,11 @@ def test_sampling_is_deterministic_under_same_key(lm_and_params):
     assert ((np.asarray(a) >= 0) & (np.asarray(a) < 17)).all()
 
 
-@pytest.mark.parametrize("vocab_parallel", [False, True])
+@pytest.mark.parametrize("vocab_parallel", [
+    False,
+    # ~7s; vocab-parallel head parity also pinned by the TP train tests — keep tier-1 inside its timeout
+    pytest.param(True, marks=pytest.mark.slow),
+])
 def test_tp_generate(comm, vocab_parallel):
     """Tensor-parallel cached decode inside comm.shard_map: per-rank
     local-head caches; with vocab_parallel_head the local logits are

@@ -42,6 +42,7 @@ def test_tiny_resnet_forward_backward():
     assert all(jnp.all(jnp.isfinite(l)) for l in jax.tree_util.tree_leaves(g))
 
 
+@pytest.mark.slow  # ~12s stem-parity variant; core resnet forward/train tests stay tier-1 — keep tier-1 inside its timeout
 def test_space_to_depth_stem():
     """The s2d stem must keep the downstream shapes identical to the conv7
     stem (2x spatial reduction before the maxpool) and train end-to-end."""

@@ -58,6 +58,7 @@ def test_flash_attention_lm_matches_full():
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow  # ~8s; flash-kernel parity stays tier-1 in kernel_tests/parallel_tests — keep tier-1 inside its timeout
 def test_flash_lm_train_step_data_parallel(comm):
     """attention='flash' must work under the jitted shard_map step (needs
     check_vma=False: Pallas interpret mode vs varying-manner checking)."""
@@ -104,7 +105,12 @@ def test_zigzag_lm_forward_matches_full(comm):
                                atol=2e-4, rtol=2e-4)
 
 
-@pytest.mark.parametrize("kind", ["zigzag", "zigzag_flash"])
+@pytest.mark.parametrize("kind", [
+    "zigzag",
+    # ~21s; flash-block composition has tier-1 gradient parity in
+    # parallel_tests/test_sequence — keep tier-1 inside its timeout
+    pytest.param("zigzag_flash", marks=pytest.mark.slow),
+])
 def test_zigzag_lm_train_step_learns(comm, kind):
     """The SP train step with zigzag attention (XLA blocks and Pallas
     blocks): data permuted once on the host, loss (mean over tokens) needs
@@ -131,6 +137,7 @@ def test_zigzag_lm_train_step_learns(comm, kind):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # ~6s; ring-flash forward+gradient parity stays tier-1 in parallel_tests — keep tier-1 inside its timeout
 def test_ring_flash_lm_train_step_learns(comm):
     """attention='ring_flash' (ring + Pallas kernel blocks, interpret mode
     here) through the public SP train step."""
@@ -183,7 +190,11 @@ def test_lm_train_step_data_parallel(comm):
     assert float(l2) < float(l1)
 
 
-@pytest.mark.parametrize("top_k", [1, 2])
+@pytest.mark.parametrize("top_k", [
+    1,
+    # ~7s; top-2 routing covered by gshard tests — keep tier-1 inside its timeout
+    pytest.param(2, marks=pytest.mark.slow),
+])
 def test_moe_lm_trains(comm, top_k):
     """MoE TransformerLM (every 2nd block expert-routed over the mesh axis):
     the step adds the Switch aux loss, surfaces routing telemetry as a 4th
@@ -228,6 +239,7 @@ def test_moe_lm_rejects_wrong_axis(comm):
         jit_lm_train_step(model, opt, comm)
 
 
+@pytest.mark.slow  # ~15s gradient-parity soak; the remat train step below stays tier-1 — keep tier-1 inside its timeout
 def test_remat_matches_nonremat():
     """remat=True is a memory/FLOPs trade, not a numerics change: values
     AND gradients must match the plain model exactly (same params — remat
@@ -311,6 +323,7 @@ def test_fused_ce_rejects_sharded_heads():
         jit_lm_train_step(lm, None, None, fused_ce=True)
 
 
+@pytest.mark.slow  # ~17s; fused-CE parity vs materialized logits stays tier-1 — keep tier-1 inside its timeout
 def test_fused_ce_sequence_parallel(comm):
     """fused_ce composes with the sequence-sharded step (zigzag): each
     shard's chunked CE over local tokens, global mean via the loss

@@ -91,7 +91,7 @@ def test_fleet_modules_never_import_extensions_at_module_level():
     stay out of the extensions cycle the same way — router/replica pull
     serving (which pulls extensions) lazily, never at module level."""
     _run_hygiene(fleet_pkg, "chainermn_tpu.fleet",
-                 ("router", "replica", "routing", "control"))
+                 ("router", "replica", "routing", "control", "overload"))
 
 
 def test_deploy_modules_never_import_extensions_at_module_level():

@@ -304,6 +304,7 @@ def test_tp_attention_composes_with_sp(comm, sp_kind):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~8s; each axis pair (DP+SP, SP+TP, DP+TP) covered individually tier-1 — keep tier-1 inside its timeout
 def test_3d_dp_sp_tp_lm_trains(comm):
     """Full hybrid: dp x sp x tp over a (2,2,2) mesh — TransformerLM with
     ring attention over sp, Megatron blocks + vocab-parallel head over tp,

@@ -79,7 +79,9 @@ def test_max_queue_validation(lm_and_params):
 def test_expired_queued_requests_are_shed(lm_and_params):
     lm, params = lm_and_params
     engine, sched = make(lm, params, n_slots=1, default_deadline_s=0.05)
-    r1 = sched.submit(np.array([1]), 8)    # admitted immediately
+    # generous override so r1 holding the only slot isn't itself shed
+    # mid-decode by the round-18 total-service-time contract
+    r1 = sched.submit(np.array([1]), 8, deadline_s=30.0)
     r2 = sched.submit(np.array([2]), 2)
     r3 = sched.submit(np.array([3]), 2, deadline_s=30.0)  # generous override
     sched.step()                           # r1 takes the only slot
@@ -91,20 +93,28 @@ def test_expired_queued_requests_are_shed(lm_and_params):
         r2.wait(timeout=1)
     with pytest.raises(DeadlineExceededError):
         _ = r2.output
+    assert r2.error.retry_after_s is not None   # structured backoff hint
     assert r3.state is RequestState.DONE   # per-request deadline respected
     assert sched.metrics.report()["requests_shed"] == 1
 
 
-def test_deadline_only_governs_queue_wait(lm_and_params):
-    """A request ADMITTED before its deadline runs to completion — the
-    deadline bounds queue wait, not decode time."""
+def test_deadline_bounds_total_service_time(lm_and_params):
+    """Round 18 contract: the deadline bounds TOTAL service time, not
+    just queue wait — a request still decoding past its deadline is
+    retired at the next step boundary (its already-delivered tokens
+    stand; the terminal error says how far it got)."""
     lm, params = lm_and_params
     engine, sched = make(lm, params, n_slots=1, default_deadline_s=0.05)
     r = sched.submit(np.array([1]), 6)
     sched.step()                           # admitted within deadline
-    time.sleep(0.1)
+    got = len(r.tokens)
+    assert got >= 1                        # decoding had started
+    time.sleep(0.1)                        # ...then blew its budget
     sched.run_until_idle()
-    assert r.state is RequestState.DONE and len(r.tokens) == 6
+    assert r.state is RequestState.ERRORED
+    with pytest.raises(DeadlineExceededError, match="decoded token"):
+        r.wait(timeout=1)
+    assert r.error.retry_after_s is not None
 
 
 # --------------------------------------------------------------------- #
@@ -280,9 +290,11 @@ def test_client_reraises_in_caller_thread(lm_and_params):
 
 def test_no_stranded_clients_on_transient_hang(lm_and_params):
     """Acceptance: with an injected engine hang, every submitted request
-    reaches a terminal state — in-flight work completes once the stall
-    clears, queued work past its deadline is shed, nothing blocks
-    forever."""
+    reaches a terminal state, nothing blocks forever. Under the round-18
+    total-service-time deadline the 0.4s stall blows every request's
+    0.2s budget — in-flight work is retired at the first step boundary
+    after the stall clears (a loud DeadlineExceededError, not a silent
+    late answer), queued work sheds the same way."""
     lm, params = lm_and_params
     engine = ServingEngine(lm, params, n_slots=2, prefill_len=6,
                            cache_len=32)
@@ -305,8 +317,9 @@ def test_no_stranded_clients_on_transient_hang(lm_and_params):
     assert waited < 30                     # nobody blocked forever
     assert all(s in (RequestState.DONE, RequestState.ERRORED)
                for s in states)
-    assert RequestState.DONE in states     # in-flight survived the stall
-    assert states.count(RequestState.ERRORED) >= 1   # expired queue shed
+    # the stall consumed every budget: all shed, each with a backoff hint
+    assert states.count(RequestState.ERRORED) == len(reqs)
+    assert all(r.error.retry_after_s is not None for r in reqs)
 
 
 def test_degradation_is_observable(lm_and_params):

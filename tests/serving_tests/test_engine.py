@@ -167,6 +167,7 @@ def test_engine_rejects_bad_configs(lm_and_params):
         engine.prefill(np.array([1, 2]), jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow  # ~16s; TP-serving parity also pinned by the paged-KV TP test below — keep tier-1 inside its timeout
 def test_tp_serving_matches_solo_tp_generate():
     """Tensor-parallel serving (the _generate_tp_fn pattern through the
     scheduler): head-sharded slot caches inside comm.shard_map, both head
