@@ -1685,6 +1685,152 @@ def serving_main() -> None:
             f"brownout {of['brownout']['max_level']}->"
             f"{of['brownout']['final_level']}, parity={of_parity}, "
             f"lost={of_lost}")
+
+        # ---- chunked prefill: decode stall ON vs OFF ------------------ #
+        # ISSUE 19 acceptance: with monolithic prefill, every long-prompt
+        # admission stalls every decoding slot for the full top-bucket
+        # prefill; chunked prefill bounds the stall to one chunk's bucket.
+        # The SAME victim+aggressor arrival runs twice on one warm paged
+        # engine — decode-gap p99 across the victims' streams must be
+        # >= 2x better with chunking ON, token streams identical, zero
+        # recompiles (chunks ride the warmup buckets).
+        cp_chunk = int(e("CHAINERMN_TPU_SERVE_CHUNK_TOKENS", "16"))
+        cp_nv = int(e("CHAINERMN_TPU_SERVE_CP_VICTIMS", "3"))
+        cp_na = int(e("CHAINERMN_TPU_SERVE_CP_LONG", "2"))
+        # the aggressor prompts get 8x the serving model's window: on CPU
+        # a dispatch costs ~same as a small prefill, so the monolithic
+        # top-bucket prefill has to DWARF one decode step (not just beat
+        # it) for the stall to be the signal, not the call overhead
+        cp_prefill = 8 * prefill_len
+        cp_new = max(8, max_new)
+        cp_model = TransformerLM(
+            vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+            n_layers=n_layers, max_len=cp_prefill + cp_new)
+        cp_params = cp_model.init(
+            jax.random.PRNGKey(3), jnp.zeros((1, cp_prefill), jnp.int32))
+        cp_rng = np.random.RandomState(19)
+        cp_eng = ServingEngine(
+            cp_model, cp_params, n_slots=cp_nv + 1,
+            prefill_buckets=(cp_chunk, cp_prefill), prefill_batch=1,
+            paged=True, kv_block_size=cp_chunk,
+            kv_blocks=2 * (cp_nv + 1) * (-(-(cp_prefill + cp_new)
+                                           // cp_chunk)),
+            cache_len=cp_prefill + cp_new)
+        cp_eng.warmup()
+        cp_counts = cp_eng.compile_counts_detailed()
+        victims = [(cp_rng.randint(1, vocab, cp_chunk - 2)
+                    .astype(np.int32), cp_new) for _ in range(cp_nv)]
+        aggressors = [(cp_rng.randint(1, vocab, cp_prefill - 1)
+                       .astype(np.int32), 2) for _ in range(cp_na)]
+
+        def cp_run(chunk):
+            s = FCFSScheduler(cp_eng, chunk_tokens_per_step=chunk)
+            stamps = [[] for _ in victims]
+            vreqs = [
+                s.submit(p, n, rng=jax.random.PRNGKey(100 + i),
+                         stream_cb=lambda tok, _i=i: stamps[_i].append(
+                             time.perf_counter()))
+                for i, (p, n) in enumerate(victims)]
+            while not all(stamps):          # victims all decoding first
+                s.step()
+            areqs = [s.submit(p, n, rng=jax.random.PRNGKey(200 + i))
+                     for i, (p, n) in enumerate(aggressors)]
+            while s.has_work:
+                s.step()
+            gaps = [b - a for ts in stamps
+                    for a, b in zip(ts, ts[1:])]
+            return ([r.tokens for r in vreqs + areqs],
+                    float(np.percentile(np.asarray(gaps), 99)))
+
+        cp_toks_off, cp_p99_off = cp_run(None)
+        cp_toks_on, cp_p99_on = cp_run(cp_chunk)
+        cp_parity = cp_toks_on == cp_toks_off
+        assert cp_eng.compile_counts_detailed() == cp_counts, "recompiled!"
+        record["chunked_prefill_serving"] = {
+            "chunk_tokens": cp_chunk,
+            "victims": cp_nv,
+            "long_prompts": cp_na,
+            "long_prompt_len": cp_prefill - 1,
+            "decode_gap_p99_ms_off": round(cp_p99_off * 1e3, 3),
+            "decode_gap_p99_ms_on": round(cp_p99_on * 1e3, 3),
+            "stall_improvement": round(cp_p99_off / max(cp_p99_on, 1e-9),
+                                       2),
+            "token_parity_on_vs_off": cp_parity,
+            "recompiles_after_warmup": 0,
+        }
+        cp = record["chunked_prefill_serving"]
+        log(f"chunked prefill: victim decode-gap p99 "
+            f"off={cp['decode_gap_p99_ms_off']}ms "
+            f"on={cp['decode_gap_p99_ms_on']}ms "
+            f"(x{cp['stall_improvement']}), parity={cp_parity}")
+
+        # ---- disaggregated prefill/decode tiers ----------------------- #
+        # 1P+1D with KV migration vs the same fleet symmetric: every
+        # request prefills on the P tier, its blocks host-bounce to the D
+        # tier, and the stream finishes there — same tokens either way,
+        # nothing lost, no recompiles. The record carries both configs'
+        # latency splits and the migration counters.
+        from chainermn_tpu.fleet import FleetRouter
+        from chainermn_tpu.monitor._state import get_registry
+
+        dg_n = int(e("CHAINERMN_TPU_SERVE_DG_REQUESTS", "6"))
+        dg_rng = np.random.RandomState(20)
+        dg_jobs = [(dg_rng.randint(1, vocab, prefill_len - 1)
+                    .astype(np.int32), max_new) for _ in range(dg_n)]
+
+        def dg_engine():
+            return ServingEngine(
+                model, params, n_slots=2,
+                prefill_buckets=(cp_chunk, prefill_len), prefill_batch=1,
+                paged=True, kv_block_size=cp_chunk,
+                kv_blocks=6 * (-(-(prefill_len + max_new) // cp_chunk)),
+                cache_len=prefill_len + max_new)
+
+        def dg_run(**tiers):
+            router = FleetRouter([dg_engine(), dg_engine()], **tiers)
+            try:
+                assert router.wait_ready(600)
+                t0 = time.perf_counter()
+                frs = [router.submit(p, n,
+                                     rng=jax.random.PRNGKey(300 + i))
+                       for i, (p, n) in enumerate(dg_jobs)]
+                done = all(fr.wait(300) for fr in frs)
+                wall = time.perf_counter() - t0
+                rep = router.fleet_report()
+                for r in router.replicas:
+                    assert r.engine.recompiles == {}, "recompiled!"
+                return ([list(fr.tokens) for fr in frs], done, wall,
+                        rep["tiers"])
+            finally:
+                router.close()
+
+        dg_mig0 = sum(
+            v for k, v in get_registry().snapshot()["counters"].items()
+            if k.startswith("kv_migrations_total"))
+        dg_toks, dg_done, dg_wall, dg_tiers = dg_run(
+            prefill_replicas=1, decode_replicas=1,
+            chunk_tokens_per_step=cp_chunk)
+        dg_migrations = sum(
+            v for k, v in get_registry().snapshot()["counters"].items()
+            if k.startswith("kv_migrations_total")) - dg_mig0
+        sym_toks, sym_done, sym_wall, _ = dg_run()
+        record["disagg_serving"] = {
+            "requests": dg_n,
+            "tiers": dg_tiers,
+            "migrations": int(dg_migrations),
+            "wall_s_disagg": round(dg_wall, 3),
+            "wall_s_symmetric": round(sym_wall, 3),
+            "token_parity_vs_symmetric": dg_toks == sym_toks,
+            "no_request_lost": bool(dg_done and sym_done),
+            "recompiles_after_warmup": 0,
+        }
+        dg = record["disagg_serving"]
+        log(f"disagg serving: {dg_n} reqs 1P+1D wall="
+            f"{dg['wall_s_disagg']}s (symmetric="
+            f"{dg['wall_s_symmetric']}s), migrations="
+            f"{dg['migrations']}, parity={dg['token_parity_vs_symmetric']}"
+            f", lost={not dg['no_request_lost']}")
+
         from chainermn_tpu.monitor import snapshot as monitor_snapshot
 
         record["monitor"] = monitor_snapshot()

@@ -122,7 +122,8 @@ class EngineReplica:
                  on_failure: Optional[Callable] = None,
                  labels: Optional[dict] = None,
                  autostart: bool = True,
-                 fair=None, tenant_weights=None, brownout=None) -> None:
+                 fair=None, tenant_weights=None, brownout=None,
+                 chunk_tokens_per_step: Optional[int] = None) -> None:
         from chainermn_tpu.serving.metrics import ServingMetrics
         from chainermn_tpu.serving.scheduler import FCFSScheduler
 
@@ -134,7 +135,8 @@ class EngineReplica:
         self.scheduler = FCFSScheduler(
             engine, eos_id=eos_id, metrics=self.metrics, retry=retry,
             restart_on_error=False, fair=fair,
-            tenant_weights=tenant_weights, brownout=brownout)
+            tenant_weights=tenant_weights, brownout=brownout,
+            chunk_tokens_per_step=chunk_tokens_per_step)
         self.max_restarts = int(max_restarts)
         self.restarts = 0
         self._idle_wait_s = idle_wait_s
@@ -209,6 +211,21 @@ class EngineReplica:
                                     tenant=tenant, priority=priority)
         self._work.set()
         return req
+
+    def submit_migrated(self, req, payload: dict):
+        """Accept a prefill-complete request handed over from a prefill-
+        tier peer (thread-safe). The SAME Request object continues on
+        this replica's scheduler — its stream/trace/waiter follow it.
+        Raises when not accepting, so the source keeps decoding in
+        place (the migration handshake never loses a request)."""
+        if not self.accepting:
+            raise RuntimeError(
+                # graftlint: unguarded-ok — diagnostic read only
+                f"replica {self.replica_id} is {self._state.value}, "
+                "not accepting migrated work")
+        out = self.scheduler.enqueue_migrated(req, payload)
+        self._work.set()
+        return out
 
     def snapshot(self) -> ReplicaSnapshot:
         """Routing-time occupancy (host counters only — the policy's

@@ -29,6 +29,21 @@ KV store) on its own thread; the :class:`FleetRouter` in front of them:
   finishes cleanly ERRORED (``DeadlineExceededError``) — re-routed or
   cleanly shed, never lost, never stranded.
 
+**Disaggregated prefill/decode tiers (PR 19).** ``FleetRouter(...,
+prefill_replicas=P, decode_replicas=D)`` splits the fleet: new requests
+route to the first ``P`` replicas (the prefill tier — typically running
+chunked prefill, ``chunk_tokens_per_step=``), and when a request's
+prefill completes its KV blocks are read out host-side and handed to a
+decode-tier replica (:meth:`FleetRouter._migrate`, installed as each
+prefill scheduler's ``migrate_cb``). The handover moves the SAME
+scheduler ``Request`` object — rng state, position, stream relay and
+waiter all ride along, so the token stream is byte-identical to an
+unmigrated decode. Every failure mode decays to something safe: no
+decode replica can take the payload → the source keeps decoding in
+place; the destination dies before importing → the drain hands the
+request back and it replays elsewhere; the destination dies mid-decode →
+the normal re-route replay. Never a lost request.
+
 The consumer surface is a :class:`FleetRequest` mirroring
 :class:`~chainermn_tpu.serving.scheduler.Request` (``wait`` / ``stream``
 / ``output`` / ``state``), so :meth:`FleetRouter.submit` and
@@ -227,6 +242,13 @@ class FleetRouter:
         Per-replica warm-restart budget before quarantine.
     max_reroutes : int, optional
         Re-route budget per request (default: the replica count).
+    prefill_replicas / decode_replicas : int, optional
+        Disaggregated tiers (give both or neither): the first
+        ``prefill_replicas`` engines form the prefill tier, the rest the
+        decode tier; ``prefill + decode`` must cover every engine.
+    chunk_tokens_per_step : int, optional
+        Forwarded to every replica's scheduler: long prompts prefill in
+        bounded chunks interleaved with decode.
     """
 
     def __init__(self, engines: Sequence, *, eos_id: Optional[int] = None,
@@ -241,11 +263,29 @@ class FleetRouter:
                  autostart: bool = True,
                  retry_budget: Optional[RetryBudget] = None,
                  breaker: Optional[TenantBreaker] = None,
-                 fair=None, tenant_weights=None, brownout=None) -> None:
+                 fair=None, tenant_weights=None, brownout=None,
+                 prefill_replicas: Optional[int] = None,
+                 decode_replicas: Optional[int] = None,
+                 chunk_tokens_per_step: Optional[int] = None) -> None:
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if (prefill_replicas is None) != (decode_replicas is None):
+            raise ValueError("prefill_replicas and decode_replicas must "
+                             "be given together (or neither)")
+        self._prefill_tier: Optional[frozenset] = None
+        if prefill_replicas is not None:
+            p, d = int(prefill_replicas), int(decode_replicas)
+            if p < 1 or d < 1:
+                raise ValueError(
+                    f"both tiers need at least one replica, got "
+                    f"prefill={p} decode={d}")
+            if p + d != len(engines):
+                raise ValueError(
+                    f"prefill_replicas + decode_replicas must cover the "
+                    f"fleet: {p}+{d} != {len(engines)} engines")
+            self._prefill_tier = frozenset(range(p))
         prefix_on = all(getattr(e, "prefix_enabled", False) for e in engines)
         self.affinity = bool(affinity) and prefix_on
         if affinity_block_size is None:
@@ -282,7 +322,8 @@ class FleetRouter:
         self._replica_cfg = dict(eos_id=eos_id, max_restarts=max_restarts,
                                  retry=retry, idle_wait_s=idle_wait_s,
                                  fair=fair, tenant_weights=tenant_weights,
-                                 brownout=brownout)
+                                 brownout=brownout,
+                                 chunk_tokens_per_step=chunk_tokens_per_step)
         # fleet-edge overload guards (None = feature off, zero overhead)
         self.retry_budget = retry_budget
         self.breaker = breaker
@@ -310,6 +351,13 @@ class FleetRouter:
                           **self._replica_cfg)
             for i, eng in enumerate(engines)
         ]
+        if self._prefill_tier is not None:
+            # the handover hook: each prefill-tier scheduler offers its
+            # prefill-complete requests back to the router for placement
+            # on a decode replica (replicas spawned later join the
+            # decode tier implicitly — they are never in _prefill_tier)
+            for rid in self._prefill_tier:
+                self.replicas[rid].scheduler.migrate_cb = self._migrate
 
     # ------------------------------------------------------------------ #
     # lifecycle                                                           #
@@ -551,6 +599,15 @@ class FleetRouter:
                           and s.replica_id != exclude]
         if not candidates:
             candidates = [s for s in snaps if s.healthy]
+        if self._prefill_tier is not None:
+            # disaggregated mode: new work lands on the prefill tier —
+            # unless none of it survived the filters above, in which
+            # case the whole fleet serves (degraded but never shedding
+            # for tier purity)
+            tiered = [s for s in candidates
+                      if s.replica_id in self._prefill_tier]
+            if tiered:
+                candidates = tiered
         from chainermn_tpu.resilience.cutpoints import FLEET_ROUTE
 
         try:
@@ -614,6 +671,55 @@ class FleetRouter:
                           replica=decision.replica_id,
                           affinity=decision.affinity_hit,
                           reason=decision.reason, rerouted=rerouted)
+
+    def _migrate(self, req, payload: dict) -> bool:
+        """A prefill-tier scheduler's handover hook (called on that
+        replica's driving thread with a prefill-complete request and its
+        exported KV payload). Picks the best decode-tier replica that can
+        take the payload and enqueues the SAME request object there; True
+        transfers ownership. Any failure — chaos at the ``fleet.migrate``
+        cut-point, no candidate with capacity, a dying destination —
+        returns False and the source decodes in place. The fleet-level
+        handle needs no rebinding: ``fr._inner`` is unchanged, only
+        ``fr.replica_id`` moves so failure attribution follows the KV."""
+        from chainermn_tpu.resilience.cutpoints import FLEET_MIGRATE
+
+        with self._lock:
+            if self._closed or self._prefill_tier is None:
+                return False
+            fr = next((f for f in self._requests.values()
+                       if f._inner is req), None)
+            if fr is None or fr.finished:
+                return False
+            try:
+                _inject(FLEET_MIGRATE, req=fr.id,
+                        replica=fr.replica_id)
+            except Exception as e:  # noqa: BLE001 — chaos: stay local
+                self._events.emit("fleet_route_fallback",
+                                  error=type(e).__name__,
+                                  replica=fr.replica_id)
+                return False
+            snaps = self._snapshots_locked()
+            cands = [s for s in snaps
+                     if s.replica_id not in self._prefill_tier
+                     and s.replica_id not in self._publishing]
+            remaining = max(1, fr.max_new_tokens - len(fr.tokens))
+            for snap in self._policy.migration_targets(cands):
+                dest = self.replicas[snap.replica_id]
+                try:
+                    if not dest.engine.can_import(payload,
+                                                  max_new=remaining):
+                        continue
+                    dest.submit_migrated(req, payload)
+                except Exception:  # noqa: BLE001 — next candidate
+                    continue
+                fr.replica_id = dest.replica_id
+                self._events.emit("fleet_route", req=fr.id,
+                                  replica=dest.replica_id,
+                                  affinity=False, reason="kv_migrate",
+                                  rerouted=False)
+                return True
+            return False
 
     # ------------------------------------------------------------------ #
     # settlement (consumer waits + failover)                              #
@@ -1003,6 +1109,11 @@ class FleetRouter:
                 "hit_rate": round(hits / max(hits + misses, 1), 4),
                 "trie_nodes": self._trie.n_nodes,
             },
+            "tiers": (None if self._prefill_tier is None else {
+                "prefill": sorted(self._prefill_tier),
+                "decode": [r.replica_id for r in self.replicas
+                           if r.replica_id not in self._prefill_tier],
+            }),
             "requests_total": int(self._c_requests.value),
             "reroutes_total": int(self._c_reroutes.value),
             "shed_total": int(self._c_shed.value),

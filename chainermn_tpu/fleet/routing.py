@@ -150,6 +150,19 @@ class RoutingPolicy:
         return RouteDecision(base.replica_id, affinity_hit=False,
                              reason="least_loaded")
 
+    def migration_targets(self, snapshots: Sequence[ReplicaSnapshot]
+                          ) -> list[ReplicaSnapshot]:
+        """Rank decode-tier candidates for a KV handover: healthy
+        replicas with block headroom, best (least-loaded) first. The
+        caller walks the list until one accepts the payload — a ranking,
+        not a single pick, because import capacity (free slots, exact
+        block budget) is only known engine-side at handover time.
+        Affinity plays no part: the migrated request's prefix KV travels
+        WITH it, so there is nothing cached to seek out."""
+        fit = [s for s in snapshots
+               if s.healthy and s.kv_free_frac >= self.min_kv_free_frac]
+        return sorted(fit, key=self._key)
+
     @staticmethod
     def overloaded(snapshots: Sequence[ReplicaSnapshot],
                    max_queue: Optional[int]) -> bool:

@@ -51,6 +51,12 @@ METRIC_NAMES = frozenset({
     "kv_blocks_in_use",
     "kv_blocks_per_request",
     "kv_preemptions_total",
+    # chunked prefill + KV migration (disaggregated prefill/decode tiers)
+    "chunk_tokens",
+    "kv_migrated_blocks_total",
+    "kv_migrations_total",
+    "migration_seconds",
+    "prefill_chunks_total",
     "prefill_batch_size",
     "prefix_cache_evictions_total",
     "prefix_cache_hits_total",
@@ -153,9 +159,11 @@ EVENT_KINDS = frozenset({
     "first_token",
     "kv_admit_defer",
     "kv_append",
+    "kv_migrate",
     "kv_preempt",
     "paged_kernel_fallback",
     "prefill",
+    "prefill_chunk",
     "prefix_evict",
     "prefix_insert",
     "prefix_insert_error",

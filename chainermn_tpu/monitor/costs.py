@@ -24,6 +24,11 @@ per-tenant shares by explicit rules:
   as ``replay`` instead of ``useful``, metered by a per-request token
   debt so a second preemption never double-books (debt only grows by
   what was *discarded*, and each replayed token consumes it once).
+- **migrate** — the host-bounce handover that moves a request's KV
+  blocks from a prefill-tier replica to a decode-tier one is device+PCIe
+  time spent on exactly one request; the whole measured interval books
+  to its tenant as ``migrate`` (overhead, not goodput — the bench's
+  crossover math weighs it against the decode stalls it deletes).
 - **KV block-seconds** — the integral of blocks held over wall time; a
   shared prefix block held by ``r`` requests contributes ``1/r`` per
   holder (the live refcount split), so the pool's occupancy always sums
@@ -62,7 +67,7 @@ from chainermn_tpu.monitor.timeseries import (
 )
 
 #: attribution kinds; together they partition every measured interval
-KINDS = ("useful", "padding", "idle", "wasted", "replay")
+KINDS = ("useful", "padding", "idle", "wasted", "replay", "migrate")
 
 #: reserved tenant for shares no request owns (empty prefill rows, idle
 #: decode slots) — kept out of per-tenant rankings but inside goodput
@@ -214,6 +219,21 @@ class CostLedger:
             if idle > 0:
                 out[(UNATTRIBUTED, "idle")] = out.get(
                     (UNATTRIBUTED, "idle"), 0.0) + row_s * idle
+            self._book_locked(interval_s, out)
+        return out
+
+    def record_migration(self, interval_s: float, *, req_id: int,
+                         tenant: str) -> dict:
+        """Book one KV-block migration's wall interval (gather dispatch +
+        host bounce + scatter dispatch) entirely to the owning tenant as
+        ``migrate`` — a single-request transfer has no rows to split, so
+        conservation is exact by construction. Returns the attribution
+        (``{(tenant, 'migrate'): interval_s}``)."""
+        interval_s = float(interval_s)
+        out: dict[tuple, float] = {}
+        if interval_s > 0.0:
+            out[(tenant, "migrate")] = interval_s
+        with self._lock:
             self._book_locked(interval_s, out)
         return out
 

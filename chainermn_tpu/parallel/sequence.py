@@ -42,6 +42,29 @@ from chainermn_tpu.utils import axis_size as _axis_size
 _NEG_BIG = -1e30  # finite "minus infinity": avoids inf-inf NaNs in masked rows
 
 
+def chunk_spans(start: int, total: int, chunk_len: int
+                ) -> list[tuple[int, int]]:
+    """Partition token range ``[start, total)`` into consecutive
+    ``(offset, length)`` spans of at most ``chunk_len`` tokens.
+
+    The one sequence-partitioning arithmetic shared by both consumers of
+    "process a long sequence in bounded pieces": sequence-parallel
+    sharding plans (where each span is a shard's local window) and the
+    serving engine's chunked prefill (where each span is one scheduler
+    step's device call). Pure host math — every span is non-empty, spans
+    tile the range exactly, and only the last may be short."""
+    start, total, chunk_len = int(start), int(total), int(chunk_len)
+    if chunk_len < 1:
+        raise ValueError(f"chunk_len must be >= 1, got {chunk_len}")
+    spans = []
+    frontier = start
+    while frontier < total:
+        clen = min(chunk_len, total - frontier)
+        spans.append((frontier, clen))
+        frontier += clen
+    return spans
+
+
 def _typeof_vma(x):
     """Varying-manner set of a traced value; empty on legacy JAX (no
     ``jax.typeof``/vma — replication tracking is off there, see

@@ -45,11 +45,13 @@ SERVING_DECODE = "serving.decode"
 SERVING_KV_APPEND = "serving.kv_append"
 SERVING_PREFIX_COPY = "serving.prefix_copy"
 SERVING_SPEC_VERIFY = "serving.spec_verify"
+SERVING_CHUNK_PREFILL = "serving.chunk_prefill"
 
 # -- fleet / deploy ------------------------------------------------------- #
 FLEET_ROUTE = "fleet.route"
 FLEET_REPLICA = "fleet.replica"
 FLEET_BREAKER = "fleet.breaker"
+FLEET_MIGRATE = "fleet.migrate"
 DEPLOY_PUBLISH = "deploy.publish"
 DEPLOY_RESHARD = "deploy.reshard"
 
@@ -81,9 +83,11 @@ ALL_CUTPOINTS = (
     SERVING_KV_APPEND,
     SERVING_PREFIX_COPY,
     SERVING_SPEC_VERIFY,
+    SERVING_CHUNK_PREFILL,
     FLEET_ROUTE,
     FLEET_REPLICA,
     FLEET_BREAKER,
+    FLEET_MIGRATE,
     DEPLOY_PUBLISH,
     DEPLOY_RESHARD,
 )
@@ -99,11 +103,13 @@ __all__ = [
     "DEPLOY_RESHARD",
     "DYNAMIC_PREFIXES",
     "FLEET_BREAKER",
+    "FLEET_MIGRATE",
     "FLEET_REPLICA",
     "FLEET_ROUTE",
     "OBJSTORE_GET",
     "OBJSTORE_PUT",
     "SERVING_ADMIT_FAIR",
+    "SERVING_CHUNK_PREFILL",
     "SERVING_DECODE",
     "SERVING_KV_APPEND",
     "SERVING_PREFILL",

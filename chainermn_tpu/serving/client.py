@@ -45,13 +45,15 @@ class ServingClient:
                  default_deadline_s: Optional[float] = None,
                  retry=None, restart_on_error: bool = True,
                  max_restarts: int = 8, fair=None, tenant_weights=None,
-                 brownout=None) -> None:
+                 brownout=None,
+                 chunk_tokens_per_step: Optional[int] = None) -> None:
         self.engine = engine
         self.scheduler = FCFSScheduler(
             engine, eos_id=eos_id, max_queue=max_queue,
             default_deadline_s=default_deadline_s, retry=retry,
             restart_on_error=restart_on_error, max_restarts=max_restarts,
-            fair=fair, tenant_weights=tenant_weights, brownout=brownout)
+            fair=fair, tenant_weights=tenant_weights, brownout=brownout,
+            chunk_tokens_per_step=chunk_tokens_per_step)
         self.metrics = self.scheduler.metrics
         self._work = threading.Event()
         self._stop = threading.Event()

@@ -83,7 +83,8 @@ unchanged. The legacy dense path is preserved byte-for-byte behind
 from __future__ import annotations
 
 import contextlib
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 from typing import Optional, Sequence, Union
 
 import jax
@@ -102,6 +103,7 @@ from chainermn_tpu.monitor import RecompileGuard, annotate
 from chainermn_tpu.monitor._state import get_event_log, get_registry
 from chainermn_tpu.parallel.paged_kernel import kernel_supported
 from chainermn_tpu.resilience.cutpoints import (
+    SERVING_CHUNK_PREFILL,
     SERVING_DECODE,
     SERVING_KV_APPEND,
     SERVING_PREFILL,
@@ -136,6 +138,36 @@ class AdmitPlan:
     @property
     def cached_frac(self) -> float:
         return self.start / len(self.prompt) if len(self.prompt) else 0.0
+
+
+@dataclass
+class ChunkedPrefill:
+    """In-progress chunked prefill of ONE slot: the request's prompt and
+    rng held host-side, the slot's privately-staged block ids (allocated
+    up front, NOT yet visible in the engine's decode table — see
+    :meth:`ServingEngine.begin_chunked`), and the precomputed chunk
+    schedule ``[(frontier, chunk_len, bucket), ...]`` that
+    :meth:`ServingEngine.prefill_chunk` walks one entry per call."""
+
+    prompt: np.ndarray
+    rng: object
+    start: int                     # cached-prefix tokens (chunk 0 frontier)
+    max_new: int
+    ids: list = field(default_factory=list)
+    chunks: list = field(default_factory=list)
+    next_idx: int = 0
+    t_begin: float = 0.0
+
+    @property
+    def done(self) -> bool:
+        return self.next_idx >= len(self.chunks)
+
+    @property
+    def frontier(self) -> int:
+        """Tokens prefilled so far (cached prefix included)."""
+        if self.done:
+            return len(self.prompt)
+        return self.chunks[self.next_idx][0]
 
 
 class EngineStateError(RuntimeError):
@@ -367,6 +399,14 @@ class ServingEngine:
         self._c_restarts = reg.counter("serving_engine_restarts_total",
                                        labels)
         self._c_appends = reg.counter("kv_block_appends_total", labels)
+        # chunked prefill + KV migration (the disaggregation spine)
+        self._c_chunks = reg.counter("prefill_chunks_total", labels)
+        self._h_chunk_tokens = reg.histogram("chunk_tokens", labels)
+        self._c_migrations = reg.counter("kv_migrations_total", labels)
+        self._c_migrated_blocks = reg.counter("kv_migrated_blocks_total",
+                                              labels)
+        self._h_migration = reg.histogram("migration_seconds", labels,
+                                          unit="s")
         # versioned weights (the deploy layer's hot-swap surface):
         # version 0 is the constructor's params; every successful
         # swap_params bumps it and moves the gauge
@@ -444,6 +484,12 @@ class ServingEngine:
                                    else self.decode_window - 1)
             self._spec_headroom = -(-self._write_horizon
                                     // self.kv_block_size)
+            # in-progress chunked prefills: slot -> ChunkedPrefill. The
+            # slot is NOT in free_slots but also NOT _active — decode
+            # dispatches mask it out, and its all-scratch table row
+            # routes ride-along writes into the scratch block until the
+            # final chunk commits the real ids
+            self._chunking: dict[int, ChunkedPrefill] = {}
         elif prefix_cache_blocks:
             if not 0 < prefix_block_size <= self.prefill_len:
                 raise ValueError(
@@ -496,6 +542,9 @@ class ServingEngine:
         for b, fn in self._prefill_fns.items():
             self._guard.watch(f"serving_prefill_{b}", fn)
         self._guard.watch("serving_decode", self._decode_fn)
+        if self.migration_supported:
+            self._guard.watch("serving_kv_gather", self._kv_gather_fn)
+            self._guard.watch("serving_kv_scatter", self._kv_scatter_fn)
         if self.prefix_cache is not None and not self.paged:
             self._guard.watch("serving_prefix_insert", self._insert_fn)
         if self.decode_window > 1:
@@ -544,6 +593,14 @@ class ServingEngine:
     @property
     def prefix_enabled(self) -> bool:
         return self.prefix_cache is not None
+
+    @property
+    def migration_supported(self) -> bool:
+        """KV block migration needs the paged store AND a single-device
+        layout: under TP the rows live head-sharded across the mesh and
+        the host-bounce gather/scatter pair is not built (documented
+        limitation — export raises, the router decodes in place)."""
+        return self.paged and self.model.tensor_axis is None
 
     # ------------------------------------------------------------------ #
     # program construction                                                #
@@ -865,6 +922,46 @@ class ServingEngine:
                               self.model.compute_dtype)
         return [{"k": z(), "v": z()} for _ in range(self.model.n_layers)]
 
+    def _kv_gather_body(self):
+        """Migration read side: pull ``n_max`` block rows (every array in
+        each layer dict — int8 rows AND their scales move as stored, no
+        dequant round-trip) out of the store by id. Junk trailing ids
+        gather scratch content the importer's ``n_used`` mask discards.
+        Compiled WITHOUT donation: export must leave the source store
+        intact so a failed handover can keep decoding in place."""
+        def body(store, ids):
+            with annotate("chainermn.kv_gather"):
+                return [{kk: jnp.take(layer[kk], ids, axis=0)
+                         for kk in layer} for layer in store]
+
+        return body
+
+    def _kv_scatter_body(self):
+        """Migration write side: land ``n_used`` gathered block rows into
+        freshly allocated ids of THIS store (donated — the store is
+        consumed and returned like every other program). Rows past
+        ``n_used`` carry the scratch id 0 and re-write scratch's current
+        content (identity), so the one compiled program covers every
+        migration size and duplicate padding ids stay deterministic."""
+        n_max = self._n_max
+
+        def body(store, ids, rows, n_used):
+            with annotate("chainermn.kv_scatter"):
+                valid = jnp.arange(n_max) < n_used
+                out = []
+                for layer, lrows in zip(store, rows):
+                    buf = dict(layer)
+                    for kk in layer:
+                        cur = jnp.take(buf[kk], ids, axis=0)
+                        mask = valid.reshape(
+                            (-1,) + (1,) * (cur.ndim - 1))
+                        buf[kk] = buf[kk].at[ids].set(
+                            jnp.where(mask, lrows[kk], cur))
+                    out.append(buf)
+                return out
+
+        return body
+
     def _build_fns(self):
         if self.paged:
             self._prefill_fns = {
@@ -873,6 +970,9 @@ class ServingEngine:
             }
             self._decode_fn = jax.jit(self._paged_decode_body(),
                                       donate_argnums=(1,))
+            self._kv_gather_fn = jax.jit(self._kv_gather_body())
+            self._kv_scatter_fn = jax.jit(self._kv_scatter_body(),
+                                          donate_argnums=(0,))
             if self._spec is not None:
                 self._spec_fn = jax.jit(self._spec_verify_body(),
                                         donate_argnums=(1,))
@@ -1115,6 +1215,16 @@ class ServingEngine:
                     self.params, self._store, jnp.asarray(self._tables),
                     jnp.asarray(self._token), jnp.asarray(self._pos),
                     jnp.asarray(self._active), self._keys)
+            if self.migration_supported:
+                # all-scratch ids + n_used=0: the gather reads scratch,
+                # the scatter re-writes scratch's own content — the one
+                # compile each covers every future migration size
+                mig_ids = jnp.zeros((self._n_max,), jnp.int32)
+                with self._watched("serving warmup kv_gather"):
+                    rows = self._kv_gather_fn(self._store, mig_ids)
+                with self._watched("serving warmup kv_scatter"):
+                    self._store = self._kv_scatter_fn(
+                        self._store, mig_ids, rows, jnp.int32(0))
             if self.decode_window > 1:
                 with self._watched("serving warmup decode_window"):
                     self._store, _, _ = self._window_fn(
@@ -1414,6 +1524,349 @@ class ServingEngine:
         self.peak_active = max(self.peak_active, self.active_slots)
         self._guard.check()
         return out
+
+    # ------------------------------------------------------------------ #
+    # chunked prefill (paged only)                                        #
+    # ------------------------------------------------------------------ #
+
+    def plan_chunks(self, plan: AdmitPlan,
+                    chunk_tokens: int) -> Optional[list]:
+        """Chunk schedule for a plan's suffix: split ``[start, len(prompt))``
+        into ``chunk_tokens``-sized pieces and pick each piece's bucket at
+        its own frontier. Returns ``[(frontier, chunk_len, bucket), ...]``
+        or ``None`` when chunking doesn't apply — non-paged engines, a
+        suffix that already fits one chunk (the one-shot path is strictly
+        better), or a chunk whose frontier leaves no bucket inside
+        ``cache_len`` (``bucket_for``'s ``start + b <= cache_len``
+        constraint; an out-of-range bucket would clamp table lookups onto
+        live blocks). ``None`` means: admit unchunked."""
+        if not self.paged:
+            return None
+        chunk_tokens = int(chunk_tokens)
+        if chunk_tokens < 1:
+            return None
+        plen = len(plan.prompt)
+        if plen - plan.start <= chunk_tokens:
+            return None
+        from chainermn_tpu.parallel.sequence import chunk_spans
+
+        chunks = []
+        for frontier, clen in chunk_spans(plan.start, plen, chunk_tokens):
+            bucket = self.bucket_for(clen, frontier)
+            if bucket is None:
+                return None
+            chunks.append((frontier, clen, bucket))
+        return chunks
+
+    def begin_chunked(self, plan: AdmitPlan, chunks: list) -> int:
+        """Stage a chunked admission: claim a free slot, allocate ALL the
+        prompt's blocks up front (shared prefix blocks referenced, not
+        copied — exactly :meth:`_paged_alloc_slot`'s accounting) and
+        reserve decode growth, but leave the slot's decode-table row
+        **all-scratch**: decode rounds interleaving with the chunks still
+        pass the full ``[n_slots]`` table, and the masked ride-along
+        write at this inactive slot's stale position must land in the
+        scratch block, never in a real (possibly trie-shared) block. The
+        real ids live privately in the :class:`ChunkedPrefill` until the
+        final chunk commits them. Consumes the plan (its match pin
+        converts into refcounts). Returns the claimed slot."""
+        if not self.paged:
+            raise RuntimeError("chunked prefill needs paged=True")
+        if not self.free_slots:
+            raise RuntimeError("no free slot for chunked prefill")
+        slot = min(self.free_slots)
+        bs = self.kv_block_size
+        plen = len(plan.prompt)
+        shared = (list(plan.match.block_ids)
+                  if plan.match is not None else [])
+        need_now = -(-plen // bs) - len(shared)
+        try:
+            new = self.prefix_cache.alloc_blocks_atomic(need_now)
+            if new is None:
+                raise RuntimeError(
+                    f"kv block pool exhausted: chunked slot {slot} needs "
+                    f"{need_now} blocks (free={self._pool.free_blocks})")
+            for block in shared:
+                self._pool.incref(block)   # the slot co-owns its prefix
+        finally:
+            self.cancel_plan(plan)
+        ids = shared + new
+        self._tables[slot, :] = 0          # stays scratch until commit
+        self._slot_reserved[slot] = (
+            -(-(plen + plan.max_new) // bs) - (-(-plen // bs))
+            + self._spec_headroom)
+        self._slot_blocks[slot] = list(ids)
+        self.free_slots.discard(slot)
+        self._chunking[slot] = ChunkedPrefill(
+            prompt=plan.prompt, rng=plan.rng, start=plan.start,
+            max_new=int(plan.max_new), ids=ids, chunks=list(chunks),
+            t_begin=time.perf_counter())
+        return slot
+
+    def chunk_state(self, slot: int) -> Optional[ChunkedPrefill]:
+        return self._chunking.get(slot)
+
+    def prefill_chunk(self, slot: int,
+                      ctx: Optional[dict] = None) -> Optional[int]:
+        """Run ONE staged chunk through its bucket's compiled prefill
+        program (row 0 carries the chunk at ``starts=frontier``; the
+        other rows ride inactive on all-scratch tables — the warmup
+        shapes, so nothing recompiles). Intermediate chunks discard the
+        sampled output and consume NO rng (their pad-tail garbage rows
+        are overwritten by the next chunk's writes before anything
+        attends them — the module's stale-rows induction, unchanged);
+        the FINAL chunk samples with the request's own rng (the one
+        admission split, sampler parity with a solo ``generate()``),
+        commits the slot's table/mirrors, and returns the first token.
+        Returns ``None`` after an intermediate chunk.
+
+        A raise before the device call leaves the staged state intact
+        (the scheduler may retry or release the slot); one that consumed
+        the donated store re-raises as :class:`EngineStateError`."""
+        st = self._chunking[slot]
+        frontier, clen, bucket = st.chunks[st.next_idx]
+        final = st.next_idx == len(st.chunks) - 1
+        k = self.prefill_batch
+        try:
+            with self._watched("serving chunk_prefill", **(ctx or {})), \
+                    annotate("chainermn.serving_chunk_prefill"):
+                inject(SERVING_CHUNK_PREFILL, slot=slot,
+                       chunk=st.next_idx, of=len(st.chunks),
+                       bucket=bucket, frontier=frontier)
+                tokens = np.zeros((k, bucket), np.int32)
+                starts = np.zeros((k,), np.int32)
+                last = np.zeros((k,), np.int32)
+                active = np.zeros((k,), bool)
+                table = np.zeros((k, self._n_max), np.int32)
+                keys = [jnp.zeros((2,), jnp.uint32)] * k
+                tokens[0, :clen] = st.prompt[frontier:frontier + clen]
+                starts[0] = frontier
+                last[0] = clen - 1
+                active[0] = True
+                table[0, : len(st.ids)] = st.ids
+                if final:
+                    keys[0] = st.rng
+                self._store, nxt, keys_out = self._prefill_fns[bucket](
+                    self.params, self._store, jnp.asarray(table),
+                    jnp.asarray(tokens), jnp.asarray(starts),
+                    jnp.asarray(last), jnp.asarray(active),
+                    jnp.stack(keys))
+                first = int(device_fetch(nxt)[0]) if final else None
+        except Exception as e:
+            if not self._state_ok():
+                raise EngineStateError(
+                    f"chunked prefill failed mid-device-call "
+                    f"({type(e).__name__}: {e}); donated store buffers "
+                    "are gone — restart required") from e
+            raise
+        st.next_idx += 1
+        self._c_chunks.inc()
+        self._c_prefills[bucket].inc()
+        self._h_chunk_tokens.observe(clen)
+        self._events.emit("prefill_chunk", slot=slot, chunk=st.next_idx,
+                          of=len(st.chunks), tokens=clen, bucket=bucket,
+                          frontier=frontier, final=final)
+        self._guard.check()
+        if not final:
+            return None
+        # final-chunk commit: the staged ids become the slot's decode
+        # table and the slot joins the active set — from here on it is
+        # indistinguishable from an unchunked admission
+        plen = len(st.prompt)
+        self._tables[slot, : len(st.ids)] = st.ids
+        self._token[slot] = first
+        self._pos[slot] = plen
+        self._active[slot] = True
+        self._keys = self._keys.at[slot].set(keys_out[0])
+        self._chunking.pop(slot)
+        self._events.emit("prefill", slot=slot, prompt_len=plen,
+                          bucket=bucket, cached=st.start, batch=1,
+                          blocks=len(st.ids), chunks=len(st.chunks))
+        if self._drafter is not None:
+            self._drafter.on_admit(slot, st.prompt, first)
+        if (self.prefix_cache.missing_blocks(st.prompt)
+                >= self._min_insert):
+            self.prefix_cache.insert_shared(st.prompt, st.ids)
+        self.peak_active = max(self.peak_active, self.active_slots)
+        return first
+
+    # ------------------------------------------------------------------ #
+    # KV block migration (paged, single-device)                           #
+    # ------------------------------------------------------------------ #
+
+    def export_slot_kv(self, slot: int,
+                       ctx: Optional[dict] = None) -> dict:
+        """Read an active slot's entire KV state out to the host: ONE
+        compiled gather dispatch (no donation — the source store is
+        untouched, so a failed handover keeps decoding in place) pulls
+        the slot's block rows, then ``np.asarray`` slices exactly
+        ``n_blocks`` rows per layer array off the device — bytes moved =
+        blocks x block_bytes, int8 rows + scales as stored, no dequant
+        round-trip. The payload plus the slot's host mirrors (position,
+        last token, sampler key) is everything a decode-tier engine needs
+        to continue the request token-exactly via
+        :meth:`import_slot_kv`. Read-only: the slot stays active here;
+        the caller releases it only after the import commits."""
+        if not self.migration_supported:
+            raise RuntimeError(
+                "KV migration needs paged=True on a single-device engine "
+                "(TP stores are head-sharded across the mesh)")
+        if not self._active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        t0 = time.perf_counter()
+        ids = list(self._slot_blocks[slot])
+        n = len(ids)
+        ids_op = np.zeros((self._n_max,), np.int32)
+        ids_op[:n] = ids
+        with self._watched("serving kv_gather", **(ctx or {})), \
+                annotate("chainermn.kv_gather"):
+            rows = self._kv_gather_fn(self._store, jnp.asarray(ids_op))
+        self._guard.check()
+        layers = [{kk: np.asarray(layer[kk][:n]) for kk in layer}
+                  for layer in rows]
+        return {
+            "n_blocks": n,
+            "block_size": self.kv_block_size,
+            "kv_quant": self.kv_quant,
+            "n_layers": self.model.n_layers,
+            "pos": int(self._pos[slot]),
+            "token": int(self._token[slot]),
+            "key": np.asarray(self._keys[slot]),
+            "layers": layers,
+            "t_start": t0,
+        }
+
+    def can_import(self, payload: dict, max_new: int = 1, *,
+                   static_only: bool = False) -> bool:
+        """Cheap host-side pre-check that :meth:`import_slot_kv` would
+        succeed here: layout agreement (block size / quant / layers /
+        row shapes), a free slot, and block budget for the resident
+        blocks plus remaining decode growth. ``static_only`` checks the
+        layout/position constraints alone — a False there means the
+        import can NEVER succeed on this engine (structural mismatch),
+        while a transient False (slots/blocks busy) clears on its own."""
+        if not self.migration_supported:
+            return False
+        if not static_only and not (self._warm and self.free_slots):
+            return False
+        if (int(payload["block_size"]) != self.kv_block_size
+                or str(payload["kv_quant"]) != self.kv_quant
+                or int(payload["n_layers"]) != self.model.n_layers):
+            return False
+        n = int(payload["n_blocks"])
+        if not 0 < n <= self._n_max:
+            return False
+        for kk, arr in payload["layers"][0].items():
+            if tuple(arr.shape[1:]) != tuple(self._store[0][kk].shape[1:]):
+                return False
+        pos = int(payload["pos"])
+        if pos + int(max_new) > self.cache_len:
+            return False
+        if static_only:
+            return True
+        bs = self.kv_block_size
+        need = (n + max(0, -(-(pos + int(max_new)) // bs) - n)
+                + self._spec_headroom)
+        return need <= self.kv_blocks_admittable()
+
+    def import_slot_kv(self, payload: dict, *,
+                       prompt: Optional[np.ndarray] = None,
+                       max_new: int = 1,
+                       ctx: Optional[dict] = None) -> int:
+        """Land a migrated request into THIS engine: allocate fresh
+        blocks, scatter the host rows in with the compiled-once pair's
+        write side (rows padded back to the static ``[n_max]`` operand —
+        the pad tail carries scratch ids and identity content), and
+        commit the slot mirrors (position/token/sampler key) so the next
+        decode round continues the request token-exactly. When
+        ``prompt`` is given, its full blocks are adopted into this
+        engine's prefix trie (``insert_shared`` — the migrated prefix
+        becomes ground truth here, not router belief). Returns the slot.
+        Raises ``RuntimeError`` (layout/budget) with the engine intact —
+        the caller's fallback is decoding in place at the source."""
+        if not self.migration_supported:
+            raise RuntimeError(
+                "KV migration needs paged=True on a single-device engine")
+        if (int(payload["block_size"]) != self.kv_block_size
+                or str(payload["kv_quant"]) != self.kv_quant
+                or int(payload["n_layers"]) != self.model.n_layers):
+            raise RuntimeError(
+                "migration layout mismatch: source/dest engines disagree "
+                "on block_size/kv_quant/n_layers")
+        if not self.free_slots:
+            raise RuntimeError("no free slot for migration import")
+        n = int(payload["n_blocks"])
+        if not 0 < n <= self._n_max:
+            raise RuntimeError(
+                f"migration carries {n} blocks; this engine's tables "
+                f"hold at most {self._n_max}")
+        pos = int(payload["pos"])
+        if pos + int(max_new) > self.cache_len:
+            raise RuntimeError(
+                f"migrated position {pos} + {max_new} new tokens exceed "
+                f"cache_len={self.cache_len}")
+        new = self.prefix_cache.alloc_blocks_atomic(n)
+        if new is None:
+            raise RuntimeError(
+                f"kv block pool exhausted: import needs {n} blocks "
+                f"(free={self._pool.free_blocks})")
+        slot = min(self.free_slots)
+        bs = self.kv_block_size
+        ids_op = np.zeros((self._n_max,), np.int32)
+        ids_op[:n] = new
+        try:
+            rows = []
+            for layer, st_layer in zip(payload["layers"], self._store):
+                full = {}
+                for kk, arr in layer.items():
+                    pad = np.zeros((self._n_max,) + tuple(arr.shape[1:]),
+                                   arr.dtype)
+                    pad[:n] = arr
+                    full[kk] = jnp.asarray(pad)
+                rows.append(full)
+            with self._watched("serving kv_scatter", **(ctx or {})), \
+                    annotate("chainermn.kv_scatter"):
+                self._store = self._kv_scatter_fn(
+                    self._store, jnp.asarray(ids_op), rows, jnp.int32(n))
+        except Exception as e:
+            for block in new:
+                self._pool.decref(block)
+            if not self._state_ok():
+                raise EngineStateError(
+                    f"migration import failed mid-device-call "
+                    f"({type(e).__name__}: {e}); donated store buffers "
+                    "are gone — restart required") from e
+            raise
+        self._guard.check()
+        self.free_slots.discard(slot)
+        self._tables[slot, :] = 0
+        self._tables[slot, :n] = new
+        self._slot_blocks[slot] = list(new)
+        self._slot_reserved[slot] = (
+            max(0, -(-(pos + int(max_new)) // bs) - n)
+            + self._spec_headroom)
+        self._pos[slot] = pos
+        self._token[slot] = int(payload["token"])
+        self._active[slot] = True
+        self._keys = self._keys.at[slot].set(jnp.asarray(payload["key"]))
+        seconds = time.perf_counter() - float(payload.get("t_start", 0.0)) \
+            if payload.get("t_start") else 0.0
+        self._c_migrations.inc()
+        self._c_migrated_blocks.inc(n)
+        if seconds > 0.0:
+            self._h_migration.observe(seconds)
+        self._events.emit("kv_migrate", slot=slot, blocks=n, pos=pos,
+                          seconds=round(seconds, 6))
+        if self._drafter is not None and prompt is not None:
+            self._drafter.on_admit(slot, np.asarray(prompt, np.int32),
+                                   int(payload["token"]))
+        if prompt is not None:
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+            if (self.prefix_cache.missing_blocks(prompt)
+                    >= self._min_insert):
+                self.prefix_cache.insert_shared(prompt, new)
+        self.peak_active = max(self.peak_active, self.active_slots)
+        return slot
 
     def blocks_needed(self, prompt_len: int, max_new: int,
                       start: int = 0) -> int:
@@ -1824,6 +2277,11 @@ class ServingEngine:
             self._slot_blocks[slot] = []
             self._slot_reserved[slot] = 0
             self._tables[slot, :] = 0
+            # a half-prefilled chunked slot releases the same way: its
+            # staged ids ARE _slot_blocks, so cancel/preempt/deadline
+            # mid-chunk leaks nothing (replay reproduces the tokens from
+            # the same prompt + rng)
+            self._chunking.pop(slot, None)
         if self._drafter is not None:
             self._drafter.on_release(slot)
         self._active[slot] = False
@@ -1861,6 +2319,7 @@ class ServingEngine:
             self._tables[:] = 0
             self._slot_blocks = [[] for _ in range(self.n_slots)]
             self._slot_reserved[:] = 0
+            self._chunking.clear()
         self._pending_inserts = []
         self._token[:] = 0
         self._pos[:] = 0
@@ -1943,6 +2402,9 @@ class ServingEngine:
         out = {f"prefill_{b}": int(fn._cache_size())
                for b, fn in self._prefill_fns.items()}
         out["decode"] = int(self._decode_fn._cache_size())
+        if self.migration_supported:
+            out["kv_gather"] = int(self._kv_gather_fn._cache_size())
+            out["kv_scatter"] = int(self._kv_scatter_fn._cache_size())
         if self.prefix_cache is not None and not self.paged:
             out["prefix_insert"] = int(self._insert_fn._cache_size())
         if self._spec is not None:
@@ -1987,4 +2449,5 @@ class ServingEngine:
         }
 
 
-__all__ = ["AdmitPlan", "EngineStateError", "ServingEngine"]
+__all__ = ["AdmitPlan", "ChunkedPrefill", "EngineStateError",
+           "ServingEngine"]

@@ -486,6 +486,20 @@ class PrefixCacheIndex:
     # the shared-pool refactor)
     _alloc = alloc_blocks
 
+    def alloc_blocks_atomic(self, n: int) -> Optional[list]:
+        """All-or-nothing :meth:`alloc_blocks`: exactly ``n`` blocks, or
+        ``None`` with every partially-allocated block already returned to
+        the pool. The KV-migration import and chunked-prefill staging
+        paths allocate through this — both must leave the pool untouched
+        on a shortfall, because their fallback (decode at the source /
+        retry the admission next step) assumes nothing was consumed."""
+        out = self.alloc_blocks(int(n))
+        if len(out) < int(n):
+            for block in out:
+                self.pool.decref(block)
+            return None
+        return out
+
     def evictable_blocks(self) -> int:
         """How many blocks eviction could *actually return to the free
         list* right now: nodes in fully-unpinned subtrees whose block has

@@ -39,6 +39,30 @@ preempts the LOWEST-priority (newest) request back to the queue — its
 re-admission replays prompt+rng from scratch, reproducing the identical
 token stream — rather than failing anyone or burning a restart.
 
+Chunked prefill (PR 19): with ``chunk_tokens_per_step=N`` on a paged
+engine, a long prompt whose suffix exceeds ``N`` tokens admits as a
+**chunked** prefill instead of one monolithic device call — the engine
+stages the slot (:meth:`ServingEngine.begin_chunked`) and the request
+enters ``PREFILLING``; each subsequent step advances exactly ONE chunk
+(:meth:`_advance_chunks`) through the same compiled bucket programs the
+batched path uses (zero recompiles), interleaved with every decode step,
+so a 1k-token prompt no longer stalls in-flight decodes for its whole
+prefill. The final chunk samples with the request's own rng (one
+admission split — token parity with the unchunked path and with a solo
+``generate()``), commits the slot, and the request proceeds to DECODE
+exactly as if it had admitted unchunked.
+
+KV migration (disaggregated prefill/decode tiers): when a supervising
+layer sets :attr:`migrate_cb`, a request that just completed its prefill
+(chunked or not) is offered for handover — the slot's KV blocks are read
+out host-side (:meth:`ServingEngine.export_slot_kv`) and the callback
+decides placement. On ``True`` the SAME :class:`Request` object now
+belongs to the destination scheduler (:meth:`enqueue_migrated` /
+``_pending_imports``; its ``stream_cb``/trace/``_done`` ride along, so
+consumers never notice the move) and the source frees the slot; on
+``False`` — or any export/handshake failure — the request simply keeps
+decoding in place. Never a lost request, by construction.
+
 Graceful degradation (the resilience layer):
 
 - **Bounded admission** — ``max_queue`` rejects overload at submit time
@@ -137,6 +161,7 @@ class DeadlineExceededError(TimeoutError):
 class RequestState(enum.Enum):
     QUEUED = "queued"
     PREFILL = "prefill"
+    PREFILLING = "prefilling"   # chunked prefill in progress (owns a slot)
     DECODE = "decode"
     DONE = "done"
     CANCELLED = "cancelled"
@@ -300,9 +325,14 @@ class FCFSScheduler:
                  max_prefills_per_step: Optional[int] = None,
                  tracer=None, cost_accounting: bool = True,
                  fair=None, tenant_weights=None,
-                 brownout: Optional[BrownoutPolicy] = None) -> None:
+                 brownout: Optional[BrownoutPolicy] = None,
+                 chunk_tokens_per_step: Optional[int] = None) -> None:
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if chunk_tokens_per_step is not None and chunk_tokens_per_step < 1:
+            raise ValueError(
+                f"chunk_tokens_per_step must be >= 1, got "
+                f"{chunk_tokens_per_step}")
         self.engine = engine
         self.eos_id = eos_id
         self.metrics = metrics or ServingMetrics(engine.n_slots)
@@ -352,6 +382,14 @@ class FCFSScheduler:
         # brownout ladder (PR 18): consulted every step when present —
         # pauses batch, forces single-token decode, caps max_new, sheds
         self._brownout = brownout
+        # chunked prefill (PR 19): only meaningful on a paged engine with
+        # the chunked path built; harmless (never triggers) elsewhere
+        self._chunk_tokens = (int(chunk_tokens_per_step)
+                              if chunk_tokens_per_step is not None else None)
+        # KV migration handover hook: ``cb(req, payload) -> bool`` set by
+        # a supervising layer (the fleet router's disaggregated tiers).
+        # On True the callback took ownership of the request; None = off.
+        self.migrate_cb: Optional[Callable] = None
         self._lock = sanitizer.make_lock("FCFSScheduler._lock")
         # sanitizer-guarded: mutating either without _lock held raises
         # when the runtime sanitizer is on (lock-discipline, enforced)
@@ -359,6 +397,14 @@ class FCFSScheduler:
             deque(), lock=self._lock, name="FCFSScheduler._queue")
         self._by_slot: dict[int, Request] = sanitizer.guarded(
             {}, lock=self._lock, name="FCFSScheduler._by_slot")
+        # slot -> request mid-chunked-prefill (disjoint from _by_slot:
+        # a PREFILLING slot takes no decode token and appends no blocks)
+        self._prefilling: dict[int, Request] = sanitizer.guarded(
+            {}, lock=self._lock, name="FCFSScheduler._prefilling")
+        # migrated-in requests awaiting a slot: (req, kv payload) pairs,
+        # admitted FCFS at step() start once the engine can take them
+        self._pending_imports: deque = sanitizer.guarded(
+            deque(), lock=self._lock, name="FCFSScheduler._pending_imports")
         self._ids = itertools.count()
         self._pending_swap: Optional[SwapTicket] = None
 
@@ -432,7 +478,21 @@ class FCFSScheduler:
                 try:
                     self._queue.remove(req)
                 except ValueError:
-                    return False
+                    # not in the queue: a migrated-in request awaiting a
+                    # slot? (mid-handover requests belong to nobody yet
+                    # and report un-cancellable, same as the ValueError)
+                    for i, (r, _) in enumerate(self._pending_imports):
+                        if r is req:
+                            del self._pending_imports[i]
+                            break
+                    else:
+                        return False
+            elif req.state is RequestState.PREFILLING:
+                # mid-chunked-prefill: the driving thread owns the slot's
+                # staged chunk state — it sees CANCELLED at the next
+                # chunk tick and releases the slot itself (releasing here
+                # would race the in-flight chunk's commit)
+                pass
             elif req.slot >= 0:
                 self.engine.release(req.slot)
                 self._by_slot.pop(req.slot, None)
@@ -452,6 +512,8 @@ class FCFSScheduler:
     def has_work(self) -> bool:
         with self._lock:
             return (bool(self._queue) or bool(self._by_slot)
+                    or bool(self._prefilling)
+                    or bool(self._pending_imports)
                     or self._pending_swap is not None)
 
     @property
@@ -478,6 +540,12 @@ class FCFSScheduler:
         with self._lock:
             drained = list(self._queue)
             self._queue.clear()
+            # migrated-in work still waiting for a slot is QUEUED work
+            # too: it never started decoding HERE, so the supervising
+            # layer replays it (prompt + rng) on a healthy replica —
+            # kill-mid-migration loses nothing
+            drained.extend(req for req, _ in self._pending_imports)
+            self._pending_imports.clear()
         for req in drained:
             if self.costs is not None:
                 self.costs.finalize(req.id)
@@ -495,7 +563,7 @@ class FCFSScheduler:
         already errored by the step's own exception boundary is left
         untouched."""
         with self._lock:
-            has_inflight = bool(self._by_slot)
+            has_inflight = bool(self._by_slot) or bool(self._prefilling)
             ticket, self._pending_swap = self._pending_swap, None
         if ticket is not None:
             # a publisher waiting on this ticket must hear about the
@@ -550,7 +618,8 @@ class FCFSScheduler:
         # calls, on the one thread that owns the engine
         with self._lock:
             swapping = self._pending_swap is not None
-            if swapping and not self._by_slot:
+            if (swapping and not self._by_slot and not self._prefilling
+                    and not self._pending_imports):
                 ticket, self._pending_swap = self._pending_swap, None
                 swapping = False
             else:
@@ -559,7 +628,13 @@ class FCFSScheduler:
             self._execute_swap(ticket)
         # 1. admission: one group (>= 1 same-bucket requests, one device
         # call) per iteration, FCFS-anchored; bounded prefill interleave
-        # in cost-aware mode so a deep queue can't stall decode
+        # in cost-aware mode so a deep queue can't stall decode.
+        # Migrated-in requests admit first: their device time is already
+        # spent elsewhere, they only need a slot + one scatter. They
+        # admit even through a swap fence — they STARTED on the current
+        # weights elsewhere, so they must finish on them here (the fence
+        # simply waits for them like any other in-flight work)
+        self._admit_imports()
         with annotate("chainermn.serving_admit"):
             calls = 0
             while not swapping and self.engine.free_slots and (
@@ -569,6 +644,12 @@ class FCFSScheduler:
                     break
                 calls += 1
                 emitted += self._admit_group(group)
+        # 1a. chunked prefill: advance the oldest PREFILLING request by
+        # exactly ONE chunk — the bounded slice of prefill work that
+        # interleaves with this step's decode. Runs through a swap fence
+        # too: a staged chunked admission already started on the current
+        # weights, so the fence waits for it rather than stranding it
+        emitted += self._advance_chunks()
         # 1b. paged: make sure every active slot can take this step's
         # token — lazily append blocks for slots crossing a block
         # boundary, preempting (requeueing, not failing) the lowest-
@@ -726,6 +807,19 @@ class FCFSScheduler:
                 self._defer_admission(head, plan, need, budget)
                 return []
             budget -= need
+        # chunked prefill: a long suffix admits as a staged chunk
+        # schedule instead of one monolithic device call — the same
+        # block-budget gate above already cleared its worst-case growth.
+        # plan_chunks returns None when chunking doesn't apply (suffix
+        # fits one chunk, or a frontier outgrows every bucket): fall
+        # through to the ordinary one-shot admission
+        if (paged and self._chunk_tokens is not None
+                and len(head.prompt) - plan.start > self._chunk_tokens
+                and hasattr(eng, "plan_chunks")):
+            chunks = eng.plan_chunks(plan, self._chunk_tokens)
+            if chunks is not None:
+                self._begin_chunked(head, plan, chunks)
+                return []
         group = [(head, plan)]
         if cap <= 1:
             return group
@@ -929,6 +1023,10 @@ class FCFSScheduler:
                                             cached_frac=plan.cached_frac)
             self._deliver(req, first, now)
             emitted += 1
+            if not req.finished:
+                # prefill done in one shot — a disaggregated fleet may
+                # still want the decode phase elsewhere
+                self._maybe_migrate(req, slot)
         return emitted
 
     def _execute_swap(self, ticket: SwapTicket) -> None:
@@ -983,6 +1081,258 @@ class FCFSScheduler:
             req.trace.mark_error(type(e).__name__)
             req.trace.finish(reason="admission_error")
             req._done.set()
+
+    # ------------------------------------------------------------------ #
+    # chunked prefill + KV migration (PR 19)                              #
+    # ------------------------------------------------------------------ #
+
+    def _begin_chunked(self, req: Request, plan, chunks: list) -> None:
+        """Stage ``req`` as a chunked admission: the engine claims a slot
+        and allocates the prompt's blocks up front (the block-budget gate
+        already cleared worst-case growth), the request enters
+        ``PREFILLING``, and :meth:`_advance_chunks` runs one chunk per
+        step from here on. The plan is consumed either way; a transient
+        staging failure re-queues the head at the FRONT (FCFS preserved,
+        it retries next step)."""
+        eng = self.engine
+        try:
+            slot = eng.begin_chunked(plan, chunks)
+        except Exception as e:  # noqa: BLE001 — containment boundary
+            if req._span_admit is not None:
+                req.trace.end_span(req._span_admit)
+                req._span_admit = None
+            req._span_queue = req.trace.start_span("queue")
+            req._t_enqueue = time.perf_counter()
+            with self._lock:
+                req.state = RequestState.QUEUED
+                self._queue.appendleft(req)
+            self._events.emit("kv_admit_defer", req=req.id,
+                              error=type(e).__name__,
+                              **self._trace_label(req))
+            return
+        with self._lock:
+            if req.state is RequestState.CANCELLED:
+                # cancelled while staging (it had no slot yet, so
+                # cancel() left the release to us)
+                eng.release(slot)
+                return
+            req.state = RequestState.PREFILLING
+            req.slot = slot
+            self._prefilling[slot] = req
+            req.weight_version = getattr(eng, "weight_version", None)
+        if req._span_admit is not None:
+            req.trace.end_span(req._span_admit)
+            req._span_admit = None
+        self._events.emit("slot_admit", req=req.id, slot=slot,
+                          prompt_len=len(req.prompt),
+                          bucket=chunks[0][2], cached=plan.start,
+                          chunks=len(chunks),
+                          queue_depth=self.queue_depth,
+                          **self._trace_label(req))
+
+    def _advance_chunks(self) -> int:
+        """Advance the OLDEST ``PREFILLING`` request by exactly one chunk
+        (one bounded device call per step — decode stall stays capped at
+        one chunk regardless of prompt length). The final chunk commits
+        the slot, records TTFT, delivers the first token, and offers the
+        request for KV migration. Returns first tokens emitted (0/1)."""
+        with self._lock:
+            if not self._prefilling:
+                return 0
+            slot, req = min(self._prefilling.items(),
+                            key=lambda kv: kv[1].id)
+        if req.finished:
+            # cancelled mid-chunking: cancel() deferred the slot release
+            # to this (the driving) thread — no in-flight chunk to race
+            with self._lock:
+                self._prefilling.pop(slot, None)
+            self.engine.release(slot)
+            return 0
+        st = self.engine.chunk_state(slot)
+        if st is None:   # engine restarted under us: nothing staged left
+            with self._lock:
+                self._prefilling.pop(slot, None)
+            return 0
+        _, clen, bucket = st.chunks[st.next_idx]
+        idx, total = st.next_idx, len(st.chunks)
+        ctx = {"reqs": [req.id]}
+        if req.trace.enabled:
+            ctx["traces"] = [req.trace.trace_id]
+        t0 = time.perf_counter()
+        try:
+            first = self.engine.prefill_chunk(slot, ctx=ctx)
+        except Exception as e:  # noqa: BLE001 — degradation boundary
+            if not self._engine_failure(e):
+                raise
+            return 0
+        t1 = time.perf_counter()
+        if self.costs is not None:
+            # each chunk is one full prefill_batch x bucket device call
+            # with a single occupied row — the empty rows and the
+            # intra-row padding book as `padding`, same as a batch of 1
+            self.costs.record_prefill(
+                t1 - t0, bucket=bucket,
+                batch_rows=self.engine.prefill_batch,
+                members=[(req.id, req.tenant, clen)])
+        req.trace.add_span("prefill_chunk", t0, t1, bucket=bucket,
+                           chunk=idx, of=total, tokens=clen, slot=slot)
+        if first is None:
+            return 0
+        with self._lock:
+            self._prefilling.pop(slot, None)
+            if req.state is RequestState.CANCELLED:
+                self.engine.release(slot)
+                return 0
+            req.state = RequestState.DECODE
+            self._by_slot[slot] = req
+        now = time.perf_counter()
+        self.metrics.record_first_token(
+            req.t_submit, now, req_id=req.id,
+            cached_frac=(st.start / len(st.prompt)
+                         if len(st.prompt) else 0.0))
+        self._deliver(req, first, now)
+        if not req.finished:
+            self._maybe_migrate(req, slot)
+        return 1
+
+    def _maybe_migrate(self, req: Request, slot: int) -> bool:
+        """Offer a prefill-complete request to :attr:`migrate_cb` for
+        handover to a decode-tier peer. The slot's KV blocks are read out
+        host-side first (read-only gather — the slot keeps decoding in
+        place if anything below fails), then the callback places the
+        request: on True the SAME Request object now belongs to the
+        destination scheduler and the slot is released here; on False —
+        or an export/callback raise — the request is re-bound to its slot
+        unchanged. Never a lost request."""
+        cb = self.migrate_cb
+        if cb is None or not getattr(self.engine, "migration_supported",
+                                     False):
+            return False
+        t0 = time.perf_counter()
+        try:
+            payload = self.engine.export_slot_kv(
+                slot, ctx={"reqs": [req.id]})
+        except Exception:  # noqa: BLE001 — fall back to decoding in place
+            return False
+        t1 = time.perf_counter()
+        n_tokens = len(req.tokens)
+        # all request-side bookkeeping happens BEFORE the callback: on
+        # True the destination owns the object immediately (possibly
+        # already admitting it on its own thread)
+        req.trace.add_span("migrate", t0, t1, blocks=payload["n_blocks"],
+                           src_slot=slot)
+        req._span_queue = req.trace.start_span("queue")
+        req._t_enqueue = time.perf_counter()
+        with self._lock:
+            self._by_slot.pop(slot, None)
+            req.state = RequestState.QUEUED
+            req.slot = -1
+        try:
+            ok = bool(cb(req, payload))
+        except Exception:  # noqa: BLE001 — handshake failure = stay local
+            ok = False
+        if not ok:
+            # decode in place: re-bind the slot exactly as it was
+            if req._span_queue is not None:
+                req.trace.end_span(req._span_queue)
+                req._span_queue = None
+            with self._lock:
+                req.state = RequestState.DECODE
+                req.slot = slot
+                self._by_slot[slot] = req
+            return False
+        if self.costs is not None:
+            self.costs.record_migration(t1 - t0, req_id=req.id,
+                                        tenant=req.tenant)
+            self.costs.finalize(req.id)
+        self.engine.release(slot)
+        self._events.emit("slot_retire", req=req.id, slot=slot,
+                          reason="migrated", tokens=n_tokens,
+                          **self._trace_label(req))
+        return True
+
+    def enqueue_migrated(self, req: Request, payload: dict) -> Request:
+        """Accept a prefill-complete request handed over from another
+        scheduler (thread-safe). The SAME Request object continues here —
+        its tokens/stream_cb/trace/``_done`` ride along, so the consumer
+        never notices the move. It waits in the import queue until the
+        engine can take the scatter (:meth:`_admit_imports` — FCFS among
+        imports, ahead of fresh admissions)."""
+        with self._lock:
+            self._pending_imports.append((req, payload))
+        return req
+
+    def _admit_imports(self) -> None:
+        """Land pending migrated-in requests (FCFS, head-of-line: a
+        transient slot/block shortage waits rather than reordering). A
+        structurally unplaceable payload fails its request loudly so a
+        supervising layer replays it elsewhere; a scatter that consumed
+        the donated store escalates through the engine-failure boundary.
+        Either way: never silently stuck, never silently lost."""
+        eng = self.engine
+        while True:
+            with self._lock:
+                if not self._pending_imports:
+                    return
+                req, payload = self._pending_imports[0]
+            if req.finished:
+                with self._lock:
+                    if (self._pending_imports
+                            and self._pending_imports[0][0] is req):
+                        self._pending_imports.popleft()
+                continue
+            remaining = max(1, req.max_new_tokens - len(req.tokens))
+            if not eng.can_import(payload, max_new=remaining):
+                if eng.can_import(payload, max_new=remaining,
+                                  static_only=True):
+                    return   # transient: slots/blocks free up later
+                with self._lock:
+                    if (self._pending_imports
+                            and self._pending_imports[0][0] is req):
+                        self._pending_imports.popleft()
+                self._fail_group([req], RuntimeError(
+                    "migrated payload can never land on this engine "
+                    "(block layout / position / capacity mismatch)"))
+                continue
+            t0 = time.perf_counter()
+            try:
+                slot = eng.import_slot_kv(payload, prompt=req.prompt,
+                                          max_new=remaining,
+                                          ctx={"reqs": [req.id]})
+            except EngineStateError as e:
+                with self._lock:
+                    if (self._pending_imports
+                            and self._pending_imports[0][0] is req):
+                        self._pending_imports.popleft()
+                if not self._engine_failure(e, admitting=req):
+                    raise
+                return
+            except Exception:  # noqa: BLE001 — engine intact: retry later
+                return
+            t1 = time.perf_counter()
+            with self._lock:
+                if (self._pending_imports
+                        and self._pending_imports[0][0] is req):
+                    self._pending_imports.popleft()
+                if req.state is RequestState.CANCELLED:
+                    eng.release(slot)
+                    continue
+                req.slot = slot
+                req.state = RequestState.DECODE
+                self._by_slot[slot] = req
+                req.weight_version = getattr(eng, "weight_version", None)
+            if req._span_queue is not None:
+                req.trace.end_span(req._span_queue)
+                req._span_queue = None
+            req.trace.add_span("import", t0, t1, slot=slot,
+                               blocks=payload["n_blocks"])
+            if self.costs is not None:
+                self.costs.record_queue_wait(
+                    req.tenant, time.perf_counter() - req._t_enqueue)
+            self._events.emit("slot_admit", req=req.id, slot=slot,
+                              prompt_len=len(req.prompt), migrated=True,
+                              queue_depth=self.queue_depth,
+                              **self._trace_label(req))
 
     # ------------------------------------------------------------------ #
     # paged-KV block management (decode-side)                             #
@@ -1091,8 +1441,11 @@ class FCFSScheduler:
         now = time.perf_counter()
         expired: list[Request] = []
         decode_expired: list[Request] = []
+        prefill_expired: list[Request] = []
         with self._lock:
-            if not self._queue and not self._by_slot:
+            if (not self._queue and not self._by_slot
+                    and not self._prefilling
+                    and not self._pending_imports):
                 return
             hint = self._retry_after_locked()
             if self._queue:
@@ -1125,7 +1478,44 @@ class FCFSScheduler:
                 req.state = RequestState.ERRORED
                 self.metrics.record_shed()
                 decode_expired.append(req)
-        for req in expired + decode_expired:
+            # chunked prefills past deadline: this sweep runs on the
+            # driving thread between steps, so no chunk is in flight and
+            # the slot release cannot race a commit
+            for slot in sorted(self._prefilling):
+                req = self._prefilling[slot]
+                if req.t_deadline is None or now < req.t_deadline:
+                    continue
+                self.engine.release(slot)
+                self._prefilling.pop(slot, None)
+                req.error = DeadlineExceededError(
+                    f"request {req.id} passed its {req.deadline_s}s "
+                    "deadline mid chunked prefill",
+                    retry_after_s=hint,
+                )
+                req.state = RequestState.ERRORED
+                self.metrics.record_shed()
+                prefill_expired.append(req)
+            if self._pending_imports:
+                keep_imp: deque = deque()
+                for item in self._pending_imports:
+                    req = item[0]
+                    if (req.t_deadline is not None
+                            and now >= req.t_deadline):
+                        req.error = DeadlineExceededError(
+                            f"request {req.id} passed its "
+                            f"{req.deadline_s}s deadline awaiting its "
+                            "KV migration import",
+                            retry_after_s=hint,
+                        )
+                        req.state = RequestState.ERRORED
+                        self.metrics.record_shed()
+                        expired.append(req)
+                    else:
+                        keep_imp.append(item)
+                self._pending_imports = sanitizer.guarded(
+                    keep_imp, lock=self._lock,
+                    name="FCFSScheduler._pending_imports")
+        for req in expired + decode_expired + prefill_expired:
             if self.costs is not None:
                 self.costs.finalize(req.id)
             # deadline-missed traces are retained regardless of sampling
@@ -1135,6 +1525,8 @@ class FCFSScheduler:
             req.trace.finish(reason="shed")
             self._events.emit("shed", req=req.id,
                               where=("decode" if req in decode_expired
+                                     else "prefill"
+                                     if req in prefill_expired
                                      else "queue"),
                               waited_s=round(now - req.t_submit, 6),
                               **self._trace_label(req))
@@ -1227,6 +1619,11 @@ class FCFSScheduler:
         with self._lock:
             victims = list(self._by_slot.values())
             self._by_slot.clear()
+            # half-prefilled chunked requests die with the store too;
+            # pending KV imports are KEPT — their payloads are host-side
+            # copies, importable onto the restarted engine as-is
+            victims.extend(self._prefilling.values())
+            self._prefilling.clear()
             victims.extend(admitting)
             for req in victims:
                 if req.finished:

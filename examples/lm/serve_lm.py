@@ -96,6 +96,15 @@ max-new cap -> shed-lowest-weight-tenant, each step edge-logged and fully
 reversible once the queue drains; the episode (levels hit, steps, final
 level) prints at the end next to the per-tenant cost table.
 
+And chunked prefill + disaggregated tiers (ISSUE 19): ``--chunk-tokens
+N`` (with ``--paged-kv``) prefills long prompts N tokens per scheduler
+step interleaved with decode — resident streams stop stalling for whole
+long prefills; ``--prefill-replicas P --decode-replicas D`` splits the
+fleet into tiers: new requests prefill on the first P replicas, then
+their KV blocks migrate host-bounce to a decode replica (same token
+stream, rng and position ride along; a failed migration just decodes in
+place). The migration counters print with the fleet report.
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -125,6 +134,11 @@ Run (CPU mesh; any accelerator works the same)::
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/lm/serve_lm.py --autoscale --min-replicas 1 \
         --max-replicas 3 --slots 1 --requests 24 --canary
+
+    # chunked prefill + disaggregated prefill/decode tiers:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/lm/serve_lm.py --paged-kv --chunk-tokens 4 \
+        --prefill-replicas 1 --decode-replicas 1 --verify-parity
 """
 
 from __future__ import annotations
@@ -206,9 +220,25 @@ def main() -> None:
     ap.add_argument("--spec-k", type=int, default=4,
                     help="speculative draft window: tokens proposed per "
                          "verify round")
+    ap.add_argument("--chunk-tokens", type=int, default=0,
+                    help="chunked prefill (ISSUE 19, needs --paged-kv): "
+                         "prefill long prompts this many tokens per "
+                         "scheduler step, interleaved with decode of "
+                         "resident slots, instead of one monolithic "
+                         "bucket call (0: off)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="run this many engine replicas behind the fleet "
                          "router (1: the plain single-engine client)")
+    ap.add_argument("--prefill-replicas", type=int, default=0,
+                    help="disaggregated tiers (ISSUE 19, needs "
+                         "--paged-kv and --decode-replicas): the first P "
+                         "replicas take every new request's prefill; on "
+                         "completion the KV blocks migrate host-bounce "
+                         "to a decode-tier replica (0: symmetric fleet)")
+    ap.add_argument("--decode-replicas", type=int, default=0,
+                    help="disaggregated tiers: replicas that only take "
+                         "migrated-in decode work (give with "
+                         "--prefill-replicas; the fleet size is P+D)")
     ap.add_argument("--affinity", dest="affinity", action="store_true",
                     default=True,
                     help="prefix-affinity routing (default): requests "
@@ -450,9 +480,24 @@ def main() -> None:
             max_level=args.brownout, queue_high=float(args.slots),
             up_after_s=0.05, down_after_s=0.2, cooldown_s=0.1)
         fair_kw["brownout"] = brownout_policy
-    fleet_mode = args.replicas > 1 or args.autoscale
-    n_start = (max(args.replicas, args.min_replicas) if args.autoscale
-               else args.replicas)
+    if args.chunk_tokens and not args.paged_kv:
+        raise SystemExit("--chunk-tokens stages chunks on the shared "
+                         "block store; add --paged-kv")
+    tiered = bool(args.prefill_replicas or args.decode_replicas)
+    if tiered:
+        if not (args.prefill_replicas and args.decode_replicas):
+            raise SystemExit("disaggregated tiers need BOTH "
+                             "--prefill-replicas and --decode-replicas")
+        if not args.paged_kv:
+            raise SystemExit("KV migration moves block-store rows; the "
+                             "tiers need --paged-kv")
+        if args.autoscale:
+            raise SystemExit("--autoscale resizes a symmetric fleet; "
+                             "static tiers don't mix with it")
+    fleet_mode = args.replicas > 1 or args.autoscale or tiered
+    n_start = (args.prefill_replicas + args.decode_replicas if tiered
+               else max(args.replicas, args.min_replicas)
+               if args.autoscale else args.replicas)
     eos = None if args.eos_id < 0 else args.eos_id
     if fleet_mode:
         from chainermn_tpu.fleet import FleetRouter
@@ -460,10 +505,14 @@ def main() -> None:
         engines = [ServingEngine(model, params, **engine_kw)
                    for _ in range(n_start)]
         engine = engines[0]
+        tier_kw = dict(prefill_replicas=args.prefill_replicas,
+                       decode_replicas=args.decode_replicas) if tiered \
+            else {}
         front = FleetRouter(engines, eos_id=eos, affinity=args.affinity,
                             max_queue=args.max_queue or None,
                             default_deadline_s=args.deadline or None,
-                            **fair_kw)
+                            chunk_tokens_per_step=args.chunk_tokens
+                            or None, **tier_kw, **fair_kw)
         front.wait_ready(600)   # every replica warm, off the burst clock
     else:
         engine = ServingEngine(model, params, **engine_kw)
@@ -471,7 +520,8 @@ def main() -> None:
         front = ServingClient(engine, eos_id=eos,
                               max_queue=args.max_queue or None,
                               default_deadline_s=args.deadline or None,
-                              **fair_kw)
+                              chunk_tokens_per_step=args.chunk_tokens
+                              or None, **fair_kw)
 
     collector = None
     if args.health or args.autoscale:
@@ -696,6 +746,15 @@ def main() -> None:
                   "(zero recompiles after warmup)")
         print("fleet: " + ", ".join(
             f"{k}={v}" for k, v in fleet_rep["affinity"].items()))
+        if fleet_rep.get("tiers"):
+            from chainermn_tpu.monitor._state import get_registry
+
+            mig = sum(v for k, v in
+                      get_registry().snapshot()["counters"].items()
+                      if k.startswith("kv_migrations_total"))
+            print(f"tiers: prefill={fleet_rep['tiers']['prefill']} "
+                  f"decode={fleet_rep['tiers']['decode']} "
+                  f"kv_migrations_total={mig}")
     else:
         if engine.prefix_enabled:
             print("prefix cache: " + ", ".join(

@@ -181,6 +181,31 @@ def test_serve_lm_paged_kv():
     assert "zero recompiles" in proc.stdout
 
 
+@pytest.mark.slow  # ~15s; chunked/migration parity stays tier-1 in serving_tests + fleet_tests — keep tier-1 inside its timeout
+def test_serve_lm_disagg_tiers():
+    """ISSUE 19: chunked prefill + 1P/1D disaggregated tiers through the
+    example — requests prefill on replica 0, their KV migrates to
+    replica 1, streams finish to solo-generate parity, and the tier +
+    migration counters print with the fleet report."""
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "6", "--slots", "2", "--max-new", "6",
+         "--prefill-len", "12", "--d-model", "32", "--layers", "1",
+         "--heads", "4", "--paged-kv", "--kv-block-size", "4",
+         "--chunk-tokens", "4", "--prefill-replicas", "1",
+         "--decode-replicas", "1", "--verify-parity"],
+    )
+    assert "6/6 requests served" in proc.stdout
+    assert "parity vs solo generate: OK (3 requests)" in proc.stdout
+    assert "tiers: prefill=[0] decode=[1]" in proc.stdout
+    mig = int(proc.stdout.split("kv_migrations_total=")[1].split()[0])
+    assert mig >= 1, proc.stdout
+    # the decode replica really served the migrated streams
+    for line in proc.stdout.splitlines():
+        if line.startswith("replica "):
+            assert "zero recompiles" in line
+
+
 @pytest.mark.slow  # another multi-second subprocess run: full-suite only, to keep tier-1 inside its timeout
 def test_serve_lm_speculative():
     """PR 12: prompt-lookup speculative decode through the demo — greedy

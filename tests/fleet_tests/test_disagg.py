@@ -1,0 +1,139 @@
+"""Disaggregated prefill/decode tiers (ISSUE 19): ``FleetRouter``
+routes new requests to prefill-tier replicas; once a request's prefill
+lands, its KV blocks migrate host-bounce to a decode-tier replica and
+the SAME scheduler Request finishes there.
+
+Pinned: tier constructor validation; 1P+1D single-request parity vs
+solo ``generate()`` with the ``tiers`` report block and the migration
+counter moving; concurrent traffic through 1P+2D; chaos at the
+``fleet.migrate`` cut-point degrading to decode-in-place on the prefill
+replica (never a lost request); and killing the decode replica with a
+request mid-flight — the router's failover replays it to parity.
+Everything under zero recompiles on every surviving replica."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.fleet import FleetRouter
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.monitor._state import get_registry
+from chainermn_tpu.resilience import FaultInjector
+from chainermn_tpu.resilience.cutpoints import FLEET_MIGRATE
+from chainermn_tpu.serving import ServingEngine
+
+PROMPT = np.asarray([1, 4, 2, 7, 3, 5, 6, 2, 9, 4, 1, 3], np.int32)
+RNG = jax.random.PRNGKey(7)
+N_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=2,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make_engine(lm, params):
+    return ServingEngine(lm, params, n_slots=2,
+                         prefill_buckets=(4, 8, 16), prefill_batch=2,
+                         paged=True, kv_block_size=2, kv_blocks=64,
+                         cache_len=48)
+
+
+@pytest.fixture(scope="module")
+def ref_tail(lm_and_params):
+    lm, params = lm_and_params
+    solo = np.asarray(generate(lm, params, jnp.asarray(PROMPT)[None],
+                               N_NEW, rng=RNG)[0])
+    return [int(t) for t in solo[len(PROMPT):]]
+
+
+def make_tiered(lm, params, p=1, d=1, chunk=3):
+    router = FleetRouter([make_engine(lm, params) for _ in range(p + d)],
+                         prefill_replicas=p, decode_replicas=d,
+                         chunk_tokens_per_step=chunk)
+    assert router.wait_ready(300)
+    return router
+
+
+def _migrations():
+    return sum(v for k, v in get_registry().snapshot()["counters"].items()
+               if k.startswith("kv_migrations_total"))
+
+
+def test_tier_kwargs_validated(lm_and_params):
+    lm, params = lm_and_params
+    with pytest.raises(ValueError, match="together"):
+        FleetRouter([None, None], prefill_replicas=1)
+    with pytest.raises(ValueError, match="at least one"):
+        FleetRouter([None, None], prefill_replicas=0, decode_replicas=2)
+    with pytest.raises(ValueError, match="cover the fleet"):
+        FleetRouter([None, None, None], prefill_replicas=1,
+                    decode_replicas=1)
+
+
+def test_one_p_one_d_parity_and_migration(lm_and_params, ref_tail):
+    lm, params = lm_and_params
+    router = make_tiered(lm, params)
+    try:
+        before = _migrations()
+        out = router.generate(PROMPT, N_NEW, rng=RNG, timeout=60)
+        assert [int(t) for t in out[len(PROMPT):]] == ref_tail
+        rep = router.fleet_report()
+        assert rep["tiers"] == {"prefill": [0], "decode": [1]}
+        assert _migrations() > before      # the decode tier really decoded
+        for r in router.replicas:
+            assert r.engine.recompiles == {}
+    finally:
+        router.close()
+
+
+# @slow: a 3-engine warmup (~11s) to show the 1P+2D shape; the tiered
+# routing + migration path itself is tier-1-covered by the 1P+1D parity
+# test above and the chaos/kill cells below.
+@pytest.mark.slow
+def test_concurrent_requests_through_tiers(lm_and_params, ref_tail):
+    lm, params = lm_and_params
+    router = make_tiered(lm, params, p=1, d=2, chunk=2)
+    try:
+        frs = [router.submit(PROMPT, N_NEW, rng=RNG) for _ in range(4)]
+        for fr in frs:
+            assert fr.wait(60)
+            assert [int(t) for t in fr.tokens] == ref_tail
+    finally:
+        router.close()
+
+
+def test_migrate_chaos_decodes_in_place(lm_and_params, ref_tail):
+    """Every fleet.migrate attempt faults: the prefill replica keeps the
+    request and decodes it locally — degraded locality, zero loss."""
+    lm, params = lm_and_params
+    inj = FaultInjector()
+    inj.arm(FLEET_MIGRATE, times=100)
+    with inj:
+        router = make_tiered(lm, params)
+        try:
+            out = router.generate(PROMPT, N_NEW, rng=RNG, timeout=60)
+            assert [int(t) for t in out[len(PROMPT):]] == ref_tail
+            assert inj.fired_log, "migrate cut-point never fired"
+        finally:
+            router.close()
+
+
+def test_kill_decode_replica_mid_flight(lm_and_params, ref_tail):
+    """The decode tier dies while a migrated request may be in any of
+    queued / importing / decoding there: the router's failover path
+    replays it — no request lost."""
+    lm, params = lm_and_params
+    router = make_tiered(lm, params, chunk=2)
+    try:
+        fr = router.submit(PROMPT, N_NEW, rng=RNG)
+        router.kill_replica(1)
+        assert fr.wait(60)
+        assert [int(t) for t in fr.tokens] == ref_tail
+    finally:
+        router.close()

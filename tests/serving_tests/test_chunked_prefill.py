@@ -1,0 +1,162 @@
+"""Chunked prefill (ISSUE 19): long prompts are prefilled in
+``chunk_tokens_per_step``-sized slices across successive scheduler steps
+instead of one monolithic bucket call, so decode of resident slots keeps
+ticking between slices.
+
+Load-bearing properties pinned here: token parity vs the unchunked
+scheduler AND solo ``generate()`` for chunk sizes 1 (degenerate), a
+block-boundary multiple, and an odd size; a prefix-cache hit landing
+mid-chunk (``plan.start > 0`` shifts every chunk frontier); chunked +
+short unchunked traffic interleaving on one engine; cancel mid-chunk
+releasing the slot from the driving thread; the ``serving.chunk_prefill``
+cut-point failing over through engine restart without leaking slots; and
+zero recompiles through all of it (chunks reuse the same bucket
+programs). int8 parity rides in the migration suite's quantized engines.
+
+One module-scoped warm engine is shared by every scheduler here —
+schedulers are cheap, engine warmup is the expensive part (tier-1
+budget). Each test drains its requests, so the pool/slots hand over
+clean; the trie deliberately persists (that's the prefix-hit case).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.resilience import FaultInjector
+from chainermn_tpu.resilience.cutpoints import SERVING_CHUNK_PREFILL
+from chainermn_tpu.serving import FCFSScheduler, RequestState, ServingEngine
+
+PROMPT = np.asarray([1, 4, 2, 7, 3, 5, 6, 2, 9, 4, 1, 3], np.int32)
+RNG = jax.random.PRNGKey(7)
+N_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=2,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+@pytest.fixture(scope="module")
+def engine(lm_and_params):
+    lm, params = lm_and_params
+    eng = ServingEngine(lm, params, n_slots=2,
+                        prefill_buckets=(4, 8, 16), prefill_batch=2,
+                        paged=True, kv_block_size=2, kv_blocks=64,
+                        cache_len=48)
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def ref_tail(lm_and_params):
+    lm, params = lm_and_params
+    solo = np.asarray(generate(lm, params, jnp.asarray(PROMPT)[None],
+                               N_NEW, rng=RNG)[0])
+    return [int(t) for t in solo[len(PROMPT):]]
+
+
+def drive(sched, reqs, steps=400):
+    for _ in range(steps):
+        sched.step()
+        if all(r.finished for r in reqs):
+            return
+    raise AssertionError([(r.state, r.error) for r in reqs])
+
+
+def test_unchunked_baseline_parity(engine, ref_tail):
+    s = FCFSScheduler(engine)
+    r = s.submit(PROMPT, N_NEW, rng=RNG)
+    drive(s, [r])
+    assert r.tokens == ref_tail
+
+
+@pytest.mark.parametrize("chunk_tokens", [1, 3, 4])
+def test_chunked_parity(engine, ref_tail, chunk_tokens):
+    """chunk=1 (every token its own step), 3 (odd, straddles the
+    kv_block_size=2 boundary), 4 (block-aligned). Same tokens as solo
+    generate, no recompiles — chunk slices ride the warm buckets."""
+    base = dict(engine.compile_counts_detailed())
+    s = FCFSScheduler(engine, chunk_tokens_per_step=chunk_tokens)
+    r = s.submit(PROMPT, N_NEW, rng=RNG)
+    drive(s, [r])
+    assert r.tokens == ref_tail, (chunk_tokens, r.tokens, ref_tail)
+    assert engine.recompiles == {}
+    assert dict(engine.compile_counts_detailed()) == base
+
+
+def test_prefix_hit_mid_chunk(engine, ref_tail):
+    """After the runs above the trie holds PROMPT's full blocks: the
+    plan starts past 0 and chunking must cover only the uncached tail —
+    token-exactly."""
+    plan = engine.plan_admission(PROMPT, rng=RNG, max_new=N_NEW)
+    start = plan.start
+    engine.cancel_plan(plan)
+    assert start > 0, "expected a prefix hit from the earlier runs"
+    s = FCFSScheduler(engine, chunk_tokens_per_step=3)
+    r = s.submit(PROMPT, N_NEW, rng=RNG)
+    drive(s, [r])
+    assert r.tokens == ref_tail
+    assert engine.recompiles == {}
+
+
+def test_chunked_interleaves_with_short_request(engine, ref_tail):
+    s = FCFSScheduler(engine, chunk_tokens_per_step=2)
+    rl = s.submit(PROMPT, N_NEW, rng=RNG)
+    rs = s.submit([2, 3, 1], 8, rng=jax.random.PRNGKey(9))
+    drive(s, [rl, rs])
+    assert rl.tokens == ref_tail
+    assert len(rs.tokens) == 8
+    assert engine.recompiles == {}
+
+
+def test_cancel_mid_chunk_releases_slot(engine, ref_tail):
+    # a prompt the trie has never seen: every chunk really prefills
+    fresh = np.asarray([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8], np.int32)
+    s = FCFSScheduler(engine, chunk_tokens_per_step=1)
+    r = s.submit(fresh, N_NEW, rng=RNG)
+    s.step()                                   # admits + first chunk only
+    assert r.state in (RequestState.PREFILLING, RequestState.QUEUED)
+    s.cancel(r)
+    for _ in range(10):                        # release happens on the
+        s.step()                               # driving thread
+    assert r.state is RequestState.CANCELLED
+    assert len(engine.free_slots) == engine.n_slots
+    # the engine is fully reusable afterwards
+    r2 = s.submit(PROMPT, N_NEW, rng=RNG)
+    drive(s, [r2])
+    assert r2.tokens == ref_tail
+
+
+def test_chunk_chaos_restarts_without_leaking_slots(engine, ref_tail):
+    """A fault at ``serving.chunk_prefill`` mid-request: the victim
+    errors with EngineFailed, the scheduler restarts the engine, and the
+    next request decodes to parity on the rebuilt store."""
+    from chainermn_tpu.serving.scheduler import EngineFailed
+
+    s = FCFSScheduler(engine, chunk_tokens_per_step=2,
+                      restart_on_error=True)
+    victim_prompt = np.asarray([2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5],
+                               np.int32)           # trie-cold: chunks run
+    inj = FaultInjector()
+    inj.arm(SERVING_CHUNK_PREFILL, times=1, after=1)
+    with inj:
+        r = s.submit(victim_prompt, N_NEW, rng=RNG)
+        for _ in range(400):
+            s.step()
+            if r.finished:
+                break
+    assert r.state is RequestState.ERRORED
+    assert isinstance(r.error, EngineFailed)
+    assert inj.fired_log, "chunk cut-point never fired"
+    assert len(engine.free_slots) == engine.n_slots
+    r2 = s.submit(PROMPT, N_NEW, rng=RNG)
+    drive(s, [r2])
+    assert r2.tokens == ref_tail
+    assert engine.recompiles == {}

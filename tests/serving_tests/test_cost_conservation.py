@@ -106,3 +106,45 @@ def test_fuzzed_conservation_soak(rig, seed):
     reqs = _run_fuzzed(sched, seed)
     assert [r.state for r in reqs] == [RequestState.DONE] * len(PROMPTS)
     _assert_conserved(sched)
+
+
+# --------------------------------------------------------------------- #
+# chunked prefill + migration attribution (ISSUE 19)                     #
+# --------------------------------------------------------------------- #
+
+
+def test_chunked_schedule_conserves_device_time(rig):
+    """Chunked prefill books each chunk's interval through the same
+    record_prefill path (one member, the chunk's token count): the
+    ledger stays exact under a fuzzed chunked schedule too."""
+    sched = FCFSScheduler(rig.engine, chunk_tokens_per_step=2)
+    reqs = _run_fuzzed(sched, seed=77)
+    assert [r.state for r in reqs] == [RequestState.DONE] * len(PROMPTS)
+    _assert_conserved(sched)
+
+
+def test_migrated_request_books_migrate_kind(rig):
+    """A migration's export+handover interval lands on the source
+    ledger under the ``migrate`` kind — and both ledgers still conserve
+    exactly."""
+    eng = rig.engine
+    eng.warmup()        # can_import gates on an explicitly warm engine
+    sa = FCFSScheduler(eng, chunk_tokens_per_step=2)
+    sb = FCFSScheduler(eng)
+    sa.migrate_cb = lambda req, payload: bool(
+        sb.enqueue_migrated(req, payload))
+    r = sa.submit(np.asarray([1, 2, 3, 4, 5, 6], np.int32), MAX_NEW,
+                  tenant="bulk")
+    for _ in range(400):
+        sa.step()
+        sb.step()
+        if r.finished:
+            break
+    assert r.state is RequestState.DONE, (r.state, r.error)
+    pay = sa.costs.payload()
+    kinds = {k.split("\x00")[1] for k in pay["device"]}
+    assert "migrate" in kinds
+    _assert_conserved(sa)
+    _assert_conserved(sb)
+    # the migrate seconds belong to the request's tenant, not overhead
+    assert sa.costs.tenant_device_seconds()["bulk"] > 0.0

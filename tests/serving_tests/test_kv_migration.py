@@ -1,0 +1,276 @@
+"""KV block migration (ISSUE 19): a prefilled slot's resident blocks
+leave one engine as a host payload (compiled-once read-side gather) and
+land in another (compiled-once write-side scatter), after which decode
+continues token-exactly — rng and position state travel with the rows.
+
+Pinned here, bottom-up: the ``alloc_blocks_atomic`` all-or-nothing pool
+primitive both the import and chunked staging lean on; engine-level
+export→import parity vs solo ``generate()``; ``can_import``'s
+static-vs-transient semantics (a structural mismatch is *never*
+importable, pool pressure clears on its own); pool-exhaustion rollback
+leaving the destination engine intact; the migration metrics spine; and
+the scheduler-level handover — the SAME Request object finishing on the
+destination scheduler, source slot released, with ``migrate_cb``
+returning False or raising falling back to decode-in-place (a migration
+failure is never a lost request). int8 end-to-end rides @slow.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.monitor._state import get_registry
+from chainermn_tpu.serving import BlockPool, FCFSScheduler, ServingEngine
+from chainermn_tpu.serving.prefix_cache import PrefixCacheIndex
+
+PROMPT = np.asarray([1, 4, 2, 7, 3, 5, 6, 2, 9, 4, 1, 3], np.int32)
+RNG = jax.random.PRNGKey(7)
+N_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=2,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def build(lm, params, **kw):
+    eng = ServingEngine(lm, params, n_slots=2,
+                        prefill_buckets=(4, 8, 16), prefill_batch=2,
+                        paged=True, kv_block_size=2, kv_blocks=64,
+                        cache_len=48, **kw)
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def engines(lm_and_params):
+    lm, params = lm_and_params
+    return build(lm, params), build(lm, params)
+
+
+@pytest.fixture(scope="module")
+def ref_tail(lm_and_params):
+    lm, params = lm_and_params
+    solo = np.asarray(generate(lm, params, jnp.asarray(PROMPT)[None],
+                               N_NEW, rng=RNG)[0])
+    return [int(t) for t in solo[len(PROMPT):]]
+
+
+def pump(eng):
+    for s in range(eng.n_slots):
+        while eng.slot_needs_block(s):
+            assert eng.append_block(s)
+    return eng.decode_round()
+
+
+# --------------------------------------------------------------------- #
+# alloc_blocks_atomic (host pool, no jax)                                #
+# --------------------------------------------------------------------- #
+
+
+def test_alloc_blocks_atomic_success_and_rollback():
+    pool = BlockPool(6, reserve_scratch=True)            # 5 allocatable
+    idx = PrefixCacheIndex(6, 2, pool=pool)
+    got = idx.alloc_blocks_atomic(3)
+    assert got is not None and len(got) == 3
+    assert pool.free_blocks == 2
+    # shortfall: nothing sticks — the partial grab is rolled back
+    assert idx.alloc_blocks_atomic(3) is None
+    assert pool.free_blocks == 2
+    for b in got:
+        pool.decref(b)
+    assert pool.free_blocks == pool.capacity
+
+
+# --------------------------------------------------------------------- #
+# engine-level export/import                                             #
+# --------------------------------------------------------------------- #
+
+
+def _prefill_on(eng):
+    plan = eng.plan_admission(PROMPT, rng=RNG, max_new=N_NEW)
+    (slot, first), = eng.admit_batch([plan])
+    return slot, first
+
+
+def _drain_pool(eng):
+    """Grab every allocatable block — free AND trie-evictable — so the
+    next allocation genuinely has nowhere to go."""
+    held = []
+    while True:
+        got = eng.prefix_cache.alloc_blocks_atomic(1)
+        if got is None:
+            return held
+        held.extend(got)
+
+
+def test_export_import_parity(engines, ref_tail):
+    src, dst = engines
+    slot_a, first = _prefill_on(src)
+    payload = src.export_slot_kv(slot_a)
+    assert payload["n_blocks"] >= 1
+    assert dst.can_import(payload, max_new=N_NEW)
+    slot_b = dst.import_slot_kv(payload, prompt=PROMPT, max_new=N_NEW)
+    src.release(slot_a)
+    toks = [first]
+    while len(toks) < N_NEW:
+        toks.extend(pump(dst)[slot_b])
+    assert toks[:N_NEW] == ref_tail
+    assert src.recompiles == {} and dst.recompiles == {}
+    dst.release(slot_b)
+
+
+def test_migration_metrics_counted(engines):
+    counters = get_registry().snapshot()["counters"]
+    migs = sum(v for k, v in counters.items()
+               if k.startswith("kv_migrations_total"))
+    blocks = sum(v for k, v in counters.items()
+                 if k.startswith("kv_migrated_blocks_total"))
+    assert migs >= 1
+    assert blocks >= migs                    # every import moved blocks
+
+
+def test_can_import_static_vs_transient(engines):
+    src, dst = engines
+    slot_a, _ = _prefill_on(src)
+    payload = src.export_slot_kv(slot_a)
+    src.release(slot_a)
+    # structural mismatch: never importable, static_only agrees
+    broken = dict(payload, block_size=payload["block_size"] * 2)
+    assert not dst.can_import(broken, max_new=1)
+    assert not dst.can_import(broken, max_new=1, static_only=True)
+    # position past cache_len: static — retrying can't help
+    too_far = dict(payload, pos=dst.cache_len)
+    assert not dst.can_import(too_far, max_new=1, static_only=True)
+    # pool pressure: transient — static check still passes
+    held = _drain_pool(dst)
+    try:
+        assert not dst.can_import(payload, max_new=N_NEW)
+        assert dst.can_import(payload, max_new=N_NEW, static_only=True)
+    finally:
+        for b in held:
+            dst._pool.decref(b)
+    assert dst.can_import(payload, max_new=N_NEW)
+
+
+def test_import_pool_exhaustion_rolls_back(engines, ref_tail):
+    """An import that can't get its blocks raises but leaves the
+    destination untouched — free counts unchanged, and the same payload
+    lands cleanly once pressure clears."""
+    src, dst = engines
+    slot_a, first = _prefill_on(src)
+    payload = src.export_slot_kv(slot_a)
+    src.release(slot_a)
+    held = _drain_pool(dst)
+    free_before = dst._pool.free_blocks
+    slots_before = set(dst.free_slots)
+    with pytest.raises(RuntimeError):
+        dst.import_slot_kv(payload, prompt=PROMPT, max_new=N_NEW)
+    assert dst._pool.free_blocks == free_before
+    assert set(dst.free_slots) == slots_before
+    for b in held:
+        dst._pool.decref(b)
+    slot_b = dst.import_slot_kv(payload, prompt=PROMPT, max_new=N_NEW)
+    toks = [first]
+    while len(toks) < N_NEW:
+        toks.extend(pump(dst)[slot_b])
+    assert toks[:N_NEW] == ref_tail
+    dst.release(slot_b)
+
+
+# --------------------------------------------------------------------- #
+# scheduler-level handover                                               #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("chunk_tokens", [None, 3])
+def test_scheduler_handover_same_request_object(engines, ref_tail,
+                                                chunk_tokens):
+    src, dst = engines
+    sa = FCFSScheduler(src, chunk_tokens_per_step=chunk_tokens)
+    sb = FCFSScheduler(dst)
+    migrations = []
+
+    def migrate(req, payload):
+        sb.enqueue_migrated(req, payload)
+        migrations.append(req.id)
+        return True
+
+    sa.migrate_cb = migrate
+    r = sa.submit(PROMPT, N_NEW, rng=RNG)
+    for _ in range(400):
+        sa.step()
+        sb.step()
+        if r.finished:
+            break
+    assert r.finished and r.tokens == ref_tail, (r.state, r.error)
+    assert migrations == [r.id]
+    assert len(src.free_slots) == src.n_slots    # source slot released
+    assert not sa.has_work and not sb.has_work
+    assert src.recompiles == {} and dst.recompiles == {}
+
+
+@pytest.mark.parametrize("failure", ["false", "raise"])
+def test_migrate_failure_decodes_in_place(engines, ref_tail, failure):
+    src, _ = engines
+    sa = FCFSScheduler(src, chunk_tokens_per_step=3)
+    if failure == "false":
+        sa.migrate_cb = lambda req, payload: False
+    else:
+        def boom(req, payload):
+            raise RuntimeError("chaos")
+        sa.migrate_cb = boom
+    r = sa.submit(PROMPT, N_NEW, rng=RNG)
+    for _ in range(400):
+        sa.step()
+        if r.finished:
+            break
+    assert r.finished and r.tokens == ref_tail, (r.state, r.error)
+    assert len(src.free_slots) == src.n_slots
+
+
+# --------------------------------------------------------------------- #
+# int8 end-to-end (own engines — @slow)                                  #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_int8_chunked_and_migration_parity(lm_and_params):
+    """Quantized rows+scales migrate as stored: int8 chunked == int8
+    unchunked == int8 after migration, token-exactly."""
+    lm, params = lm_and_params
+    eng_u = build(lm, params, kv_quant="int8")
+    slot, first = _prefill_on(eng_u)
+    toks_u = [first]
+    while len(toks_u) < N_NEW:
+        toks_u.extend(pump(eng_u)[slot])
+    toks_u = toks_u[:N_NEW]
+
+    eng_c = build(lm, params, kv_quant="int8")
+    sc = FCFSScheduler(eng_c, chunk_tokens_per_step=3)
+    r = sc.submit(PROMPT, N_NEW, rng=RNG)
+    for _ in range(400):
+        sc.step()
+        if r.finished:
+            break
+    assert r.finished and r.tokens == toks_u, (r.state, r.tokens, toks_u)
+
+    eng_a = build(lm, params, kv_quant="int8")
+    eng_b = build(lm, params, kv_quant="int8")
+    slot_a, first_a = _prefill_on(eng_a)
+    payload = eng_a.export_slot_kv(slot_a)
+    assert payload["kv_quant"] == "int8"
+    slot_b = eng_b.import_slot_kv(payload, prompt=PROMPT, max_new=N_NEW)
+    eng_a.release(slot_a)
+    toks_m = [first_a]
+    while len(toks_m) < N_NEW:
+        toks_m.extend(pump(eng_b)[slot_b])
+    assert toks_m[:N_NEW] == toks_u
+    for e in (eng_u, eng_c, eng_a, eng_b):
+        assert e.recompiles == {}

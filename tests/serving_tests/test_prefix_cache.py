@@ -48,6 +48,20 @@ def test_trie_match_is_block_granular_and_never_whole_prompt():
     assert idx.stats()["used_blocks"] == 3
 
 
+def test_alloc_blocks_atomic_is_all_or_nothing():
+    """The migration/chunked-staging primitive (ISSUE 19): either every
+    requested block comes back, or none stick — a shortfall rolls the
+    partial grab straight back so a failed import can't bleed the pool."""
+    idx = PrefixCacheIndex(n_blocks=6, block_size=2)
+    got = idx.alloc_blocks_atomic(4)
+    assert got is not None and len(got) == 4
+    free_before = idx.pool.free_blocks
+    assert idx.alloc_blocks_atomic(free_before + 1) is None
+    assert idx.pool.free_blocks == free_before         # rollback exact
+    assert idx.alloc_blocks_atomic(free_before) is not None
+    assert idx.alloc_blocks_atomic(0) == []
+
+
 def test_trie_refcount_blocks_eviction_until_release():
     idx = PrefixCacheIndex(n_blocks=2, block_size=2)
     idx.commit_insert(idx.plan_insert(np.array([1, 2, 3, 4])))

@@ -263,7 +263,8 @@ def test_bench_serving_mode_smoke():
     assert ca["recompiles_after_warmup"] == 0
     # goodput fractions partition the measured time (padding/idle/etc.)
     gp = ca["goodput"]
-    assert set(gp) == {"useful", "padding", "idle", "wasted", "replay"}
+    assert set(gp) == {"useful", "padding", "idle", "wasted", "replay",
+                       "migrate"}
     assert gp["useful"] > 0
     assert abs(sum(gp.values()) - 1.0) < 0.02, gp
     # the bursty tenant out-billed the quiet one, and the threshold
@@ -293,6 +294,22 @@ def test_bench_serving_mode_smoke():
     assert of["no_request_lost"] is True
     assert of["recompiles_after_warmup"] == 0
     assert of["conservation_error"] < 1e-6, of
+    # ---- the ISSUE-19 chunked prefill (acceptance criterion) --------- #
+    cp = rec["chunked_prefill_serving"]
+    # chunking bounds the decode stall a long admission inflicts on
+    # resident streams: victim decode-gap p99 at least 2x better ON
+    assert cp["stall_improvement"] >= 2.0, cp
+    assert cp["decode_gap_p99_ms_on"] < cp["decode_gap_p99_ms_off"], cp
+    assert cp["token_parity_on_vs_off"] is True
+    assert cp["recompiles_after_warmup"] == 0
+    # ---- the ISSUE-19 disaggregated tiers (acceptance criterion) ----- #
+    dg = rec["disagg_serving"]
+    assert dg["tiers"] == {"prefill": [0], "decode": [1]}, dg
+    # every request prefilled on the P tier and migrated out to decode
+    assert dg["migrations"] >= dg["requests"], dg
+    assert dg["token_parity_vs_symmetric"] is True
+    assert dg["no_request_lost"] is True
+    assert dg["recompiles_after_warmup"] == 0
 
 
 def _run_monitor_mode(extra_env):
