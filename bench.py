@@ -615,6 +615,13 @@ def serving_main() -> None:
     d_model = int(e("CHAINERMN_TPU_SERVE_DMODEL", "128"))
     n_layers = int(e("CHAINERMN_TPU_SERVE_LAYERS", "4"))
     n_heads = int(e("CHAINERMN_TPU_SERVE_HEADS", "8"))
+    skip_sections = {s for s in e(
+        "CHAINERMN_TPU_SERVE_SKIP_SECTIONS", "").split(",") if s}
+    # the kernel + speculative sections reuse the paged
+    # section's workload/engine parameters
+    if "paged_serving" in skip_sections:
+        skip_sections |= {"paged_kernel_serving",
+                          "speculative_serving"}
 
     devs = _devices_or_fail_fast(jax, mode="serving",
                                  metric="serving_decode_throughput",
@@ -750,706 +757,729 @@ def serving_main() -> None:
             f"({ts_rec['ticks']} ticks over {ts_rec['n_series']} series), "
             f"health={ts_rec['worst_state']}, parity={ts_parity}")
 
-        # ---- prefix-heavy workload: shared system prompt, mixed tails - #
-        # The admission fast path's acceptance numbers (ISSUE 5): the SAME
-        # workload runs twice through bucketed batched-prefill engines —
-        # prefix cache ON vs OFF — so the TTFT delta isolates KV reuse.
-        # Every request shares a system-prompt prefix; tails are ragged.
-        buckets = tuple(
-            int(x) for x in e(
-                "CHAINERMN_TPU_SERVE_BUCKETS",
-                f"{max(1, prefill_len // 4)},{prefill_len}").split(","))
-        batch_k = int(e("CHAINERMN_TPU_SERVE_PREFILL_BATCH", "4"))
-        shared_len = min(int(e("CHAINERMN_TPU_SERVE_SHARED_PREFIX",
-                               str(3 * prefill_len // 4))), prefill_len - 1)
-        block = int(e("CHAINERMN_TPU_SERVE_PREFIX_BLOCK",
-                      str(max(1, prefill_len // 8))))
-        n_blocks = int(e("CHAINERMN_TPU_SERVE_PREFIX_BLOCKS", "64"))
-        min_insert = int(e("CHAINERMN_TPU_SERVE_MIN_INSERT", "2"))
-        shared = rng.randint(1, vocab, shared_len).astype(np.int32)
-        tail_max = prefill_len - shared_len
-        jobs = [
-            (np.concatenate([shared, rng.randint(
-                1, vocab, 1 + i % tail_max).astype(np.int32)]),
-             int(rng.randint(1, max_new + 1)))
-            for i in range(n_requests)
-        ]
+        if "prefix_serving" in skip_sections:
+            log("prefix_serving: skipped via CHAINERMN_TPU_SERVE_SKIP_SECTIONS")
+        else:
+            # ---- prefix-heavy workload: shared system prompt, mixed tails - #
+            # The admission fast path's acceptance numbers (ISSUE 5): the SAME
+            # workload runs twice through bucketed batched-prefill engines —
+            # prefix cache ON vs OFF — so the TTFT delta isolates KV reuse.
+            # Every request shares a system-prompt prefix; tails are ragged.
+            buckets = tuple(
+                int(x) for x in e(
+                    "CHAINERMN_TPU_SERVE_BUCKETS",
+                    f"{max(1, prefill_len // 4)},{prefill_len}").split(","))
+            batch_k = int(e("CHAINERMN_TPU_SERVE_PREFILL_BATCH", "4"))
+            shared_len = min(int(e("CHAINERMN_TPU_SERVE_SHARED_PREFIX",
+                                   str(3 * prefill_len // 4))), prefill_len - 1)
+            block = int(e("CHAINERMN_TPU_SERVE_PREFIX_BLOCK",
+                          str(max(1, prefill_len // 8))))
+            n_blocks = int(e("CHAINERMN_TPU_SERVE_PREFIX_BLOCKS", "64"))
+            min_insert = int(e("CHAINERMN_TPU_SERVE_MIN_INSERT", "2"))
+            shared = rng.randint(1, vocab, shared_len).astype(np.int32)
+            tail_max = prefill_len - shared_len
+            jobs = [
+                (np.concatenate([shared, rng.randint(
+                    1, vocab, 1 + i % tail_max).astype(np.int32)]),
+                 int(rng.randint(1, max_new + 1)))
+                for i in range(n_requests)
+            ]
 
-        def run_prefix_workload(prefix_on):
-            eng = ServingEngine(
-                model, params, n_slots=n_slots, prefill_buckets=buckets,
-                prefill_batch=batch_k,
-                prefix_cache_blocks=n_blocks if prefix_on else 0,
-                prefix_block_size=block,
-                prefix_min_insert_blocks=min_insert)
-            eng.warmup()                      # every program, off the clock
-            counts = eng.compile_counts_detailed()
-            seeder = FCFSScheduler(eng)       # seed the trie off the clock
-            seeder.submit(
-                np.concatenate([shared, np.array([1], np.int32)]), 1)
-            seeder.run_until_idle()
-            s = FCFSScheduler(eng)
-            t0 = time.time()
-            reqs = [s.submit(p, n) for p, n in jobs]
-            s.run_until_idle()
-            wall = time.time() - t0
-            assert eng.compile_counts_detailed() == counts, "recompiled!"
-            return eng, s.metrics.report(), reqs, wall
+            def run_prefix_workload(prefix_on):
+                eng = ServingEngine(
+                    model, params, n_slots=n_slots, prefill_buckets=buckets,
+                    prefill_batch=batch_k,
+                    prefix_cache_blocks=n_blocks if prefix_on else 0,
+                    prefix_block_size=block,
+                    prefix_min_insert_blocks=min_insert)
+                eng.warmup()                      # every program, off the clock
+                counts = eng.compile_counts_detailed()
+                seeder = FCFSScheduler(eng)       # seed the trie off the clock
+                seeder.submit(
+                    np.concatenate([shared, np.array([1], np.int32)]), 1)
+                seeder.run_until_idle()
+                s = FCFSScheduler(eng)
+                t0 = time.time()
+                reqs = [s.submit(p, n) for p, n in jobs]
+                s.run_until_idle()
+                wall = time.time() - t0
+                assert eng.compile_counts_detailed() == counts, "recompiled!"
+                return eng, s.metrics.report(), reqs, wall
 
-        eng_on, m_on, reqs_on, wall_on = run_prefix_workload(True)
-        eng_off, m_off, _, wall_off = run_prefix_workload(False)
-        # token-for-token parity vs solo generate() (greedy), through
-        # prefix fetch + batched suffix prefill
-        parity = True
-        for i in (0, 1):
-            prompt, n = jobs[i]
-            ref = np.asarray(generate(model, params,
-                                      jnp.asarray(prompt)[None], n)[0])
-            parity = parity and bool(np.array_equal(reqs_on[i].output, ref))
-        pstats = eng_on.prefix_stats()
-        record["prefix_serving"] = {
-            "buckets": list(buckets),
-            "prefill_batch": batch_k,
-            "shared_prefix": shared_len,
-            "prefix_blocks": n_blocks,
-            "block_size": block,
-            # per-ADMISSION hit rate (fraction of admitted requests whose
-            # prompt was partly served from cache); the trie's own stats
-            # (below) count every match probe incl. re-scanned candidates
-            "hit_rate": m_on.get("prefix_hit_rate", 0.0),
-            "trie": pstats,
-            "evictions": pstats["evictions"],
-            "cached_prefix_frac_mean": m_on.get("cached_prefix_frac_mean",
-                                                0.0),
-            "prefill_batch_occupancy":
-                m_on.get("prefill_batch_size_mean", 0.0),
-            "ttft_p50_ms": round(m_on["ttft_p50_s"] * 1e3, 3),
-            "ttft_p99_ms": round(m_on["ttft_p99_s"] * 1e3, 3),
-            "ttft_p50_ms_off": round(m_off["ttft_p50_s"] * 1e3, 3),
-            "ttft_p99_ms_off": round(m_off["ttft_p99_s"] * 1e3, 3),
-            "ttft_p50_speedup": round(
-                m_off["ttft_p50_s"] / max(m_on["ttft_p50_s"], 1e-9), 3),
-            "tokens_per_sec": m_on["tokens_per_sec"],
-            "tokens_per_sec_off": m_off["tokens_per_sec"],
-            "wall_s": round(wall_on, 3),
-            "wall_s_off": round(wall_off, 3),
-            "recompiles_after_warmup":
-                sum(eng_on.recompiles.values())
-                + sum(eng_off.recompiles.values()),
-            "parity_vs_solo_generate": parity,
-            "compile_counts": eng_on.compile_counts_detailed(),
-        }
-        log(f"prefix serving: "
-            f"hit_rate={record['prefix_serving']['hit_rate']} "
-            f"ttft_p50 {record['prefix_serving']['ttft_p50_ms']}ms (on) vs "
-            f"{record['prefix_serving']['ttft_p50_ms_off']}ms (off), "
-            f"parity={parity}")
-
-        # ---- paged KV decode: ON vs OFF at the SAME device KV budget - #
-        # The PR-7 acceptance: a dense engine reserves cache_len rows per
-        # slot regardless of what requests actually use, so concurrency =
-        # n_slots. The paged engine spends the SAME row budget as a block
-        # pool and admits by blocks actually needed — short requests pack
-        # 4x+ more concurrent decodes into identical memory (worst-case
-        # block-budget admission, so zero preemptions in the clean run).
-        pg_prefill = int(e("CHAINERMN_TPU_SERVE_PAGED_PREFILL", "16"))
-        pg_cache = int(e("CHAINERMN_TPU_SERVE_PAGED_CACHE", "64"))
-        pg_bs = int(e("CHAINERMN_TPU_SERVE_KV_BLOCK", "8"))
-        pg_batch = int(e("CHAINERMN_TPU_SERVE_PAGED_BATCH", "4"))
-        pg_max_new = int(e("CHAINERMN_TPU_SERVE_PAGED_MAX_NEW", "6"))
-        pg_quant = e("CHAINERMN_TPU_SERVE_KV_QUANT", "none")
-        dense_slots = int(e("CHAINERMN_TPU_SERVE_DENSE_SLOTS", "2"))
-        paged_slots = int(e("CHAINERMN_TPU_SERVE_PAGED_SLOTS", "12"))
-        budget_rows = dense_slots * pg_cache       # dense-resident KV rows
-        pg_blocks = budget_rows // pg_bs + 1       # same rows (+ scratch)
-        pg_jobs = [
-            (rng.randint(1, vocab,
-                         2 + i % (pg_prefill // 2 - 1)).astype(np.int32),
-             pg_max_new)
-            for i in range(int(e("CHAINERMN_TPU_SERVE_PAGED_REQUESTS",
-                                 "16")))
-        ]
-
-        def run_paged_workload(paged_on):
-            kw = (dict(paged=True, kv_blocks=pg_blocks, kv_block_size=pg_bs,
-                       kv_quant=pg_quant, n_slots=paged_slots)
-                  if paged_on else dict(n_slots=dense_slots))
-            eng = ServingEngine(model, params, prefill_buckets=(pg_prefill,),
-                                prefill_batch=pg_batch, cache_len=pg_cache,
-                                **kw)
-            eng.warmup()
-            counts = eng.compile_counts_detailed()
-            s = FCFSScheduler(eng)
-            t0 = time.time()
-            reqs = [s.submit(p, n) for p, n in pg_jobs]
-            s.run_until_idle()
-            wall = time.time() - t0
-            assert eng.compile_counts_detailed() == counts, "recompiled!"
-            return eng, s.metrics.report(), reqs, wall
-
-        eng_pg, m_pg, reqs_pg, wall_pg = run_paged_workload(True)
-        eng_dn, m_dn, reqs_dn, wall_dn = run_paged_workload(False)
-        pg_parity = True
-        for i in (0, 1):
-            prompt, n = pg_jobs[i]
-            ref = np.asarray(generate(model, params,
-                                      jnp.asarray(prompt)[None], n)[0])
-            pg_parity = (pg_parity
-                         and bool(np.array_equal(reqs_pg[i].output, ref))
-                         and bool(np.array_equal(reqs_dn[i].output, ref)))
-        record["paged_serving"] = {
-            "kv_blocks": pg_blocks,
-            "kv_block_size": pg_bs,
-            "kv_quant": pg_quant,
-            "kv_budget_rows": budget_rows,
-            "dense_slots": dense_slots,
-            "paged_slots": paged_slots,
-            "max_concurrent_paged": eng_pg.peak_active,
-            "max_concurrent_dense": eng_dn.peak_active,
-            "concurrency_gain": round(
-                eng_pg.peak_active / max(eng_dn.peak_active, 1), 3),
-            "tokens_per_sec": m_pg["tokens_per_sec"],
-            "tokens_per_sec_dense": m_dn["tokens_per_sec"],
-            "wall_s": round(wall_pg, 3),
-            "wall_s_dense": round(wall_dn, 3),
-            "preemptions": m_pg.get("kv_preemptions", 0),
-            "kv_blocks_per_request_mean":
-                m_pg.get("kv_blocks_per_request_mean", 0.0),
-            "kv_stats": eng_pg.kv_stats(),
-            "parity_vs_solo_generate": pg_parity,
-            "recompiles_after_warmup":
-                sum(eng_pg.recompiles.values())
-                + sum(eng_dn.recompiles.values()),
-        }
-        p = record["paged_serving"]
-        log(f"paged serving: {p['max_concurrent_paged']} vs "
-            f"{p['max_concurrent_dense']} concurrent "
-            f"({p['concurrency_gain']}x) at {budget_rows} KV rows, "
-            f"preemptions={p['preemptions']}, parity={pg_parity}")
-
-        # ---- fused paged-decode kernel: ON vs OFF ---------------------- #
-        # ISSUE 14: two paged engines differing ONLY in paged_kernel= run
-        # the identical workload. Off TPU the kernel executes in Pallas
-        # interpret mode, so the tokens/s pair is parity/recompile
-        # EVIDENCE there, not a performance claim — the speedup number is
-        # only meaningful on real hardware (the smoke test gates on
-        # device_kind the same way). The bytes-read model rides along:
-        # it is the analytical XLA-dense-view vs streamed-blocks cost,
-        # computed from the workload's final lengths, chip-free.
-        from chainermn_tpu.parallel.paged_kernel import (
-            bytes_read_model,
-            kernel_supported,
-        )
-
-        def run_kernel_workload():
-            eng = ServingEngine(model, params, prefill_buckets=(pg_prefill,),
-                                prefill_batch=pg_batch, cache_len=pg_cache,
-                                paged=True, kv_blocks=pg_blocks,
-                                kv_block_size=pg_bs, kv_quant=pg_quant,
-                                n_slots=paged_slots, paged_kernel=True)
-            eng.warmup()
-            counts = eng.compile_counts_detailed()
-            s = FCFSScheduler(eng)
-            t0 = time.time()
-            reqs = [s.submit(p_, n_) for p_, n_ in pg_jobs]
-            s.run_until_idle()
-            wall = time.time() - t0
-            assert eng.compile_counts_detailed() == counts, "recompiled!"
-            return eng, s.metrics.report(), reqs, wall
-
-        eng_kn, m_kn, reqs_kn, wall_kn = run_kernel_workload()
-        # the OFF side IS the paged section's engine — identical config
-        # down to paged_kernel=False, same jobs — so its run is reused
-        # rather than rebuilt (the tier-1 bench smoke rides this)
-        eng_kf, m_kf, reqs_kf, wall_kf = eng_pg, m_pg, reqs_pg, wall_pg
-        kn_parity = all(
-            bool(np.array_equal(a.output, b.output))
-            for a, b in zip(reqs_kn, reqs_kf))
-        for i in (0, 1):
-            prompt, n = pg_jobs[i]
-            ref = np.asarray(generate(model, params,
-                                      jnp.asarray(prompt)[None], n)[0])
-            kn_parity = (kn_parity
-                         and bool(np.array_equal(reqs_kn[i].output, ref)))
-        final_lengths = [len(p_) + n_ for p_, n_ in pg_jobs]
-        supported, why = kernel_supported()
-        record["paged_kernel_serving"] = {
-            "kernel_used": bool(eng_kn.paged_kernel),
-            "kernel_supported": supported,
-            "fallback_reason": why,
-            "interpret_mode": jax.default_backend() != "tpu",
-            "device_kind": jax.devices()[0].device_kind,
-            "kv_quant": pg_quant,
-            "kv_block_size": pg_bs,
-            "tokens_per_sec": m_kn["tokens_per_sec"],
-            "tokens_per_sec_off": m_kf["tokens_per_sec"],
-            "wall_s": round(wall_kn, 3),
-            "wall_s_off": round(wall_kf, 3),
-            "parity_vs_xla_and_solo": kn_parity,
-            "recompiles_after_warmup":
-                sum(eng_kn.recompiles.values())
-                + sum(eng_kf.recompiles.values()),
-            "bytes_read_model": bytes_read_model(
-                final_lengths, block_size=pg_bs,
-                max_blocks=-(-pg_cache // pg_bs),
-                n_heads=model.n_heads,
-                head_dim=model.d_model // model.n_heads,
-                n_layers=model.n_layers, kv_quant=pg_quant),
-        }
-        kn = record["paged_kernel_serving"]
-        log(f"paged kernel: used={kn['kernel_used']} "
-            f"(interpret={kn['interpret_mode']}), parity={kn_parity}, "
-            f"read_amp={kn['bytes_read_model']['read_amplification']}x "
-            f"modelled")
-
-        # ---- speculative decode: prompt-lookup drafting ON vs OFF ----- #
-        # ISSUE 12: a shared-system-prompt workload with LONG greedy
-        # generations (the regime speculation targets) through two paged
-        # engines differing ONLY in ``speculative=``; the n-gram drafter
-        # costs no second model, so the tokens/s ratio isolates
-        # multi-token commit per dispatch. Outputs are asserted
-        # token-identical ON vs OFF. A randomly-initialized transformer's
-        # greedy trajectory is aperiodic noise (nothing for prompt-lookup
-        # to mine — accept rate ~0, a pure slowdown), so this section
-        # measures the CONTROLLED-accept-rate regime instead: the random
-        # params are surgically rewritten into a "copy-cycle" model —
-        # every block's output projections zeroed (residual blocks become
-        # identity, attention still computed at full cost), one-hot
-        # embeddings, and an lm_head permutation so greedy decode walks a
-        # period-``sp_period`` token cycle with huge argmax margins. The
-        # accept rate this induces travels in the record; the speedup
-        # number is the dispatch-amortization mechanism, not a claim
-        # about random-weight trajectories.
-        from chainermn_tpu.serving import SpeculativeConfig
-        sp_k = int(e("CHAINERMN_TPU_SERVE_SPEC_K", "6"))
-        sp_max_new = int(e("CHAINERMN_TPU_SERVE_SPEC_MAX_NEW", "64"))
-        sp_requests = int(e("CHAINERMN_TPU_SERVE_SPEC_REQUESTS", "8"))
-        sp_slots = int(e("CHAINERMN_TPU_SERVE_SPEC_SLOTS", "4"))
-        sp_period = int(e("CHAINERMN_TPU_SERVE_SPEC_PERIOD", "4"))
-        # a deliberately tiny model: the section measures dispatch
-        # amortization, which is LARGEST when per-step compute is small,
-        # and two engines (ON + OFF) get compiled from it
-        sp_d = int(e("CHAINERMN_TPU_SERVE_SPEC_DMODEL", "32"))
-        sp_layers = int(e("CHAINERMN_TPU_SERVE_SPEC_LAYERS", "1"))
-        sp_heads = int(e("CHAINERMN_TPU_SERVE_SPEC_HEADS", "2"))
-        sp_vocab = min(vocab, sp_d)          # one-hot rows need d >= vocab
-        sp_model = TransformerLM(
-            vocab_size=sp_vocab, d_model=sp_d, n_heads=sp_heads,
-            n_layers=sp_layers, max_len=prefill_len + sp_max_new)
-        sp_params = jax.device_get(sp_model.init(
-            jax.random.PRNGKey(0), jnp.zeros((1, prefill_len), jnp.int32)))
-        sp_p = sp_params["params"]
-        sp_p["embed"]["embedding"] = (
-            4.0 * np.eye(sp_vocab, sp_d)).astype(np.float32)
-        sp_p["pos_embed"]["embedding"] = np.zeros_like(
-            sp_p["pos_embed"]["embedding"])
-        for li in range(sp_layers):
-            blk = sp_p[f"block_{li}"]
-            for nm in ("proj", "Dense_1"):
-                blk[nm]["kernel"] = np.zeros_like(blk[nm]["kernel"])
-                blk[nm]["bias"] = np.zeros_like(blk[nm]["bias"])
-        sp_head = np.zeros_like(sp_p["lm_head"]["kernel"])
-        for t in range(sp_vocab):     # successor permutation, short cycles
-            sp_head[t, (t // sp_period) * sp_period
-                    + ((t % sp_period) + 1) % sp_period] = 1.0
-        sp_p["lm_head"]["kernel"] = sp_head
-        sp_p["lm_head"]["bias"] = np.zeros_like(sp_p["lm_head"]["bias"])
-        sp_shared = rng.randint(1, sp_vocab, shared_len).astype(np.int32)
-        sp_cache = prefill_len + sp_max_new
-        sp_blocks = sp_slots * (sp_cache // pg_bs + 2) + 1
-        sp_jobs = [
-            (np.concatenate([sp_shared, rng.randint(
-                1, sp_vocab, 1 + i % max(1, tail_max)).astype(np.int32)]),
-             sp_max_new)
-            for i in range(sp_requests)
-        ]
-
-        def run_spec_workload(spec_on):
-            eng = ServingEngine(
-                sp_model, sp_params, n_slots=sp_slots,
-                prefill_buckets=(prefill_len,), prefill_batch=pg_batch,
-                cache_len=sp_cache, paged=True, kv_blocks=sp_blocks,
-                kv_block_size=pg_bs,
-                speculative=(SpeculativeConfig(k=sp_k) if spec_on
-                             else None))
-            eng.warmup()
-            counts = eng.compile_counts_detailed()
-            s = FCFSScheduler(eng)
-            t0 = time.time()
-            reqs = [s.submit(p, n) for p, n in sp_jobs]
-            s.run_until_idle()
-            wall = time.time() - t0
-            assert eng.compile_counts_detailed() == counts, "recompiled!"
-            return eng, s.metrics.report(), reqs, wall
-
-        eng_sp, m_sp, reqs_sp, wall_sp = run_spec_workload(True)
-        eng_ns, m_ns, reqs_ns, wall_ns = run_spec_workload(False)
-        sp_parity = all(
-            bool(np.array_equal(a.output, b.output))
-            for a, b in zip(reqs_sp, reqs_ns))
-        sp_stats = eng_sp.spec_stats()
-        record["speculative_serving"] = {
-            "drafter": "ngram",
-            "spec_k": sp_k,
-            "n_requests": sp_requests,
-            "max_new": sp_max_new,
-            "shared_prefix": shared_len,
-            "cycle_period": sp_period,
-            "model": {"vocab": sp_vocab, "d_model": sp_d,
-                      "n_layers": sp_layers, "n_heads": sp_heads,
-                      "family": "copy-cycle"},
-            "accept_rate": sp_stats["accept_rate"],
-            "spec_tokens_proposed": sp_stats["spec_tokens_proposed"],
-            "spec_tokens_accepted": sp_stats["spec_tokens_accepted"],
-            "tokens_per_sec": m_sp["tokens_per_sec"],
-            "tokens_per_sec_off": m_ns["tokens_per_sec"],
-            "decode_speedup": round(
-                m_sp["tokens_per_sec"]
-                / max(m_ns["tokens_per_sec"], 1e-9), 3),
-            "ttft_p50_ms": round(m_sp["ttft_p50_s"] * 1e3, 3),
-            "ttft_p50_ms_off": round(m_ns["ttft_p50_s"] * 1e3, 3),
-            "tpot_p50_ms": round(m_sp["tpot_p50_s"] * 1e3, 3),
-            "tpot_p50_ms_off": round(m_ns["tpot_p50_s"] * 1e3, 3),
-            "wall_s": round(wall_sp, 3),
-            "wall_s_off": round(wall_ns, 3),
-            "parity_on_vs_off": sp_parity,
-            "recompiles_after_warmup":
-                sum(eng_sp.recompiles.values())
-                + sum(eng_ns.recompiles.values()),
-            "compile_counts": eng_sp.compile_counts_detailed(),
-        }
-        sp = record["speculative_serving"]
-        log(f"speculative serving: accept_rate={sp['accept_rate']} "
-            f"{sp['tokens_per_sec']} vs {sp['tokens_per_sec_off']} tok/s "
-            f"({sp['decode_speedup']}x), parity={sp_parity}")
-
-        # ---- hot swap: online weight publish through the version fence - #
-        # ISSUE 10 serving-continuity probe: n_swaps publishes land in the
-        # base engine while it decodes. Each cycle fills the pool, fences
-        # a swap mid-stream (publish_async — this thread drives step(), so
-        # a blocking publish would deadlock against its own fence), keeps
-        # stepping until the swap lands, then submits post-swap work. The
-        # record carries swap latency p50/max, the tokens/s dip inside the
-        # swap windows vs steady state, the version ledger, and the
-        # zero-recompile invariant across every swap.
-        from chainermn_tpu.deploy import WeightPublisher
-
-        n_swaps = int(e("CHAINERMN_TPU_SERVE_SWAPS", "3"))
-        hs_sched = FCFSScheduler(engine)
-        hs_pub = WeightPublisher(engine, hs_sched)
-        hs_counts = engine.compile_counts_detailed()
-        new_params = jax.tree_util.tree_map(lambda l: l * 1.001, params)
-        base_version = engine.weight_version
-        swap_total, swap_fence, swap_commit = [], [], []
-        window_tokens = window_wall = 0.0
-        versions_ok = True
-        hs_done = 0
-        hs_total = 0
-        t0 = time.time()
-        for k in range(n_swaps):
-            pre = [hs_sched.submit(
-                rng.randint(1, vocab, rng.randint(
-                    1, prefill_len + 1)).astype(np.int32), max_new)
-                for _ in range(n_slots)]
-            hs_sched.step()            # admit the pool on the OLD weights
-            handle = hs_pub.publish_async(new_params)
-            t_sw = time.time()
-            while not handle.done:     # fence drains, swap lands mid-loop
-                window_tokens += hs_sched.step()
-            window_wall += time.time() - t_sw
-            post = [hs_sched.submit(
-                rng.randint(1, vocab, rng.randint(
-                    1, prefill_len + 1)).astype(np.int32), max_new)
-                for _ in range(2)]
-            hs_sched.run_until_idle()
-            swap_total.append(handle.total_s)
-            swap_fence.append(handle.fence_s)
-            swap_commit.append(handle.commit_s)
-            want_pre = base_version + k
-            versions_ok = versions_ok and all(
-                r.weight_version == want_pre for r in pre) and all(
-                r.weight_version == want_pre + 1 for r in post)
-            hs_total += len(pre) + len(post)
-            hs_done += sum(r.state.value == "done" for r in pre + post)
-        wall_hs = time.time() - t0
-        hs_m = hs_sched.metrics.report()
-        steady_tps = hs_m["tokens_per_sec"]
-        window_tps = window_tokens / max(window_wall, 1e-9)
-        assert engine.compile_counts_detailed() == hs_counts, "recompiled!"
-        record["hot_swap"] = {
-            "swaps": n_swaps,
-            "swap_total_s_p50": round(
-                float(np.percentile(swap_total, 50)), 6),
-            "swap_total_s_max": round(float(max(swap_total)), 6),
-            "swap_fence_s_p50": round(
-                float(np.percentile(swap_fence, 50)), 6),
-            "swap_commit_s_p50": round(
-                float(np.percentile(swap_commit, 50)), 6),
-            "tokens_per_sec_steady": steady_tps,
-            "tokens_per_sec_during_swap": round(window_tps, 2),
-            "throughput_dip_frac": round(
-                1.0 - window_tps / max(steady_tps, 1e-9), 4),
-            "requests": hs_total,
-            "requests_done": hs_done,
-            "weight_version": engine.weight_version,
-            "versions_correct": versions_ok,
-            "wall_s": round(wall_hs, 3),
-            "recompiles_after_warmup": sum(engine.recompiles.values()),
-        }
-        hsr = record["hot_swap"]
-        log(f"hot swap: {n_swaps} swaps, total_p50="
-            f"{hsr['swap_total_s_p50'] * 1e3:.1f}ms (fence "
-            f"{hsr['swap_fence_s_p50'] * 1e3:.1f}ms), dip="
-            f"{hsr['throughput_dip_frac']}, versions_ok={versions_ok}, "
-            f"recompiles={hsr['recompiles_after_warmup']}")
-
-        # ---- fleet: N replicas vs 1 at equal total KV budget (ISSUE 8) - #
-        # The SAME prefix-heavy workload through a FleetRouter over
-        # fl_n replicas of n_slots/fl_n slots each (total KV budget ==
-        # the solo prefix engine above, whose numbers are the baseline),
-        # plus the kill-one-replica continuity probe: replica 0 is
-        # hard-killed once it owns live work — its queued/in-flight
-        # requests must re-route (replayed, stream-dedup'd) or end
-        # cleanly ERRORED per deadline policy; none may be lost.
-        from chainermn_tpu.fleet import FleetRouter
-        from chainermn_tpu.serving.scheduler import DeadlineExceededError
-
-        fl_n = int(e("CHAINERMN_TPU_SERVE_FLEET_REPLICAS", "2"))
-        fl_slots = max(1, n_slots // fl_n)
-        fl_engines = [ServingEngine(
-            model, params, n_slots=fl_slots, prefill_buckets=buckets,
-            prefill_batch=batch_k, prefix_cache_blocks=n_blocks,
-            prefix_block_size=block, prefix_min_insert_blocks=min_insert)
-            for _ in range(fl_n)]
-        router = FleetRouter(fl_engines, affinity=True)
-        fl_col = None
-        try:
-            assert router.wait_ready(600), "fleet warmup timed out"
-            # continuous telemetry rides the fleet run too (ISSUE 15):
-            # per-replica sensors + health scoring + routing penalty,
-            # sampled by a background collector for the whole probe
-            from chainermn_tpu.monitor.health import fleet_health
-
-            fl_col = fleet_health(router, cadence_s=ts_cadence,
-                                  stall_timeout_s=60.0)
-            fl_col.start()
-            t0 = time.time()
-            frs = [router.submit(prompt, n) for prompt, n in jobs]
-            kill_deadline = time.time() + 60
-            while time.time() < kill_deadline:
-                snap0 = router.replicas[0].snapshot()
-                if snap0.queue_depth + snap0.active_slots > 0:
-                    break
-                if all(fr.finished for fr in frs):
-                    break
-                time.sleep(0.001)
-            router.kill_replica(0)
-            finished = [fr.wait(timeout=600) for fr in frs]
-            wall_fl = time.time() - t0
-            # the health verdict is scored on the collector cadence: give
-            # it a bounded window to observe the quarantine before the
-            # report is captured (deterministic, not sleep-and-hope)
-            h_deadline = time.time() + 30
-            while time.time() < h_deadline:
-                h = router.fleet_report().get("health") or {}
-                if h.get("replicas", {}).get("0", {}).get(
-                        "state") == "critical":
-                    break
-                time.sleep(ts_cadence)
-            rep = router.fleet_report()
-            fl_parity = True
+            eng_on, m_on, reqs_on, wall_on = run_prefix_workload(True)
+            eng_off, m_off, _, wall_off = run_prefix_workload(False)
+            # token-for-token parity vs solo generate() (greedy), through
+            # prefix fetch + batched suffix prefill
+            parity = True
             for i in (0, 1):
                 prompt, n = jobs[i]
-                if frs[i].state.value != "done":
-                    continue
                 ref = np.asarray(generate(model, params,
                                           jnp.asarray(prompt)[None], n)[0])
-                fl_parity = fl_parity and bool(
-                    np.array_equal(frs[i].output, ref))
-            lost = [fr.id for fr in frs
-                    if not fr.finished
-                    or (fr.state.value != "done"
-                        and not isinstance(fr.error, DeadlineExceededError))]
-            survivors = [r for r in router.replicas
-                         if r.state.value != "quarantined"]
-            pooled = rep["pooled"]
-            pooled_ttft = pooled["histograms"].get(
-                "serving_ttft_seconds", {})
-            fl_tokens = pooled["counters"].get("serving_tokens_total", 0)
-            record["fleet_serving"] = {
-                "replicas": fl_n,
-                "slots_per_replica": fl_slots,
-                "solo_slots": n_slots,
-                "requests": len(jobs),
-                "done": sum(fr.state.value == "done" for fr in frs),
-                "all_terminal": all(finished),
-                "no_request_lost": not lost,
-                "killed_replica_quarantined":
-                    router.replicas[0].state.value == "quarantined",
-                "capacity_after_kill": rep["capacity"],
-                "reroutes": rep["reroutes_total"],
-                "shed": rep["shed_total"],
-                "route_fallbacks": rep["route_fallbacks_total"],
-                "affinity_hit_rate": rep["affinity"]["hit_rate"],
-                "tokens_per_sec": round(fl_tokens / max(wall_fl, 1e-9), 2),
-                "tokens_per_sec_solo": m_on["tokens_per_sec"],
-                "ttft_p50_ms": round(
-                    pooled_ttft.get("p50_s", 0.0) * 1e3, 3),
-                "ttft_p99_ms": round(
-                    pooled_ttft.get("p99_s", 0.0) * 1e3, 3),
-                "ttft_p50_ms_solo": round(m_on["ttft_p50_s"] * 1e3, 3),
-                "wall_s": round(wall_fl, 3),
-                "parity_vs_solo_generate": fl_parity,
-                "recompiles_after_warmup_survivors": sum(
-                    sum(r.engine.recompiles.values()) for r in survivors),
-                "replica_states": {k: v["state"]
-                                   for k, v in rep["replicas"].items()},
-                # the health monitor's verdicts at probe end: the killed
-                # replica must have gone critical, survivors healthy
-                "health": rep.get("health"),
-                "ts_series": len(fl_col.store.names()),
-                "ts_ticks": fl_col.ticks,
+                parity = parity and bool(np.array_equal(reqs_on[i].output, ref))
+            pstats = eng_on.prefix_stats()
+            record["prefix_serving"] = {
+                "buckets": list(buckets),
+                "prefill_batch": batch_k,
+                "shared_prefix": shared_len,
+                "prefix_blocks": n_blocks,
+                "block_size": block,
+                # per-ADMISSION hit rate (fraction of admitted requests whose
+                # prompt was partly served from cache); the trie's own stats
+                # (below) count every match probe incl. re-scanned candidates
+                "hit_rate": m_on.get("prefix_hit_rate", 0.0),
+                "trie": pstats,
+                "evictions": pstats["evictions"],
+                "cached_prefix_frac_mean": m_on.get("cached_prefix_frac_mean",
+                                                    0.0),
+                "prefill_batch_occupancy":
+                    m_on.get("prefill_batch_size_mean", 0.0),
+                "ttft_p50_ms": round(m_on["ttft_p50_s"] * 1e3, 3),
+                "ttft_p99_ms": round(m_on["ttft_p99_s"] * 1e3, 3),
+                "ttft_p50_ms_off": round(m_off["ttft_p50_s"] * 1e3, 3),
+                "ttft_p99_ms_off": round(m_off["ttft_p99_s"] * 1e3, 3),
+                "ttft_p50_speedup": round(
+                    m_off["ttft_p50_s"] / max(m_on["ttft_p50_s"], 1e-9), 3),
+                "tokens_per_sec": m_on["tokens_per_sec"],
+                "tokens_per_sec_off": m_off["tokens_per_sec"],
+                "wall_s": round(wall_on, 3),
+                "wall_s_off": round(wall_off, 3),
+                "recompiles_after_warmup":
+                    sum(eng_on.recompiles.values())
+                    + sum(eng_off.recompiles.values()),
+                "parity_vs_solo_generate": parity,
+                "compile_counts": eng_on.compile_counts_detailed(),
             }
-            # rolling publish through the surviving replicas: the
-            # quarantined kill-probe victim is skipped, everyone still
-            # accepting takes the new version with zero recompiles
-            pub_out = router.publish(new_params, timeout=120.0)
-            rep2 = router.fleet_report()
-            record["fleet_serving"]["publish"] = {
-                "ok": pub_out["ok"],
-                "outcomes": pub_out["replicas"],
-                "weight_versions": {
-                    k: v["weight_version"]
-                    for k, v in rep2["replicas"].items()},
-                "recompiles_after_publish_survivors": sum(
-                    sum(r.engine.recompiles.values()) for r in survivors),
+            log(f"prefix serving: "
+                f"hit_rate={record['prefix_serving']['hit_rate']} "
+                f"ttft_p50 {record['prefix_serving']['ttft_p50_ms']}ms (on) vs "
+                f"{record['prefix_serving']['ttft_p50_ms_off']}ms (off), "
+                f"parity={parity}")
+
+        if "paged_serving" in skip_sections:
+            log("paged_serving: skipped via CHAINERMN_TPU_SERVE_SKIP_SECTIONS")
+        else:
+            # ---- paged KV decode: ON vs OFF at the SAME device KV budget - #
+            # The PR-7 acceptance: a dense engine reserves cache_len rows per
+            # slot regardless of what requests actually use, so concurrency =
+            # n_slots. The paged engine spends the SAME row budget as a block
+            # pool and admits by blocks actually needed — short requests pack
+            # 4x+ more concurrent decodes into identical memory (worst-case
+            # block-budget admission, so zero preemptions in the clean run).
+            pg_prefill = int(e("CHAINERMN_TPU_SERVE_PAGED_PREFILL", "16"))
+            pg_cache = int(e("CHAINERMN_TPU_SERVE_PAGED_CACHE", "64"))
+            pg_bs = int(e("CHAINERMN_TPU_SERVE_KV_BLOCK", "8"))
+            pg_batch = int(e("CHAINERMN_TPU_SERVE_PAGED_BATCH", "4"))
+            pg_max_new = int(e("CHAINERMN_TPU_SERVE_PAGED_MAX_NEW", "6"))
+            pg_quant = e("CHAINERMN_TPU_SERVE_KV_QUANT", "none")
+            dense_slots = int(e("CHAINERMN_TPU_SERVE_DENSE_SLOTS", "2"))
+            paged_slots = int(e("CHAINERMN_TPU_SERVE_PAGED_SLOTS", "12"))
+            budget_rows = dense_slots * pg_cache       # dense-resident KV rows
+            pg_blocks = budget_rows // pg_bs + 1       # same rows (+ scratch)
+            pg_jobs = [
+                (rng.randint(1, vocab,
+                             2 + i % (pg_prefill // 2 - 1)).astype(np.int32),
+                 pg_max_new)
+                for i in range(int(e("CHAINERMN_TPU_SERVE_PAGED_REQUESTS",
+                                     "16")))
+            ]
+
+            def run_paged_workload(paged_on):
+                kw = (dict(paged=True, kv_blocks=pg_blocks, kv_block_size=pg_bs,
+                           kv_quant=pg_quant, n_slots=paged_slots)
+                      if paged_on else dict(n_slots=dense_slots))
+                eng = ServingEngine(model, params, prefill_buckets=(pg_prefill,),
+                                    prefill_batch=pg_batch, cache_len=pg_cache,
+                                    **kw)
+                eng.warmup()
+                counts = eng.compile_counts_detailed()
+                s = FCFSScheduler(eng)
+                t0 = time.time()
+                reqs = [s.submit(p, n) for p, n in pg_jobs]
+                s.run_until_idle()
+                wall = time.time() - t0
+                assert eng.compile_counts_detailed() == counts, "recompiled!"
+                return eng, s.metrics.report(), reqs, wall
+
+            eng_pg, m_pg, reqs_pg, wall_pg = run_paged_workload(True)
+            eng_dn, m_dn, reqs_dn, wall_dn = run_paged_workload(False)
+            pg_parity = True
+            for i in (0, 1):
+                prompt, n = pg_jobs[i]
+                ref = np.asarray(generate(model, params,
+                                          jnp.asarray(prompt)[None], n)[0])
+                pg_parity = (pg_parity
+                             and bool(np.array_equal(reqs_pg[i].output, ref))
+                             and bool(np.array_equal(reqs_dn[i].output, ref)))
+            record["paged_serving"] = {
+                "kv_blocks": pg_blocks,
+                "kv_block_size": pg_bs,
+                "kv_quant": pg_quant,
+                "kv_budget_rows": budget_rows,
+                "dense_slots": dense_slots,
+                "paged_slots": paged_slots,
+                "max_concurrent_paged": eng_pg.peak_active,
+                "max_concurrent_dense": eng_dn.peak_active,
+                "concurrency_gain": round(
+                    eng_pg.peak_active / max(eng_dn.peak_active, 1), 3),
+                "tokens_per_sec": m_pg["tokens_per_sec"],
+                "tokens_per_sec_dense": m_dn["tokens_per_sec"],
+                "wall_s": round(wall_pg, 3),
+                "wall_s_dense": round(wall_dn, 3),
+                "preemptions": m_pg.get("kv_preemptions", 0),
+                "kv_blocks_per_request_mean":
+                    m_pg.get("kv_blocks_per_request_mean", 0.0),
+                "kv_stats": eng_pg.kv_stats(),
+                "parity_vs_solo_generate": pg_parity,
+                "recompiles_after_warmup":
+                    sum(eng_pg.recompiles.values())
+                    + sum(eng_dn.recompiles.values()),
             }
-        finally:
-            if fl_col is not None:
-                fl_col.stop()
-            router.close()
-        fl = record["fleet_serving"]
-        log(f"fleet serving: {fl['replicas']}x{fl['slots_per_replica']} "
-            f"slots, done {fl['done']}/{fl['requests']} through a "
-            f"mid-run replica kill (reroutes={fl['reroutes']}, "
-            f"lost={not fl['no_request_lost']}), affinity "
-            f"hit_rate={fl['affinity_hit_rate']}, parity={fl_parity}")
+            p = record["paged_serving"]
+            log(f"paged serving: {p['max_concurrent_paged']} vs "
+                f"{p['max_concurrent_dense']} concurrent "
+                f"({p['concurrency_gain']}x) at {budget_rows} KV rows, "
+                f"preemptions={p['preemptions']}, parity={pg_parity}")
 
-        # ---- fleet autoscale: diurnal arrivals (ISSUE 16) ------------- #
-        # A compressed diurnal cycle: sinusoidal arrival rate over one
-        # window (trough -> peak -> trough) against a fleet that starts
-        # at min_replicas with the closed-loop controller LIVE. Replica
-        # count must track load — scale up under the peak, retire back
-        # to the floor in the trough — with zero requests lost.
-        import math
+        if "paged_kernel_serving" in skip_sections:
+            log("paged_kernel_serving: skipped via "
+                "CHAINERMN_TPU_SERVE_SKIP_SECTIONS")
+        else:
+            # ---- fused paged-decode kernel: ON vs OFF ---------------------- #
+            # ISSUE 14: two paged engines differing ONLY in paged_kernel= run
+            # the identical workload. Off TPU the kernel executes in Pallas
+            # interpret mode, so the tokens/s pair is parity/recompile
+            # EVIDENCE there, not a performance claim — the speedup number is
+            # only meaningful on real hardware (the smoke test gates on
+            # device_kind the same way). The bytes-read model rides along:
+            # it is the analytical XLA-dense-view vs streamed-blocks cost,
+            # computed from the workload's final lengths, chip-free.
+            from chainermn_tpu.parallel.paged_kernel import (
+                bytes_read_model,
+                kernel_supported,
+            )
 
-        from chainermn_tpu.fleet import AutoscalePolicy, FleetController
+            def run_kernel_workload():
+                eng = ServingEngine(model, params, prefill_buckets=(pg_prefill,),
+                                    prefill_batch=pg_batch, cache_len=pg_cache,
+                                    paged=True, kv_blocks=pg_blocks,
+                                    kv_block_size=pg_bs, kv_quant=pg_quant,
+                                    n_slots=paged_slots, paged_kernel=True)
+                eng.warmup()
+                counts = eng.compile_counts_detailed()
+                s = FCFSScheduler(eng)
+                t0 = time.time()
+                reqs = [s.submit(p_, n_) for p_, n_ in pg_jobs]
+                s.run_until_idle()
+                wall = time.time() - t0
+                assert eng.compile_counts_detailed() == counts, "recompiled!"
+                return eng, s.metrics.report(), reqs, wall
 
-        as_window = float(e("CHAINERMN_TPU_SERVE_AS_WINDOW", "6.0"))
-        # arrival rates are expressed as MULTIPLES of one replica's
-        # measured service rate, so the peak is a genuine overload on
-        # any machine (a fixed req/s would be a no-op on a fast box)
-        as_base_x = float(e("CHAINERMN_TPU_SERVE_AS_BASE_X", "0.3"))
-        as_peak_x = float(e("CHAINERMN_TPU_SERVE_AS_PEAK_X", "3.0"))
-        as_cap = int(e("CHAINERMN_TPU_SERVE_AS_MAX_REQUESTS", "400"))
-        as_min = int(e("CHAINERMN_TPU_SERVE_AS_MIN", "1"))
-        as_max = int(e("CHAINERMN_TPU_SERVE_AS_MAX", "3"))
-        as_prefill, as_new = 16, 12
+            eng_kn, m_kn, reqs_kn, wall_kn = run_kernel_workload()
+            # the OFF side IS the paged section's engine — identical config
+            # down to paged_kernel=False, same jobs — so its run is reused
+            # rather than rebuilt (the tier-1 bench smoke rides this)
+            eng_kf, m_kf, reqs_kf, wall_kf = eng_pg, m_pg, reqs_pg, wall_pg
+            kn_parity = all(
+                bool(np.array_equal(a.output, b.output))
+                for a, b in zip(reqs_kn, reqs_kf))
+            for i in (0, 1):
+                prompt, n = pg_jobs[i]
+                ref = np.asarray(generate(model, params,
+                                          jnp.asarray(prompt)[None], n)[0])
+                kn_parity = (kn_parity
+                             and bool(np.array_equal(reqs_kn[i].output, ref)))
+            final_lengths = [len(p_) + n_ for p_, n_ in pg_jobs]
+            supported, why = kernel_supported()
+            record["paged_kernel_serving"] = {
+                "kernel_used": bool(eng_kn.paged_kernel),
+                "kernel_supported": supported,
+                "fallback_reason": why,
+                "interpret_mode": jax.default_backend() != "tpu",
+                "device_kind": jax.devices()[0].device_kind,
+                "kv_quant": pg_quant,
+                "kv_block_size": pg_bs,
+                "tokens_per_sec": m_kn["tokens_per_sec"],
+                "tokens_per_sec_off": m_kf["tokens_per_sec"],
+                "wall_s": round(wall_kn, 3),
+                "wall_s_off": round(wall_kf, 3),
+                "parity_vs_xla_and_solo": kn_parity,
+                "recompiles_after_warmup":
+                    sum(eng_kn.recompiles.values())
+                    + sum(eng_kf.recompiles.values()),
+                "bytes_read_model": bytes_read_model(
+                    final_lengths, block_size=pg_bs,
+                    max_blocks=-(-pg_cache // pg_bs),
+                    n_heads=model.n_heads,
+                    head_dim=model.d_model // model.n_heads,
+                    n_layers=model.n_layers, kv_quant=pg_quant),
+            }
+            kn = record["paged_kernel_serving"]
+            log(f"paged kernel: used={kn['kernel_used']} "
+                f"(interpret={kn['interpret_mode']}), parity={kn_parity}, "
+                f"read_amp={kn['bytes_read_model']['read_amplification']}x "
+                f"modelled")
 
-        def as_engine():
-            # deliberately small: ONE slot per replica, so the diurnal
-            # peak genuinely exceeds a single replica's service rate
-            return ServingEngine(model, params, n_slots=1,
-                                 prefill_len=as_prefill,
-                                 cache_len=as_prefill + as_new + 4)
+        if "speculative_serving" in skip_sections:
+            log("speculative_serving: skipped via "
+                "CHAINERMN_TPU_SERVE_SKIP_SECTIONS")
+        else:
+            # ---- speculative decode: prompt-lookup drafting ON vs OFF ----- #
+            # ISSUE 12: a shared-system-prompt workload with LONG greedy
+            # generations (the regime speculation targets) through two paged
+            # engines differing ONLY in ``speculative=``; the n-gram drafter
+            # costs no second model, so the tokens/s ratio isolates
+            # multi-token commit per dispatch. Outputs are asserted
+            # token-identical ON vs OFF. A randomly-initialized transformer's
+            # greedy trajectory is aperiodic noise (nothing for prompt-lookup
+            # to mine — accept rate ~0, a pure slowdown), so this section
+            # measures the CONTROLLED-accept-rate regime instead: the random
+            # params are surgically rewritten into a "copy-cycle" model —
+            # every block's output projections zeroed (residual blocks become
+            # identity, attention still computed at full cost), one-hot
+            # embeddings, and an lm_head permutation so greedy decode walks a
+            # period-``sp_period`` token cycle with huge argmax margins. The
+            # accept rate this induces travels in the record; the speedup
+            # number is the dispatch-amortization mechanism, not a claim
+            # about random-weight trajectories.
+            from chainermn_tpu.serving import SpeculativeConfig
+            sp_k = int(e("CHAINERMN_TPU_SERVE_SPEC_K", "6"))
+            sp_max_new = int(e("CHAINERMN_TPU_SERVE_SPEC_MAX_NEW", "64"))
+            sp_requests = int(e("CHAINERMN_TPU_SERVE_SPEC_REQUESTS", "8"))
+            sp_slots = int(e("CHAINERMN_TPU_SERVE_SPEC_SLOTS", "4"))
+            sp_period = int(e("CHAINERMN_TPU_SERVE_SPEC_PERIOD", "4"))
+            # a deliberately tiny model: the section measures dispatch
+            # amortization, which is LARGEST when per-step compute is small,
+            # and two engines (ON + OFF) get compiled from it
+            sp_d = int(e("CHAINERMN_TPU_SERVE_SPEC_DMODEL", "32"))
+            sp_layers = int(e("CHAINERMN_TPU_SERVE_SPEC_LAYERS", "1"))
+            sp_heads = int(e("CHAINERMN_TPU_SERVE_SPEC_HEADS", "2"))
+            sp_vocab = min(vocab, sp_d)          # one-hot rows need d >= vocab
+            sp_model = TransformerLM(
+                vocab_size=sp_vocab, d_model=sp_d, n_heads=sp_heads,
+                n_layers=sp_layers, max_len=prefill_len + sp_max_new)
+            sp_params = jax.device_get(sp_model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, prefill_len), jnp.int32)))
+            sp_p = sp_params["params"]
+            sp_p["embed"]["embedding"] = (
+                4.0 * np.eye(sp_vocab, sp_d)).astype(np.float32)
+            sp_p["pos_embed"]["embedding"] = np.zeros_like(
+                sp_p["pos_embed"]["embedding"])
+            for li in range(sp_layers):
+                blk = sp_p[f"block_{li}"]
+                for nm in ("proj", "Dense_1"):
+                    blk[nm]["kernel"] = np.zeros_like(blk[nm]["kernel"])
+                    blk[nm]["bias"] = np.zeros_like(blk[nm]["bias"])
+            sp_head = np.zeros_like(sp_p["lm_head"]["kernel"])
+            for t in range(sp_vocab):     # successor permutation, short cycles
+                sp_head[t, (t // sp_period) * sp_period
+                        + ((t % sp_period) + 1) % sp_period] = 1.0
+            sp_p["lm_head"]["kernel"] = sp_head
+            sp_p["lm_head"]["bias"] = np.zeros_like(sp_p["lm_head"]["bias"])
+            sp_shared = rng.randint(1, sp_vocab, shared_len).astype(np.int32)
+            sp_cache = prefill_len + sp_max_new
+            sp_blocks = sp_slots * (sp_cache // pg_bs + 2) + 1
+            sp_jobs = [
+                (np.concatenate([sp_shared, rng.randint(
+                    1, sp_vocab, 1 + i % max(1, tail_max)).astype(np.int32)]),
+                 sp_max_new)
+                for i in range(sp_requests)
+            ]
 
-        router2 = FleetRouter([as_engine() for _ in range(as_min)])
-        ctrl = as_col = None
-        try:
-            assert router2.wait_ready(600), "autoscale warmup timed out"
-            rng2 = np.random.RandomState(7)
-            # calibrate: sequential service time of this request shape on
-            # the floor fleet — the sinusoid's amplitude is set off it
-            t_cal = time.time()
-            for _ in range(3):
-                p2 = rng2.randint(1, vocab, size=8).astype(np.int32)
-                router2.submit(p2, as_new).wait(timeout=600)
-            svc_s = max((time.time() - t_cal) / 3.0, 1e-3)
-            as_base = as_base_x / svc_s
-            as_peak = as_peak_x / svc_s
-            as_col = fleet_health(router2, cadence_s=ts_cadence,
-                                  stall_timeout_s=60.0)
-            as_col.start()
-            ctrl = FleetController(
-                router2, as_col, engine_factory=as_engine,
-                autoscale=AutoscalePolicy(
-                    min_replicas=as_min, max_replicas=as_max,
-                    queue_high=1.0, idle_low=0.25, up_after_s=0.2,
-                    down_after_s=0.8, cooldown_s=0.3),
-                cadence_s=0.05, sensor_kw=dict(stall_timeout_s=60.0))
-            ctrl.start()
+            def run_spec_workload(spec_on):
+                eng = ServingEngine(
+                    sp_model, sp_params, n_slots=sp_slots,
+                    prefill_buckets=(prefill_len,), prefill_batch=pg_batch,
+                    cache_len=sp_cache, paged=True, kv_blocks=sp_blocks,
+                    kv_block_size=pg_bs,
+                    speculative=(SpeculativeConfig(k=sp_k) if spec_on
+                                 else None))
+                eng.warmup()
+                counts = eng.compile_counts_detailed()
+                s = FCFSScheduler(eng)
+                t0 = time.time()
+                reqs = [s.submit(p, n) for p, n in sp_jobs]
+                s.run_until_idle()
+                wall = time.time() - t0
+                assert eng.compile_counts_detailed() == counts, "recompiled!"
+                return eng, s.metrics.report(), reqs, wall
+
+            eng_sp, m_sp, reqs_sp, wall_sp = run_spec_workload(True)
+            eng_ns, m_ns, reqs_ns, wall_ns = run_spec_workload(False)
+            sp_parity = all(
+                bool(np.array_equal(a.output, b.output))
+                for a, b in zip(reqs_sp, reqs_ns))
+            sp_stats = eng_sp.spec_stats()
+            record["speculative_serving"] = {
+                "drafter": "ngram",
+                "spec_k": sp_k,
+                "n_requests": sp_requests,
+                "max_new": sp_max_new,
+                "shared_prefix": shared_len,
+                "cycle_period": sp_period,
+                "model": {"vocab": sp_vocab, "d_model": sp_d,
+                          "n_layers": sp_layers, "n_heads": sp_heads,
+                          "family": "copy-cycle"},
+                "accept_rate": sp_stats["accept_rate"],
+                "spec_tokens_proposed": sp_stats["spec_tokens_proposed"],
+                "spec_tokens_accepted": sp_stats["spec_tokens_accepted"],
+                "tokens_per_sec": m_sp["tokens_per_sec"],
+                "tokens_per_sec_off": m_ns["tokens_per_sec"],
+                "decode_speedup": round(
+                    m_sp["tokens_per_sec"]
+                    / max(m_ns["tokens_per_sec"], 1e-9), 3),
+                "ttft_p50_ms": round(m_sp["ttft_p50_s"] * 1e3, 3),
+                "ttft_p50_ms_off": round(m_ns["ttft_p50_s"] * 1e3, 3),
+                "tpot_p50_ms": round(m_sp["tpot_p50_s"] * 1e3, 3),
+                "tpot_p50_ms_off": round(m_ns["tpot_p50_s"] * 1e3, 3),
+                "wall_s": round(wall_sp, 3),
+                "wall_s_off": round(wall_ns, 3),
+                "parity_on_vs_off": sp_parity,
+                "recompiles_after_warmup":
+                    sum(eng_sp.recompiles.values())
+                    + sum(eng_ns.recompiles.values()),
+                "compile_counts": eng_sp.compile_counts_detailed(),
+            }
+            sp = record["speculative_serving"]
+            log(f"speculative serving: accept_rate={sp['accept_rate']} "
+                f"{sp['tokens_per_sec']} vs {sp['tokens_per_sec_off']} tok/s "
+                f"({sp['decode_speedup']}x), parity={sp_parity}")
+
+        if "hot_swap" in skip_sections:
+            log("hot_swap: skipped via CHAINERMN_TPU_SERVE_SKIP_SECTIONS")
+        else:
+            # -- hot swap: online weight publish through the version fence - #
+            # ISSUE 10 serving-continuity probe: n_swaps publishes land in the
+            # base engine while it decodes. Each cycle fills the pool, fences
+            # a swap mid-stream (publish_async: this thread drives step(), so
+            # a blocking publish would deadlock against its own fence), keeps
+            # stepping until the swap lands, then submits post-swap work. The
+            # record carries swap latency p50/max, the tokens/s dip inside the
+            # swap windows vs steady state, the version ledger, and the
+            # zero-recompile invariant across every swap.
+            from chainermn_tpu.deploy import WeightPublisher
+
+            n_swaps = int(e("CHAINERMN_TPU_SERVE_SWAPS", "3"))
+            hs_sched = FCFSScheduler(engine)
+            hs_pub = WeightPublisher(engine, hs_sched)
+            hs_counts = engine.compile_counts_detailed()
+            new_params = jax.tree_util.tree_map(lambda l: l * 1.001, params)
+            base_version = engine.weight_version
+            swap_total, swap_fence, swap_commit = [], [], []
+            window_tokens = window_wall = 0.0
+            versions_ok = True
+            hs_done = 0
+            hs_total = 0
             t0 = time.time()
-            as_frs, caps = [], []
-            while ((el := time.time() - t0) < as_window
-                   and len(as_frs) < as_cap):
-                rate = as_base + (as_peak - as_base) * 0.5 * (
-                    1.0 - math.cos(2.0 * math.pi * el / as_window))
-                # ~50ms arrival chunks: sleep() granularity stays sane
-                # even when the calibrated peak is hundreds of req/s
-                burst = max(1, int(rate * 0.05))
-                for _ in range(burst):
-                    p2 = rng2.randint(
-                        1, vocab, size=rng2.randint(4, 9)).astype(np.int32)
-                    as_frs.append(router2.submit(p2, as_new))
-                caps.append(router2.capacity)
-                time.sleep(burst / max(rate, 0.5))
-            as_done = [fr.wait(timeout=600) for fr in as_frs]
-            # the trough: give the controller a bounded window to see
-            # sustained idleness and retire back down to the floor
-            down_deadline = time.time() + 60
-            while (time.time() < down_deadline
-                   and router2.capacity > as_min):
-                time.sleep(0.05)
-            caps.append(router2.capacity)
-            wall_as = round(time.time() - t0, 3)
-            crep = ctrl.report()
-            as_lost = [fr.id for fr in as_frs
-                       if not fr.finished or fr.state.value != "done"]
-            record["fleet_autoscale"] = {
-                "window_s": as_window,
-                "service_s_calibrated": round(svc_s, 4),
-                "arrival_base_hz": round(as_base, 2),
-                "arrival_peak_hz": round(as_peak, 2),
-                "requests": len(as_frs),
-                "done": sum(fr.state.value == "done" for fr in as_frs),
-                "all_terminal": all(as_done),
-                "no_request_lost": not as_lost,
-                "min_replicas": as_min,
-                "max_replicas": as_max,
-                "peak_capacity": max(caps),
-                "final_capacity": router2.capacity,
-                "scale_ups": crep["autoscale"]["scale_ups"],
-                "scale_downs": crep["autoscale"]["scale_downs"],
-                "replica_count_tracks_load": bool(
-                    max(caps) > as_min and router2.capacity == as_min),
-                "recompiles_after_warmup": sum(
-                    sum(r.engine.recompiles.values())
-                    for r in router2.replicas if r.accepting),
-                "decisions": crep["decisions"],
-                "wall_s": wall_as,
+            for k in range(n_swaps):
+                pre = [hs_sched.submit(
+                    rng.randint(1, vocab, rng.randint(
+                        1, prefill_len + 1)).astype(np.int32), max_new)
+                    for _ in range(n_slots)]
+                hs_sched.step()            # admit the pool on the OLD weights
+                handle = hs_pub.publish_async(new_params)
+                t_sw = time.time()
+                while not handle.done:     # fence drains, swap lands mid-loop
+                    window_tokens += hs_sched.step()
+                window_wall += time.time() - t_sw
+                post = [hs_sched.submit(
+                    rng.randint(1, vocab, rng.randint(
+                        1, prefill_len + 1)).astype(np.int32), max_new)
+                    for _ in range(2)]
+                hs_sched.run_until_idle()
+                swap_total.append(handle.total_s)
+                swap_fence.append(handle.fence_s)
+                swap_commit.append(handle.commit_s)
+                want_pre = base_version + k
+                versions_ok = versions_ok and all(
+                    r.weight_version == want_pre for r in pre) and all(
+                    r.weight_version == want_pre + 1 for r in post)
+                hs_total += len(pre) + len(post)
+                hs_done += sum(r.state.value == "done" for r in pre + post)
+            wall_hs = time.time() - t0
+            hs_m = hs_sched.metrics.report()
+            steady_tps = hs_m["tokens_per_sec"]
+            window_tps = window_tokens / max(window_wall, 1e-9)
+            assert engine.compile_counts_detailed() == hs_counts, "recompiled!"
+            record["hot_swap"] = {
+                "swaps": n_swaps,
+                "swap_total_s_p50": round(
+                    float(np.percentile(swap_total, 50)), 6),
+                "swap_total_s_max": round(float(max(swap_total)), 6),
+                "swap_fence_s_p50": round(
+                    float(np.percentile(swap_fence, 50)), 6),
+                "swap_commit_s_p50": round(
+                    float(np.percentile(swap_commit, 50)), 6),
+                "tokens_per_sec_steady": steady_tps,
+                "tokens_per_sec_during_swap": round(window_tps, 2),
+                "throughput_dip_frac": round(
+                    1.0 - window_tps / max(steady_tps, 1e-9), 4),
+                "requests": hs_total,
+                "requests_done": hs_done,
+                "weight_version": engine.weight_version,
+                "versions_correct": versions_ok,
+                "wall_s": round(wall_hs, 3),
+                "recompiles_after_warmup": sum(engine.recompiles.values()),
             }
-        finally:
-            if ctrl is not None:
-                ctrl.stop()
-            if as_col is not None:
-                as_col.stop()
-            router2.close()
-        fa = record["fleet_autoscale"]
-        log(f"fleet autoscale: {fa['requests']} diurnal arrivals over "
-            f"{fa['window_s']}s, capacity {fa['min_replicas']}->"
-            f"{fa['peak_capacity']}->{fa['final_capacity']} "
-            f"(ups={fa['scale_ups']}, downs={fa['scale_downs']}), "
-            f"lost={not fa['no_request_lost']}")
+            hsr = record["hot_swap"]
+            log(f"hot swap: {n_swaps} swaps, total_p50="
+                f"{hsr['swap_total_s_p50'] * 1e3:.1f}ms (fence "
+                f"{hsr['swap_fence_s_p50'] * 1e3:.1f}ms), dip="
+                f"{hsr['throughput_dip_frac']}, versions_ok={versions_ok}, "
+                f"recompiles={hsr['recompiles_after_warmup']}")
+
+        if "fleet_serving" in skip_sections:
+            log("fleet_serving: skipped via CHAINERMN_TPU_SERVE_SKIP_SECTIONS")
+        else:
+            # -- fleet: N replicas vs 1 at equal total KV budget (ISSUE 8) - #
+            # The SAME prefix-heavy workload through a FleetRouter over
+            # fl_n replicas of n_slots/fl_n slots each (total KV budget ==
+            # the solo prefix engine above, whose numbers are the baseline),
+            # plus the kill-one-replica continuity probe: replica 0 is
+            # hard-killed once it owns live work — its queued/in-flight
+            # requests must re-route (replayed, stream-dedup'd) or end
+            # cleanly ERRORED per deadline policy; none may be lost.
+            from chainermn_tpu.fleet import FleetRouter
+            from chainermn_tpu.serving.scheduler import DeadlineExceededError
+
+            fl_n = int(e("CHAINERMN_TPU_SERVE_FLEET_REPLICAS", "2"))
+            fl_slots = max(1, n_slots // fl_n)
+            fl_engines = [ServingEngine(
+                model, params, n_slots=fl_slots, prefill_buckets=buckets,
+                prefill_batch=batch_k, prefix_cache_blocks=n_blocks,
+                prefix_block_size=block, prefix_min_insert_blocks=min_insert)
+                for _ in range(fl_n)]
+            router = FleetRouter(fl_engines, affinity=True)
+            fl_col = None
+            try:
+                assert router.wait_ready(600), "fleet warmup timed out"
+                # continuous telemetry rides the fleet run too (ISSUE 15):
+                # per-replica sensors + health scoring + routing penalty,
+                # sampled by a background collector for the whole probe
+                from chainermn_tpu.monitor.health import fleet_health
+
+                fl_col = fleet_health(router, cadence_s=ts_cadence,
+                                      stall_timeout_s=60.0)
+                fl_col.start()
+                t0 = time.time()
+                frs = [router.submit(prompt, n) for prompt, n in jobs]
+                kill_deadline = time.time() + 60
+                while time.time() < kill_deadline:
+                    snap0 = router.replicas[0].snapshot()
+                    if snap0.queue_depth + snap0.active_slots > 0:
+                        break
+                    if all(fr.finished for fr in frs):
+                        break
+                    time.sleep(0.001)
+                router.kill_replica(0)
+                finished = [fr.wait(timeout=600) for fr in frs]
+                wall_fl = time.time() - t0
+                # the health verdict is scored on the collector cadence: give
+                # it a bounded window to observe the quarantine before the
+                # report is captured (deterministic, not sleep-and-hope)
+                h_deadline = time.time() + 30
+                while time.time() < h_deadline:
+                    h = router.fleet_report().get("health") or {}
+                    if h.get("replicas", {}).get("0", {}).get(
+                            "state") == "critical":
+                        break
+                    time.sleep(ts_cadence)
+                rep = router.fleet_report()
+                fl_parity = True
+                for i in (0, 1):
+                    prompt, n = jobs[i]
+                    if frs[i].state.value != "done":
+                        continue
+                    ref = np.asarray(generate(model, params,
+                                              jnp.asarray(prompt)[None], n)[0])
+                    fl_parity = fl_parity and bool(
+                        np.array_equal(frs[i].output, ref))
+                lost = [fr.id for fr in frs
+                        if not fr.finished
+                        or (fr.state.value != "done"
+                            and not isinstance(fr.error, DeadlineExceededError))]
+                survivors = [r for r in router.replicas
+                             if r.state.value != "quarantined"]
+                pooled = rep["pooled"]
+                pooled_ttft = pooled["histograms"].get(
+                    "serving_ttft_seconds", {})
+                fl_tokens = pooled["counters"].get("serving_tokens_total", 0)
+                record["fleet_serving"] = {
+                    "replicas": fl_n,
+                    "slots_per_replica": fl_slots,
+                    "solo_slots": n_slots,
+                    "requests": len(jobs),
+                    "done": sum(fr.state.value == "done" for fr in frs),
+                    "all_terminal": all(finished),
+                    "no_request_lost": not lost,
+                    "killed_replica_quarantined":
+                        router.replicas[0].state.value == "quarantined",
+                    "capacity_after_kill": rep["capacity"],
+                    "reroutes": rep["reroutes_total"],
+                    "shed": rep["shed_total"],
+                    "route_fallbacks": rep["route_fallbacks_total"],
+                    "affinity_hit_rate": rep["affinity"]["hit_rate"],
+                    "tokens_per_sec": round(fl_tokens / max(wall_fl, 1e-9), 2),
+                    "tokens_per_sec_solo": m_on["tokens_per_sec"],
+                    "ttft_p50_ms": round(
+                        pooled_ttft.get("p50_s", 0.0) * 1e3, 3),
+                    "ttft_p99_ms": round(
+                        pooled_ttft.get("p99_s", 0.0) * 1e3, 3),
+                    "ttft_p50_ms_solo": round(m_on["ttft_p50_s"] * 1e3, 3),
+                    "wall_s": round(wall_fl, 3),
+                    "parity_vs_solo_generate": fl_parity,
+                    "recompiles_after_warmup_survivors": sum(
+                        sum(r.engine.recompiles.values()) for r in survivors),
+                    "replica_states": {k: v["state"]
+                                       for k, v in rep["replicas"].items()},
+                    # the health monitor's verdicts at probe end: the killed
+                    # replica must have gone critical, survivors healthy
+                    "health": rep.get("health"),
+                    "ts_series": len(fl_col.store.names()),
+                    "ts_ticks": fl_col.ticks,
+                }
+                # rolling publish through the surviving replicas: the
+                # quarantined kill-probe victim is skipped, everyone still
+                # accepting takes the new version with zero recompiles
+                pub_out = router.publish(new_params, timeout=120.0)
+                rep2 = router.fleet_report()
+                record["fleet_serving"]["publish"] = {
+                    "ok": pub_out["ok"],
+                    "outcomes": pub_out["replicas"],
+                    "weight_versions": {
+                        k: v["weight_version"]
+                        for k, v in rep2["replicas"].items()},
+                    "recompiles_after_publish_survivors": sum(
+                        sum(r.engine.recompiles.values()) for r in survivors),
+                }
+            finally:
+                if fl_col is not None:
+                    fl_col.stop()
+                router.close()
+            fl = record["fleet_serving"]
+            log(f"fleet serving: {fl['replicas']}x{fl['slots_per_replica']} "
+                f"slots, done {fl['done']}/{fl['requests']} through a "
+                f"mid-run replica kill (reroutes={fl['reroutes']}, "
+                f"lost={not fl['no_request_lost']}), affinity "
+                f"hit_rate={fl['affinity_hit_rate']}, parity={fl_parity}")
+
+        if "fleet_autoscale" in skip_sections:
+            log("fleet_autoscale: skipped via CHAINERMN_TPU_SERVE_SKIP_SECTIONS")
+        else:
+            # ---- fleet autoscale: diurnal arrivals (ISSUE 16) ------------- #
+            # A compressed diurnal cycle: sinusoidal arrival rate over one
+            # window (trough -> peak -> trough) against a fleet that starts
+            # at min_replicas with the closed-loop controller LIVE. Replica
+            # count must track load — scale up under the peak, retire back
+            # to the floor in the trough — with zero requests lost.
+            import math
+
+            from chainermn_tpu.fleet import AutoscalePolicy, FleetController
+
+            as_window = float(e("CHAINERMN_TPU_SERVE_AS_WINDOW", "6.0"))
+            # arrival rates are expressed as MULTIPLES of one replica's
+            # measured service rate, so the peak is a genuine overload on
+            # any machine (a fixed req/s would be a no-op on a fast box)
+            as_base_x = float(e("CHAINERMN_TPU_SERVE_AS_BASE_X", "0.3"))
+            as_peak_x = float(e("CHAINERMN_TPU_SERVE_AS_PEAK_X", "3.0"))
+            as_cap = int(e("CHAINERMN_TPU_SERVE_AS_MAX_REQUESTS", "400"))
+            as_min = int(e("CHAINERMN_TPU_SERVE_AS_MIN", "1"))
+            as_max = int(e("CHAINERMN_TPU_SERVE_AS_MAX", "3"))
+            as_prefill, as_new = 16, 12
+
+            def as_engine():
+                # deliberately small: ONE slot per replica, so the diurnal
+                # peak genuinely exceeds a single replica's service rate
+                return ServingEngine(model, params, n_slots=1,
+                                     prefill_len=as_prefill,
+                                     cache_len=as_prefill + as_new + 4)
+
+            router2 = FleetRouter([as_engine() for _ in range(as_min)])
+            ctrl = as_col = None
+            try:
+                assert router2.wait_ready(600), "autoscale warmup timed out"
+                rng2 = np.random.RandomState(7)
+                # calibrate: sequential service time of this request shape on
+                # the floor fleet — the sinusoid's amplitude is set off it
+                t_cal = time.time()
+                for _ in range(3):
+                    p2 = rng2.randint(1, vocab, size=8).astype(np.int32)
+                    router2.submit(p2, as_new).wait(timeout=600)
+                svc_s = max((time.time() - t_cal) / 3.0, 1e-3)
+                as_base = as_base_x / svc_s
+                as_peak = as_peak_x / svc_s
+                as_col = fleet_health(router2, cadence_s=ts_cadence,
+                                      stall_timeout_s=60.0)
+                as_col.start()
+                ctrl = FleetController(
+                    router2, as_col, engine_factory=as_engine,
+                    autoscale=AutoscalePolicy(
+                        min_replicas=as_min, max_replicas=as_max,
+                        queue_high=1.0, idle_low=0.25, up_after_s=0.2,
+                        down_after_s=0.8, cooldown_s=0.3),
+                    cadence_s=0.05, sensor_kw=dict(stall_timeout_s=60.0))
+                ctrl.start()
+                t0 = time.time()
+                as_frs, caps = [], []
+                while ((el := time.time() - t0) < as_window
+                       and len(as_frs) < as_cap):
+                    rate = as_base + (as_peak - as_base) * 0.5 * (
+                        1.0 - math.cos(2.0 * math.pi * el / as_window))
+                    # ~50ms arrival chunks: sleep() granularity stays sane
+                    # even when the calibrated peak is hundreds of req/s
+                    burst = max(1, int(rate * 0.05))
+                    for _ in range(burst):
+                        p2 = rng2.randint(
+                            1, vocab, size=rng2.randint(4, 9)).astype(np.int32)
+                        as_frs.append(router2.submit(p2, as_new))
+                    caps.append(router2.capacity)
+                    time.sleep(burst / max(rate, 0.5))
+                as_done = [fr.wait(timeout=600) for fr in as_frs]
+                # the trough: give the controller a bounded window to see
+                # sustained idleness and retire back down to the floor
+                down_deadline = time.time() + 60
+                while (time.time() < down_deadline
+                       and router2.capacity > as_min):
+                    time.sleep(0.05)
+                caps.append(router2.capacity)
+                wall_as = round(time.time() - t0, 3)
+                crep = ctrl.report()
+                as_lost = [fr.id for fr in as_frs
+                           if not fr.finished or fr.state.value != "done"]
+                record["fleet_autoscale"] = {
+                    "window_s": as_window,
+                    "service_s_calibrated": round(svc_s, 4),
+                    "arrival_base_hz": round(as_base, 2),
+                    "arrival_peak_hz": round(as_peak, 2),
+                    "requests": len(as_frs),
+                    "done": sum(fr.state.value == "done" for fr in as_frs),
+                    "all_terminal": all(as_done),
+                    "no_request_lost": not as_lost,
+                    "min_replicas": as_min,
+                    "max_replicas": as_max,
+                    "peak_capacity": max(caps),
+                    "final_capacity": router2.capacity,
+                    "scale_ups": crep["autoscale"]["scale_ups"],
+                    "scale_downs": crep["autoscale"]["scale_downs"],
+                    "replica_count_tracks_load": bool(
+                        max(caps) > as_min and router2.capacity == as_min),
+                    "recompiles_after_warmup": sum(
+                        sum(r.engine.recompiles.values())
+                        for r in router2.replicas if r.accepting),
+                    "decisions": crep["decisions"],
+                    "wall_s": wall_as,
+                }
+            finally:
+                if ctrl is not None:
+                    ctrl.stop()
+                if as_col is not None:
+                    as_col.stop()
+                router2.close()
+            fa = record["fleet_autoscale"]
+            log(f"fleet autoscale: {fa['requests']} diurnal arrivals over "
+                f"{fa['window_s']}s, capacity {fa['min_replicas']}->"
+                f"{fa['peak_capacity']}->{fa['final_capacity']} "
+                f"(ups={fa['scale_ups']}, downs={fa['scale_downs']}), "
+                f"lost={not fa['no_request_lost']}")
 
         # ---- cost accounting: tenant ledger ON vs OFF, warm engine ---- #
         # ISSUE 17 acceptance: the per-request resource ledger must (a)
@@ -1830,6 +1860,154 @@ def serving_main() -> None:
             f"{dg['wall_s_symmetric']}s), migrations="
             f"{dg['migrations']}, parity={dg['token_parity_vs_symmetric']}"
             f", lost={not dg['no_request_lost']}")
+
+        # ---- fleet-wide KV reuse: cross-replica prefix sharing -------- #
+        # 3 paged replicas, every request carrying one shared system
+        # prompt, and a zero-tolerance imbalance policy so the holder's
+        # own load pushes traffic to its peers — the affinity-miss-heavy
+        # arrival sharing exists for. ON: the holder exports the prefix
+        # blocks ONCE through the fused gather, the host payload LRU
+        # serves every later adopter, and peers prefill only their ragged
+        # tails. OFF: every miss re-prefills the whole prompt. Same
+        # tokens either way; the record carries TTFT p50 both ways plus
+        # the fleet prefill tokens/FLOPs the shares avoided.
+        from chainermn_tpu.fleet.routing import RoutingPolicy
+        from chainermn_tpu.monitor._state import get_event_log
+
+        ps_n = int(e("CHAINERMN_TPU_SERVE_PS_REQUESTS", "9"))
+        ps_rng = np.random.RandomState(22)
+        ps_shared = ps_rng.randint(1, vocab, prefill_len - 4) \
+            .astype(np.int32)
+        ps_jobs = [np.concatenate([ps_shared,
+                                   ps_rng.randint(1, vocab, 1 + (i % 4))
+                                   .astype(np.int32)])
+                   for i in range(ps_n)]
+        ps_params = int(sum(x.size
+                            for x in jax.tree_util.tree_leaves(params)))
+
+        # small blocks so the shared prefix spans MANY trie blocks: the
+        # share trigger needs the fleet trie to know >=
+        # prefix_share_min_blocks of it, and the fused transfer gets a
+        # real multi-block payload. Overridable so CI can pick a bigger
+        # block (fewer warmup-bucketed migration programs to compile).
+        ps_block = int(e("CHAINERMN_TPU_SERVE_PS_BLOCK", "4"))
+
+        def ps_engine():
+            return ServingEngine(
+                model, params, n_slots=2,
+                prefill_buckets=(4, prefill_len), prefill_batch=1,
+                paged=True, kv_block_size=ps_block,
+                kv_blocks=6 * (-(-(prefill_len + max_new) // ps_block)),
+                cache_len=prefill_len + max_new)
+
+        def ps_fleet(share):
+            return FleetRouter(
+                [ps_engine() for _ in range(3)],
+                policy=RoutingPolicy(max_imbalance=0.0),
+                share_prefixes=share, prefix_share_min_blocks=2)
+
+        def ps_run(router):
+            assert router.wait_ready(600)
+            evs0 = get_event_log().tail(1)
+            seq0 = evs0[-1]["i"] if evs0 else -1
+            t_submit, t_first, frs = {}, {}, []
+            for i, p in enumerate(ps_jobs):
+                def cb(tok, _i=i):
+                    t_first.setdefault(_i, time.perf_counter())
+                t_submit[i] = time.perf_counter()
+                frs.append(router.submit(
+                    p, max_new, rng=jax.random.PRNGKey(400 + i),
+                    stream_cb=cb))
+                if i == 0:
+                    # the holder serves the system prompt once
+                    # BEFORE the burst: sharing targets the steady
+                    # state where the prefix is already resident
+                    # somewhere, so the burst's misses find a
+                    # populated trie to adopt from
+                    assert frs[0].wait(300)
+            done = all(fr.wait(300) for fr in frs)
+            ttfts = [t_first[i] - t_submit[i] for i in range(ps_n)]
+            cached = sum(ev.get("cached", 0)
+                         for ev in get_event_log().tail()
+                         if ev["i"] > seq0
+                         and ev["kind"] == "slot_admit")
+            rep = router.fleet_report()["kv_reuse"]
+            for r in router.replicas:
+                assert r.engine.recompiles == {}, "recompiled!"
+            return ([list(fr.tokens) for fr in frs], done,
+                    float(np.percentile(np.asarray(ttfts), 50)),
+                    int(cached), rep)
+
+        ps_router = ps_fleet(False)
+        try:
+            ps_toks_off, ps_done_off, ps_p50_off, ps_cached_off, _ = \
+                ps_run(ps_router)
+        finally:
+            ps_router.close()
+        ps_router = ps_fleet(True)
+        try:
+            ps_toks_on, ps_done_on, ps_p50_on, ps_cached_on, ps_rep = \
+                ps_run(ps_router)
+            ps_saved = max(0, ps_cached_on - ps_cached_off)
+
+            # rebalance probe, riding the already-warm ON fleet: a
+            # throttled stream keeps one request mid-decode while the
+            # router drains it to a peer through the fused path — the
+            # stream finishes token-exactly on its new home, nothing
+            # lost. (ps_rep was snapshotted above, so the probe's own
+            # counters don't leak into the share numbers.)
+            rb_prompt = ps_jobs[0]
+            rb_ref = ps_router.generate(rb_prompt, max_new,
+                                        rng=jax.random.PRNGKey(500),
+                                        timeout=300)
+            rb_ref_tail = [int(t) for t in rb_ref[len(rb_prompt):]]
+            rb_fr = ps_router.submit(
+                rb_prompt, max_new, rng=jax.random.PRNGKey(500),
+                stream_cb=lambda tok: time.sleep(0.01))
+            while not (rb_fr.tokens or rb_fr.finished):
+                time.sleep(0.002)
+            rb_src = rb_fr.replica_id
+            rb_dest_pick = (rb_src + 1) % len(ps_router.replicas)
+            rb_ticket = ps_router.rebalance_decode(rb_src, rb_dest_pick)
+            rb_moved = (bool(rb_ticket.wait(30))
+                        if rb_ticket is not None else False)
+            rb_done = rb_fr.wait(300)
+            rb_parity = [int(t) for t in rb_fr.tokens] == rb_ref_tail
+            rb_dest = rb_fr.replica_id
+        finally:
+            ps_router.close()
+
+        record["fleet_prefix_share"] = {
+            "replicas": 3,
+            "requests": ps_n,
+            "shared_prefix_tokens": int(len(ps_shared)),
+            "ttft_p50_ms_on": round(ps_p50_on * 1e3, 3),
+            "ttft_p50_ms_off": round(ps_p50_off * 1e3, 3),
+            "ttft_p50_speedup": round(ps_p50_off / max(ps_p50_on, 1e-9),
+                                      2),
+            "shares": int(ps_rep["shares"]),
+            "payload_cache": ps_rep["payload_cache"],
+            "prefill_tokens_saved": int(ps_saved),
+            "prefill_flops_saved": float(2 * ps_params * ps_saved),
+            "token_parity_on_vs_off": ps_toks_on == ps_toks_off,
+            "no_request_lost": bool(ps_done_on and ps_done_off),
+            "recompiles_after_warmup": 0,
+            "rebalance_probe": {
+                "moved": bool(rb_moved),
+                "src_replica": rb_src,
+                "dest_replica": rb_dest,
+                "token_parity": bool(rb_parity),
+                "no_request_lost": bool(rb_done),
+            },
+        }
+        psr = record["fleet_prefix_share"]
+        log(f"fleet prefix share: {ps_n} reqs x3 replicas ttft_p50 "
+            f"{psr['ttft_p50_ms_on']}ms (on) vs "
+            f"{psr['ttft_p50_ms_off']}ms (off), shares={psr['shares']}, "
+            f"tokens_saved={psr['prefill_tokens_saved']}, "
+            f"parity={psr['token_parity_on_vs_off']}; rebalance "
+            f"moved={psr['rebalance_probe']['moved']} "
+            f"parity={psr['rebalance_probe']['token_parity']}")
 
         from chainermn_tpu.monitor import snapshot as monitor_snapshot
 

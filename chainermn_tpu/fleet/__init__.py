@@ -63,6 +63,7 @@ from chainermn_tpu.fleet.routing import (
     RouteDecision,
     RoutingPolicy,
 )
+from chainermn_tpu.fleet.share import SharePayloadCache
 
 __all__ = [
     "AutoscalePolicy",
@@ -80,5 +81,6 @@ __all__ = [
     "RetryBudget",
     "RouteDecision",
     "RoutingPolicy",
+    "SharePayloadCache",
     "TenantBreaker",
 ]

@@ -108,9 +108,19 @@ class CanaryPolicy:
 @dataclass
 class RebalancePolicy:
     """Admission weight applied to DEGRADED (level 1) replicas — shed
-    before the supervisor would ever consider quarantine."""
+    before the supervisor would ever consider quarantine — plus the
+    mid-stream decode-migration actuator (ISSUE 20): weight shedding
+    only steers NEW traffic, so a replica already full of long decodes
+    stays hot for minutes; ``migrate_decode`` moves one live decode
+    slot per tick off the most-pressured replica (degraded outranks
+    loaded; normalized load gap at least ``migrate_load_gap``) onto the
+    least-loaded peer, token-exactly, through
+    ``FleetRouter.rebalance_decode``."""
 
     degraded_weight: float = 0.25
+    migrate_decode: bool = False
+    migrate_load_gap: float = 1.0
+    migrate_cooldown_s: float = 2.0
 
 
 class _Canary:
@@ -245,6 +255,7 @@ class FleetController:
         self._pressure_since: Optional[float] = None
         self._idle_since: Optional[float] = None
         self._last_scale: Optional[float] = None
+        self._last_decode_rebalance: Optional[float] = None
         self._target: Optional[int] = None
         self._fleet_version = 0
         self._params_current = None      # last PROMOTED params (sync src)
@@ -722,7 +733,12 @@ class FleetController:
 
     def _rebalance_tick(self, s: dict, summary: dict) -> None:
         p = self.rebalance
-        if p is None or self.health is None:
+        if p is None:
+            return
+        if self.health is None:
+            # weight shedding keys on the health verdict; the decode-
+            # migration branch below is load-based and works without one
+            self._migrate_tick(summary)
             return
         for r in self.router.replicas:
             if not r.accepting:
@@ -744,6 +760,47 @@ class FleetController:
             summary["actions"].append(action)
             self._events.emit("controller_rebalance", replica=rid,
                               weight=want, level=level, **tenant_kw)
+        self._migrate_tick(summary)
+
+    def _migrate_tick(self, summary: dict) -> None:
+        """Decode-migration branch of the rebalance policy: weight
+        shedding only steers NEW traffic, so this moves one LIVE decode
+        slot per tick (cooldown-bounded) off the most-pressured replica
+        — degraded verdict first, then normalized load — onto the
+        least-loaded healthy peer. Fire-and-forget: the source's drive
+        thread picks the cheapest victim and the router places it; every
+        failure leaves the victim decoding where it is."""
+        p = self.rebalance
+        if p is None or not p.migrate_decode:
+            return
+        now = summary["now"]
+        if (self._last_decode_rebalance is not None
+                and now - self._last_decode_rebalance
+                < p.migrate_cooldown_s):
+            return
+        snaps = [r.snapshot() for r in self.router.replicas if r.accepting]
+        for s in snaps:
+            s.health = self._level(s.replica_id)
+        busy = [s for s in snaps if s.active_slots > 0]
+        if len(snaps) < 2 or not busy:
+            return
+        src = max(busy, key=lambda s: (s.health, s.load, s.replica_id))
+        peers = [s for s in snaps
+                 if s.replica_id != src.replica_id and s.health == 0]
+        if not peers:
+            return
+        dest = min(peers, key=lambda s: (s.load, s.replica_id))
+        if src.health == 0 and src.load - dest.load < p.migrate_load_gap:
+            return            # a healthy source must be LOPSIDED to move
+        ticket = self.router.rebalance_decode(src.replica_id,
+                                              dest.replica_id)
+        if ticket is None:
+            return
+        self._last_decode_rebalance = now
+        summary["actions"].append(
+            {"action": "rebalance_decode", "t": now,
+             "src": src.replica_id, "dest": dest.replica_id,
+             "level": src.health})
 
     # ------------------------------------------------------------------ #
     # observability                                                       #

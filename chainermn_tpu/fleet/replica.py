@@ -227,6 +227,52 @@ class EngineReplica:
         self._work.set()
         return out
 
+    def request_prefix_export(self, tokens, *, min_blocks: int = 1):
+        """Ask this replica's drive thread to export its cached KV for
+        ``tokens``'s prefix (thread-safe); returns the scheduler's
+        :class:`~chainermn_tpu.serving.scheduler.KvReuseTicket` — the
+        caller bounds its own wait. Raises when not accepting (a dying
+        holder has nothing shareable)."""
+        if not self.accepting:
+            raise RuntimeError(
+                # graftlint: unguarded-ok — diagnostic read only
+                f"replica {self.replica_id} is {self._state.value}, "
+                "not accepting export work")
+        ticket = self.scheduler.request_prefix_export(
+            tokens, min_blocks=min_blocks)
+        self._work.set()
+        return ticket
+
+    def enqueue_prefix_import(self, payload: dict, on_done=None):
+        """Hand a shared-prefix KV payload to this replica's drive
+        thread for adoption into its block pool + trie (thread-safe;
+        returns the scheduler's ticket — wait on it for a deterministic
+        adopt-before-admit, or ignore it for fire-and-forget; any
+        failure decays to a plain prefill). Raises when not accepting."""
+        if not self.accepting:
+            raise RuntimeError(
+                # graftlint: unguarded-ok — diagnostic read only
+                f"replica {self.replica_id} is {self._state.value}, "
+                "not accepting import work")
+        ticket = self.scheduler.enqueue_prefix_import(payload,
+                                                      on_done=on_done)
+        self._work.set()
+        return ticket
+
+    def request_rebalance(self, place_cb):
+        """Ask this replica's drive thread to hand its cheapest live
+        decode slot to ``place_cb`` (thread-safe); returns the ticket.
+        Raises when not accepting — a quarantining replica's work moves
+        through the supervisor drain instead."""
+        if not self.accepting:
+            raise RuntimeError(
+                # graftlint: unguarded-ok — diagnostic read only
+                f"replica {self.replica_id} is {self._state.value}, "
+                "not accepting rebalance work")
+        ticket = self.scheduler.request_rebalance(place_cb)
+        self._work.set()
+        return ticket
+
     def snapshot(self) -> ReplicaSnapshot:
         """Routing-time occupancy (host counters only — the policy's
         input)."""

@@ -96,6 +96,7 @@ from chainermn_tpu.fleet.routing import (
     RouteDecision,
     RoutingPolicy,
 )
+from chainermn_tpu.fleet.share import SharePayloadCache
 from chainermn_tpu.monitor._state import get_event_log, get_registry
 from chainermn_tpu.monitor.costs import merge_cost_payloads
 from chainermn_tpu.monitor.registry import merge_rank_payloads
@@ -249,6 +250,23 @@ class FleetRouter:
     chunk_tokens_per_step : int, optional
         Forwarded to every replica's scheduler: long prompts prefill in
         bounded chunks interleaved with decode.
+    share_prefixes : bool
+        Cross-replica prefix sharing: when the affinity trie knows a
+        holder but the policy routes elsewhere (holder overloaded/
+        degraded), export the holder's cached prefix KV and import it
+        into the chosen replica instead of re-prefilling it there.
+        Auto-disabled unless every engine supports block migration
+        (paged, single-device) and affinity is on.
+    prefix_share_min_blocks : int
+        Smallest resident prefix worth shipping (below it the import
+        round-trip costs more than the prefill it saves — PERF.md
+        derives the crossover).
+    share_timeout_s : float
+        Bound on the holder-export wait; a slow holder just means the
+        destination prefills.
+    share_cache_entries : int
+        Host-side payload LRU size: a hot prefix is exported once and
+        imported everywhere.
     """
 
     def __init__(self, engines: Sequence, *, eos_id: Optional[int] = None,
@@ -266,7 +284,11 @@ class FleetRouter:
                  fair=None, tenant_weights=None, brownout=None,
                  prefill_replicas: Optional[int] = None,
                  decode_replicas: Optional[int] = None,
-                 chunk_tokens_per_step: Optional[int] = None) -> None:
+                 chunk_tokens_per_step: Optional[int] = None,
+                 share_prefixes: bool = False,
+                 prefix_share_min_blocks: int = 2,
+                 share_timeout_s: float = 5.0,
+                 share_cache_entries: int = 8) -> None:
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if max_queue is not None and max_queue < 1:
@@ -288,6 +310,15 @@ class FleetRouter:
             self._prefill_tier = frozenset(range(p))
         prefix_on = all(getattr(e, "prefix_enabled", False) for e in engines)
         self.affinity = bool(affinity) and prefix_on
+        # cross-replica prefix sharing (ISSUE 20) needs the affinity trie
+        # to find holders AND every engine able to export/import block
+        # rows (paged, single-device). Anything less degrades to plain
+        # affinity routing — never an error (the TP-fleet stance).
+        self.share_prefixes = (bool(share_prefixes) and self.affinity
+                               and all(getattr(e, "migration_supported",
+                                               False) for e in engines))
+        self.prefix_share_min_blocks = max(1, int(prefix_share_min_blocks))
+        self.share_timeout_s = float(share_timeout_s)
         if affinity_block_size is None:
             affinity_block_size = (engines[0].prefix_cache.block_size
                                    if prefix_on else 16)
@@ -315,6 +346,12 @@ class FleetRouter:
         self._c_aff_miss = reg.counter("fleet_affinity_misses_total", labels)
         self._c_fallbacks = reg.counter("fleet_route_fallbacks_total",
                                         labels)
+        self._c_shares = reg.counter("kv_shares_total", labels)
+        self._c_rebalances = reg.counter("kv_rebalances_total", labels)
+        # one export serves every later importer of the same prefix
+        self._share_cache = (SharePayloadCache(share_cache_entries,
+                                               labels=labels)
+                             if self.share_prefixes else None)
         self.max_reroutes = (int(max_reroutes) if max_reroutes is not None
                              else len(engines))
         # replicas added later (spawn_replica) are built with the same
@@ -530,6 +567,26 @@ class FleetRouter:
                               priority=priority)
             t0 = time.perf_counter()
             decision = self._route_locked(fr.prompt, snaps)
+            share = (self._plan_share_locked(fr, decision)
+                     if self.share_prefixes else None)
+            if share is None:
+                self._bind_locked(fr, decision, t0)
+                self._requests[fid] = fr
+                self._c_requests.inc()
+                return fr
+        # cross-replica share handshake OUTSIDE the router lock: both
+        # halves are bounded waits on other replicas' drive threads
+        # (export on the holder, adoption on the destination — never
+        # under the router lock), and the destination serves pending
+        # imports at step() start BEFORE fresh admissions, so by the
+        # time the bind below enqueues the request its prompt's shared
+        # blocks are already trie-resident there. Every failure or
+        # timeout decays to a plain prefill on the destination — the
+        # request lands either way.
+        self._execute_share(fr, share)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("fleet router is closed")
             self._bind_locked(fr, decision, t0)
             self._requests[fid] = fr
             self._c_requests.inc()
@@ -713,13 +770,169 @@ class FleetRouter:
                     dest.submit_migrated(req, payload)
                 except Exception:  # noqa: BLE001 — next candidate
                     continue
+                src_rid = fr.replica_id
                 fr.replica_id = dest.replica_id
+                if self.affinity:
+                    # the blocks MOVED: the importer now holds the
+                    # prompt's KV, the exporter released it — keeping the
+                    # exporter's stamps routes affinity traffic at KV
+                    # that no longer exists (the disagg staleness bug)
+                    self._trie.note(fr.prompt, dest.replica_id)
+                    if src_rid is not None:
+                        self._trie.forget(fr.prompt, src_rid)
                 self._events.emit("fleet_route", req=fr.id,
                                   replica=dest.replica_id,
                                   affinity=False, reason="kv_migrate",
                                   rerouted=False)
                 return True
             return False
+
+    # ------------------------------------------------------------------ #
+    # fleet-wide KV reuse (cross-replica prefix sharing + rebalancing)    #
+    # ------------------------------------------------------------------ #
+
+    def _plan_share_locked(self, fr: FleetRequest,
+                           decision: RouteDecision) -> Optional[dict]:
+        """Decide whether the routed request should import a shared
+        prefix (router-locked, host-only). The trigger is exactly the
+        affinity-policy's rejection: the trie knows a holder, but the
+        policy sent the request elsewhere (holder overloaded, degraded,
+        or out of blocks) — the miss that cross-replica sharing turns
+        back into a hit."""
+        if self._closed or decision.affinity_hit:
+            return None            # routed TO the holder: nothing to move
+        holder, blocks = self._trie.lookup(fr.prompt)
+        if (holder is None or holder == decision.replica_id
+                or blocks < self.prefix_share_min_blocks):
+            return None
+        if not self.replicas[holder].accepting:
+            # a dying holder can't export — but a cached payload from an
+            # earlier export still can serve (checked in _execute_share)
+            holder = None
+        return {"holder": holder, "blocks": blocks,
+                "dest": decision.replica_id}
+
+    def _execute_share(self, fr: FleetRequest, plan: dict) -> bool:
+        """Run one share handshake (NO router lock held): payload-cache
+        hit, else a bounded-wait export on the holder's drive thread;
+        then a fire-and-forget import enqueue on the destination. The
+        ``fleet.share`` cut-point covers the whole handshake — chaos (or
+        any real failure) decays to the destination prefilling the
+        prefix itself."""
+        from chainermn_tpu.resilience.cutpoints import FLEET_SHARE
+
+        dest_rid = plan["dest"]
+        try:
+            _inject(FLEET_SHARE, req=fr.id, holder=plan["holder"],
+                    dest=dest_rid)
+        except Exception as e:  # noqa: BLE001 — chaos: re-prefill
+            self._events.emit("fleet_route_fallback",
+                              error=type(e).__name__, replica=dest_rid)
+            return False
+        entry = self._share_cache.match(fr.prompt)
+        if entry is None:
+            holder = plan["holder"]
+            if holder is None:
+                return False
+            try:
+                ticket = self.replicas[holder].request_prefix_export(
+                    fr.prompt, min_blocks=self.prefix_share_min_blocks)
+            except Exception:  # noqa: BLE001 — holder dying: re-prefill
+                return False
+            payload = ticket.wait(self.share_timeout_s)
+            if payload is None:
+                return False
+            entry = self._share_cache.put(payload)
+
+        def _adopted(n: int, entry=entry) -> None:
+            # destination drive thread: adoption outcome (0 = the blocks
+            # were already cached there, or the import failed — either
+            # way the request just prefills what's missing)
+            self._share_cache.release(entry, imported=bool(n))
+            if n:
+                self._c_shares.inc()
+
+        try:
+            ticket = self.replicas[dest_rid].enqueue_prefix_import(
+                entry.payload, on_done=_adopted)
+        except Exception:  # noqa: BLE001 — dest dying: re-route handles it
+            self._share_cache.release(entry)
+            return False
+        # bounded wait for the adoption so the bind that follows admits
+        # against the populated trie; a timeout (wedged destination)
+        # just means this request prefills — the import still lands for
+        # the next one
+        ticket.wait(self.share_timeout_s)
+        return True
+
+    def rebalance_decode(self, src_rid: int,
+                         dest_rid: Optional[int] = None):
+        """Ask replica ``src_rid`` to hand its cheapest live decode slot
+        to a peer mid-stream (thread-safe, fire-and-forget; the control
+        plane's pre-quarantine actuator — see
+        :meth:`FleetController._rebalance_tick`). Returns the
+        scheduler's ticket, or None when the source can't participate.
+        The source picks the victim (batch class first, fewest live
+        blocks — least payload to move); this router callback places it
+        on the least-loaded peer that can import, ``dest_rid`` pinning
+        the destination when given. Chaos at ``fleet.rebalance`` — or
+        any placement failure — leaves the victim decoding in place."""
+
+        def place(req, payload, src_rid=int(src_rid)) -> bool:
+            # source drive thread (outside its scheduler lock): the same
+            # lock pattern as _migrate — router-locked candidate walk,
+            # host-only capacity checks
+            from chainermn_tpu.resilience.cutpoints import FLEET_REBALANCE
+
+            with self._lock:
+                if self._closed:
+                    return False
+                fr = next((f for f in self._requests.values()
+                           if f._inner is req), None)
+                if fr is None or fr.finished:
+                    return False
+                try:
+                    _inject(FLEET_REBALANCE, req=fr.id, replica=src_rid)
+                except Exception as e:  # noqa: BLE001 — decode in place
+                    self._events.emit("fleet_route_fallback",
+                                      error=type(e).__name__,
+                                      replica=src_rid)
+                    return False
+                snaps = self._snapshots_locked()
+                cands = [s for s in snaps
+                         if s.replica_id != src_rid
+                         and s.replica_id not in self._publishing
+                         and (dest_rid is None
+                              or s.replica_id == int(dest_rid))
+                         and (self._prefill_tier is None
+                              or s.replica_id not in self._prefill_tier)]
+                remaining = max(1, fr.max_new_tokens - len(fr.tokens))
+                for snap in self._policy.migration_targets(cands):
+                    dest = self.replicas[snap.replica_id]
+                    try:
+                        if not dest.engine.can_import(payload,
+                                                      max_new=remaining):
+                            continue
+                        dest.submit_migrated(req, payload)
+                    except Exception:  # noqa: BLE001 — next candidate
+                        continue
+                    fr.replica_id = dest.replica_id
+                    if self.affinity:
+                        self._trie.note(fr.prompt, dest.replica_id)
+                        self._trie.forget(fr.prompt, src_rid)
+                    self._c_rebalances.inc()
+                    self._events.emit(
+                        "rebalance", req=fr.id, src=src_rid,
+                        dest=dest.replica_id,
+                        blocks=int(payload["n_blocks"]),
+                        tokens=len(fr.tokens))
+                    return True
+                return False
+
+        try:
+            return self.replicas[int(src_rid)].request_rebalance(place)
+        except Exception:  # noqa: BLE001 — source dying/not accepting
+            return None
 
     # ------------------------------------------------------------------ #
     # settlement (consumer waits + failover)                              #
@@ -1108,6 +1321,14 @@ class FleetRouter:
                 "misses": misses,
                 "hit_rate": round(hits / max(hits + misses, 1), 4),
                 "trie_nodes": self._trie.n_nodes,
+            },
+            "kv_reuse": {
+                "share_enabled": self.share_prefixes,
+                "shares": int(self._c_shares.value),
+                "rebalances": int(self._c_rebalances.value),
+                "payload_cache": (self._share_cache.to_json()
+                                  if self._share_cache is not None
+                                  else None),
             },
             "tiers": (None if self._prefill_tier is None else {
                 "prefill": sorted(self._prefill_tier),

@@ -273,6 +273,35 @@ class FleetTrie:
                    key=lambda r: (depth_by[r], stamp_by[r], -r))
         return best, depth_by[best]
 
+    def forget(self, tokens, replica_id: int) -> int:
+        """Drop ``replica_id``'s stamps along the full-block path of
+        ``tokens`` — the surgical inverse of :meth:`note`, for when ONE
+        prompt's blocks left a replica (KV migration / decode rebalance
+        handed them to a peer) while the rest of its cache stayed put.
+        Without this the trie keeps routing affinity traffic at the
+        exporter for KV that now lives elsewhere (the disaggregation
+        staleness bug). Prunes holder-less childless tail nodes; returns
+        blocks forgotten."""
+        rid = int(replica_id)
+        tokens = list(tokens)
+        total = len(tokens) // self.block_size
+        path = []
+        node = self._root
+        for i in range(total):
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        forgotten = 0
+        for node in reversed(path):
+            if node.replicas.pop(rid, None) is not None:
+                forgotten += 1
+            if not node.replicas and not node.children:
+                del node.parent.children[node.key]
+                self._n_nodes -= 1
+        return forgotten
+
     def drop_replica(self, replica_id: int) -> int:
         """Forget everything attributed to ``replica_id`` (its engine's
         trie/store was just rebuilt); prunes nodes left holder-less.

@@ -56,6 +56,11 @@ METRIC_NAMES = frozenset({
     "kv_migrated_blocks_total",
     "kv_migrations_total",
     "migration_seconds",
+    # fleet-wide KV reuse (prefix sharing + decode rebalancing)
+    "kv_rebalances_total",
+    "kv_shares_total",
+    "share_payload_cache_evictions_total",
+    "share_payload_cache_hits_total",
     "prefill_chunks_total",
     "prefill_batch_size",
     "prefix_cache_evictions_total",
@@ -188,6 +193,8 @@ EVENT_KINDS = frozenset({
     "fleet_shed",
     "fleet_spawn",
     "fleet_spawn_restore",
+    # fleet-wide KV reuse (mid-stream decode rebalancing)
+    "rebalance",
     # control plane (edge-triggered controller decisions)
     "canary_promote",
     "canary_rollback",

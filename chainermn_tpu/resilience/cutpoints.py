@@ -52,6 +52,8 @@ FLEET_ROUTE = "fleet.route"
 FLEET_REPLICA = "fleet.replica"
 FLEET_BREAKER = "fleet.breaker"
 FLEET_MIGRATE = "fleet.migrate"
+FLEET_SHARE = "fleet.share"
+FLEET_REBALANCE = "fleet.rebalance"
 DEPLOY_PUBLISH = "deploy.publish"
 DEPLOY_RESHARD = "deploy.reshard"
 
@@ -88,6 +90,8 @@ ALL_CUTPOINTS = (
     FLEET_REPLICA,
     FLEET_BREAKER,
     FLEET_MIGRATE,
+    FLEET_SHARE,
+    FLEET_REBALANCE,
     DEPLOY_PUBLISH,
     DEPLOY_RESHARD,
 )
@@ -104,8 +108,10 @@ __all__ = [
     "DYNAMIC_PREFIXES",
     "FLEET_BREAKER",
     "FLEET_MIGRATE",
+    "FLEET_REBALANCE",
     "FLEET_REPLICA",
     "FLEET_ROUTE",
+    "FLEET_SHARE",
     "OBJSTORE_GET",
     "OBJSTORE_PUT",
     "SERVING_ADMIT_FAIR",

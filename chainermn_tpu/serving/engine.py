@@ -543,8 +543,11 @@ class ServingEngine:
             self._guard.watch(f"serving_prefill_{b}", fn)
         self._guard.watch("serving_decode", self._decode_fn)
         if self.migration_supported:
-            self._guard.watch("serving_kv_gather", self._kv_gather_fn)
-            self._guard.watch("serving_kv_scatter", self._kv_scatter_fn)
+            for w in self._mig_buckets:
+                self._guard.watch(f"serving_kv_gather_{w}",
+                                  self._kv_gather_fns[w])
+                self._guard.watch(f"serving_kv_scatter_{w}",
+                                  self._kv_scatter_fns[w])
         if self.prefix_cache is not None and not self.paged:
             self._guard.watch("serving_prefix_insert", self._insert_fn)
         if self.decode_window > 1:
@@ -923,12 +926,16 @@ class ServingEngine:
         return [{"k": z(), "v": z()} for _ in range(self.model.n_layers)]
 
     def _kv_gather_body(self):
-        """Migration read side: pull ``n_max`` block rows (every array in
-        each layer dict — int8 rows AND their scales move as stored, no
-        dequant round-trip) out of the store by id. Junk trailing ids
-        gather scratch content the importer's ``n_used`` mask discards.
-        Compiled WITHOUT donation: export must leave the source store
-        intact so a failed handover can keep decoding in place."""
+        """Migration read side: pull one bucket's worth of block rows
+        (every array in each layer dict — int8 rows AND their scales move
+        as stored, no dequant round-trip) out of the store by id, in ONE
+        dispatch. The block-id operand is data, not a trace constant, so
+        each warmup-bucketed width compiles exactly once and covers every
+        block list of that size — the same scalar-operand trick as the
+        paged decode path. Junk trailing ids gather scratch content the
+        importer's ``n_used`` mask discards. Compiled WITHOUT donation:
+        export must leave the source store intact so a failed handover
+        can keep decoding in place."""
         def body(store, ids):
             with annotate("chainermn.kv_gather"):
                 return [{kk: jnp.take(layer[kk], ids, axis=0)
@@ -936,18 +943,17 @@ class ServingEngine:
 
         return body
 
-    def _kv_scatter_body(self):
+    def _kv_scatter_body(self, width: int):
         """Migration write side: land ``n_used`` gathered block rows into
         freshly allocated ids of THIS store (donated — the store is
-        consumed and returned like every other program). Rows past
-        ``n_used`` carry the scratch id 0 and re-write scratch's current
-        content (identity), so the one compiled program covers every
-        migration size and duplicate padding ids stay deterministic."""
-        n_max = self._n_max
-
+        consumed and returned like every other program), one compiled
+        program per warmup bucket ``width``. Rows past ``n_used`` carry
+        the scratch id 0 and re-write scratch's current content
+        (identity), so each bucket's program covers every migration size
+        it pads to and duplicate padding ids stay deterministic."""
         def body(store, ids, rows, n_used):
             with annotate("chainermn.kv_scatter"):
-                valid = jnp.arange(n_max) < n_used
+                valid = jnp.arange(width) < n_used
                 out = []
                 for layer, lrows in zip(store, rows):
                     buf = dict(layer)
@@ -962,6 +968,29 @@ class ServingEngine:
 
         return body
 
+    def _migration_bucket_widths(self) -> tuple:
+        """Warmup bucket widths for the fused migration transfer: powers
+        of two up to ``n_max`` plus ``n_max`` itself, always including 1
+        (the per-block reference path rides the width-1 program). A
+        transfer pads its block list to the smallest covering bucket —
+        at most 2x the live blocks move, and no block count ever
+        compiles a new program."""
+        widths = {1, self._n_max}
+        w = 2
+        while w < self._n_max:
+            widths.add(w)
+            w *= 2
+        return tuple(sorted(widths))
+
+    def _mig_bucket(self, n: int) -> int:
+        """Smallest warmup bucket covering ``n`` blocks."""
+        for w in self._mig_buckets:
+            if w >= n:
+                return w
+        raise RuntimeError(
+            f"{n} blocks exceed the largest migration bucket "
+            f"{self._mig_buckets[-1]}")
+
     def _build_fns(self):
         if self.paged:
             self._prefill_fns = {
@@ -970,9 +999,15 @@ class ServingEngine:
             }
             self._decode_fn = jax.jit(self._paged_decode_body(),
                                       donate_argnums=(1,))
-            self._kv_gather_fn = jax.jit(self._kv_gather_body())
-            self._kv_scatter_fn = jax.jit(self._kv_scatter_body(),
-                                          donate_argnums=(0,))
+            self._mig_buckets = self._migration_bucket_widths()
+            self._kv_gather_fns = {
+                w: jax.jit(self._kv_gather_body())
+                for w in self._mig_buckets
+            }
+            self._kv_scatter_fns = {
+                w: jax.jit(self._kv_scatter_body(w), donate_argnums=(0,))
+                for w in self._mig_buckets
+            }
             if self._spec is not None:
                 self._spec_fn = jax.jit(self._spec_verify_body(),
                                         donate_argnums=(1,))
@@ -1216,15 +1251,17 @@ class ServingEngine:
                     jnp.asarray(self._token), jnp.asarray(self._pos),
                     jnp.asarray(self._active), self._keys)
             if self.migration_supported:
-                # all-scratch ids + n_used=0: the gather reads scratch,
-                # the scatter re-writes scratch's own content — the one
-                # compile each covers every future migration size
-                mig_ids = jnp.zeros((self._n_max,), jnp.int32)
-                with self._watched("serving warmup kv_gather"):
-                    rows = self._kv_gather_fn(self._store, mig_ids)
-                with self._watched("serving warmup kv_scatter"):
-                    self._store = self._kv_scatter_fn(
-                        self._store, mig_ids, rows, jnp.int32(0))
+                # all-scratch ids + n_used=0 at EVERY bucket width: the
+                # gather reads scratch, the scatter re-writes scratch's
+                # own content — one compile per bucket covers every
+                # future migration size that pads to it
+                for w in self._mig_buckets:
+                    mig_ids = jnp.zeros((w,), jnp.int32)
+                    with self._watched(f"serving warmup kv_gather[{w}]"):
+                        rows = self._kv_gather_fns[w](self._store, mig_ids)
+                    with self._watched(f"serving warmup kv_scatter[{w}]"):
+                        self._store = self._kv_scatter_fns[w](
+                            self._store, mig_ids, rows, jnp.int32(0))
             if self.decode_window > 1:
                 with self._watched("serving warmup decode_window"):
                     self._store, _, _ = self._window_fn(
@@ -1694,19 +1731,89 @@ class ServingEngine:
     # KV block migration (paged, single-device)                           #
     # ------------------------------------------------------------------ #
 
+    def _gather_block_rows(self, ids: list, ctx: Optional[dict],
+                           fused: bool) -> list:
+        """Pull ``ids``' block rows to the host. Fused: pad the block
+        list to the smallest warmup bucket and run ONE gather dispatch.
+        Per-block (the pre-round-20 reference path, kept for the
+        bit-equality pin and the PERF.md phase model): one width-1
+        gather per block — N dispatches + N host bounces. Both return
+        the identical layers structure."""
+        n = len(ids)
+        if fused:
+            w = self._mig_bucket(n)
+            ids_op = np.zeros((w,), np.int32)
+            ids_op[:n] = ids
+            with self._watched(f"serving kv_gather[{w}]", **(ctx or {})), \
+                    annotate("chainermn.kv_gather"):
+                rows = self._kv_gather_fns[w](self._store,
+                                              jnp.asarray(ids_op))
+            self._guard.check()
+            return [{kk: np.asarray(layer[kk])[:n] for kk in layer}
+                    for layer in rows]
+        per_block = []
+        for b in ids:
+            one = np.asarray([b], np.int32)
+            with self._watched("serving kv_gather[1]", **(ctx or {})), \
+                    annotate("chainermn.kv_gather"):
+                rows = self._kv_gather_fns[1](self._store,
+                                              jnp.asarray(one))
+            self._guard.check()
+            per_block.append([{kk: np.asarray(layer[kk])
+                               for kk in layer} for layer in rows])
+        return [{kk: np.concatenate([blk[li][kk] for blk in per_block])
+                 for kk in per_block[0][li]}
+                for li in range(len(per_block[0]))]
+
+    def _scatter_block_rows(self, new: list, layers: list,
+                            ctx: Optional[dict], fused: bool) -> None:
+        """Land host ``layers`` rows into blocks ``new`` of THIS store.
+        Fused: one scatter dispatch at the covering bucket width.
+        Per-block: one width-1 scatter per block (reference path). Any
+        raise leaves rollback to the caller."""
+        n = len(new)
+        if fused:
+            w = self._mig_bucket(n)
+            ids_op = np.zeros((w,), np.int32)
+            ids_op[:n] = new
+            rows = []
+            for layer in layers:
+                full = {}
+                for kk, arr in layer.items():
+                    pad = np.zeros((w,) + tuple(arr.shape[1:]), arr.dtype)
+                    pad[:n] = arr
+                    full[kk] = jnp.asarray(pad)
+                rows.append(full)
+            with self._watched(f"serving kv_scatter[{w}]", **(ctx or {})), \
+                    annotate("chainermn.kv_scatter"):
+                self._store = self._kv_scatter_fns[w](
+                    self._store, jnp.asarray(ids_op), rows, jnp.int32(n))
+            return
+        for j in range(n):
+            one = np.asarray([new[j]], np.int32)
+            rows = [{kk: jnp.asarray(arr[j:j + 1])
+                     for kk, arr in layer.items()} for layer in layers]
+            with self._watched("serving kv_scatter[1]", **(ctx or {})), \
+                    annotate("chainermn.kv_scatter"):
+                self._store = self._kv_scatter_fns[1](
+                    self._store, jnp.asarray(one), rows, jnp.int32(1))
+
     def export_slot_kv(self, slot: int,
-                       ctx: Optional[dict] = None) -> dict:
+                       ctx: Optional[dict] = None, *,
+                       fused: bool = True) -> dict:
         """Read an active slot's entire KV state out to the host: ONE
-        compiled gather dispatch (no donation — the source store is
-        untouched, so a failed handover keeps decoding in place) pulls
-        the slot's block rows, then ``np.asarray`` slices exactly
-        ``n_blocks`` rows per layer array off the device — bytes moved =
-        blocks x block_bytes, int8 rows + scales as stored, no dequant
-        round-trip. The payload plus the slot's host mirrors (position,
-        last token, sampler key) is everything a decode-tier engine needs
-        to continue the request token-exactly via
-        :meth:`import_slot_kv`. Read-only: the slot stays active here;
-        the caller releases it only after the import commits."""
+        compiled gather dispatch at the covering warmup bucket (no
+        donation — the source store is untouched, so a failed handover
+        keeps decoding in place) pulls the slot's block rows, then the
+        host slices exactly ``n_blocks`` rows per layer array — bytes
+        moved = bucket(n) x block_bytes, int8 rows + scales as stored,
+        no dequant round-trip. ``fused=False`` keeps the per-block
+        reference path (one dispatch per block) for parity pins. The
+        payload plus the slot's host mirrors (position, last token,
+        sampler key) is everything a decode-tier engine needs to
+        continue the request token-exactly via :meth:`import_slot_kv`.
+        Read-only: the slot stays active here; the caller releases it
+        only after the import commits."""
         if not self.migration_supported:
             raise RuntimeError(
                 "KV migration needs paged=True on a single-device engine "
@@ -1716,14 +1823,7 @@ class ServingEngine:
         t0 = time.perf_counter()
         ids = list(self._slot_blocks[slot])
         n = len(ids)
-        ids_op = np.zeros((self._n_max,), np.int32)
-        ids_op[:n] = ids
-        with self._watched("serving kv_gather", **(ctx or {})), \
-                annotate("chainermn.kv_gather"):
-            rows = self._kv_gather_fn(self._store, jnp.asarray(ids_op))
-        self._guard.check()
-        layers = [{kk: np.asarray(layer[kk][:n]) for kk in layer}
-                  for layer in rows]
+        layers = self._gather_block_rows(ids, ctx, fused)
         return {
             "n_blocks": n,
             "block_size": self.kv_block_size,
@@ -1772,11 +1872,12 @@ class ServingEngine:
     def import_slot_kv(self, payload: dict, *,
                        prompt: Optional[np.ndarray] = None,
                        max_new: int = 1,
-                       ctx: Optional[dict] = None) -> int:
+                       ctx: Optional[dict] = None,
+                       fused: bool = True) -> int:
         """Land a migrated request into THIS engine: allocate fresh
         blocks, scatter the host rows in with the compiled-once pair's
-        write side (rows padded back to the static ``[n_max]`` operand —
-        the pad tail carries scratch ids and identity content), and
+        write side (one dispatch at the covering warmup bucket — the pad
+        tail carries scratch ids and identity content), and
         commit the slot mirrors (position/token/sampler key) so the next
         decode round continues the request token-exactly. When
         ``prompt`` is given, its full blocks are adopted into this
@@ -1812,22 +1913,8 @@ class ServingEngine:
                 f"(free={self._pool.free_blocks})")
         slot = min(self.free_slots)
         bs = self.kv_block_size
-        ids_op = np.zeros((self._n_max,), np.int32)
-        ids_op[:n] = new
         try:
-            rows = []
-            for layer, st_layer in zip(payload["layers"], self._store):
-                full = {}
-                for kk, arr in layer.items():
-                    pad = np.zeros((self._n_max,) + tuple(arr.shape[1:]),
-                                   arr.dtype)
-                    pad[:n] = arr
-                    full[kk] = jnp.asarray(pad)
-                rows.append(full)
-            with self._watched("serving kv_scatter", **(ctx or {})), \
-                    annotate("chainermn.kv_scatter"):
-                self._store = self._kv_scatter_fn(
-                    self._store, jnp.asarray(ids_op), rows, jnp.int32(n))
+            self._scatter_block_rows(new, payload["layers"], ctx, fused)
         except Exception as e:
             for block in new:
                 self._pool.decref(block)
@@ -1867,6 +1954,120 @@ class ServingEngine:
                 self.prefix_cache.insert_shared(prompt, new)
         self.peak_active = max(self.peak_active, self.active_slots)
         return slot
+
+    # ------------------------------------------------------------------ #
+    # cross-replica prefix sharing (paged, single-device)                 #
+    # ------------------------------------------------------------------ #
+
+    def export_prefix_kv(self, tokens, ctx: Optional[dict] = None, *,
+                         min_blocks: int = 1) -> Optional[dict]:
+        """Read this engine's cached prefix of ``tokens`` out to the
+        host through the fused migration gather — the share payload
+        another replica imports via :meth:`import_prefix_kv` instead of
+        re-prefilling blocks the fleet already paid for. Returns ``None``
+        (never raises on a cold cache) when sharing is unsupported, the
+        trie holds fewer than ``min_blocks`` of the prompt, or the
+        engine is not warm — the caller's fallback is a plain prefill.
+        Read-only on the store; the matched blocks are pinned only for
+        the duration of the gather."""
+        if not (self.migration_supported and self._warm
+                and self.prefix_cache is not None):
+            return None
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        m = self.prefix_cache.match(tokens)
+        if m is None:
+            return None
+        try:
+            n = len(m.block_ids)
+            if n < max(1, int(min_blocks)):
+                return None
+            t0 = time.perf_counter()
+            layers = self._gather_block_rows(list(m.block_ids), ctx, True)
+            return {
+                "n_blocks": n,
+                "block_size": self.kv_block_size,
+                "kv_quant": self.kv_quant,
+                "n_layers": self.model.n_layers,
+                "tokens": tokens[:m.length].copy(),
+                "layers": layers,
+                "t_start": t0,
+            }
+        finally:
+            self.prefix_cache.release(m)
+
+    def can_import_prefix(self, payload: dict, *,
+                          static_only: bool = False) -> bool:
+        """Pre-check that :meth:`import_prefix_kv` would succeed here:
+        layout agreement and (non-static) warm programs plus block
+        budget. Same static/transient split as :meth:`can_import`."""
+        if not (self.migration_supported and self.prefix_cache
+                is not None):
+            return False
+        if (int(payload["block_size"]) != self.kv_block_size
+                or str(payload["kv_quant"]) != self.kv_quant
+                or int(payload["n_layers"]) != self.model.n_layers):
+            return False
+        n = int(payload["n_blocks"])
+        if not 0 < n <= self._n_max:
+            return False
+        for kk, arr in payload["layers"][0].items():
+            if tuple(arr.shape[1:]) != tuple(self._store[0][kk].shape[1:]):
+                return False
+        if static_only:
+            return True
+        return self._warm and n <= self.kv_blocks_admittable()
+
+    def import_prefix_kv(self, payload: dict,
+                         ctx: Optional[dict] = None) -> int:
+        """Adopt a shared prefix payload into THIS engine's trie:
+        allocate blocks all-or-nothing, scatter the rows in through the
+        fused write side, then ``insert_shared`` hands ownership to the
+        trie (each adopted block settles at refcount 1, trie-owned; a
+        block whose trie position was cached concurrently drops straight
+        back to the free list). The next admission matching this prefix
+        prefills ZERO of its shared blocks. Returns blocks adopted (0 =
+        already resident, nothing to do); raises ``RuntimeError`` with
+        the engine intact on layout mismatch or pool exhaustion — the
+        caller's fallback is a plain prefill."""
+        if not self.migration_supported or self.prefix_cache is None:
+            raise RuntimeError(
+                "prefix sharing needs paged=True on a single-device "
+                "engine")
+        if (int(payload["block_size"]) != self.kv_block_size
+                or str(payload["kv_quant"]) != self.kv_quant
+                or int(payload["n_layers"]) != self.model.n_layers):
+            raise RuntimeError(
+                "share layout mismatch: source/dest engines disagree "
+                "on block_size/kv_quant/n_layers")
+        n = int(payload["n_blocks"])
+        if not 0 < n <= self._n_max:
+            raise RuntimeError(
+                f"shared prefix carries {n} blocks; this engine's "
+                f"tables hold at most {self._n_max}")
+        tokens = np.asarray(payload["tokens"], np.int32).reshape(-1)
+        if self.prefix_cache.missing_blocks(tokens) == 0:
+            return 0                       # already ground truth here
+        new = self.prefix_cache.alloc_blocks_atomic(n)
+        if new is None:
+            raise RuntimeError(
+                f"kv block pool exhausted: share import needs {n} "
+                f"blocks (free={self._pool.free_blocks})")
+        try:
+            self._scatter_block_rows(new, payload["layers"], ctx, True)
+        except Exception as e:
+            for block in new:
+                self._pool.decref(block)
+            if not self._state_ok():
+                raise EngineStateError(
+                    f"share import failed mid-device-call "
+                    f"({type(e).__name__}: {e}); donated store buffers "
+                    "are gone — restart required") from e
+            raise
+        self._guard.check()
+        adopted = self.prefix_cache.insert_shared(tokens, new)
+        for block in new:
+            self._pool.decref(block)
+        return adopted
 
     def blocks_needed(self, prompt_len: int, max_new: int,
                       start: int = 0) -> int:
@@ -2403,8 +2604,11 @@ class ServingEngine:
                for b, fn in self._prefill_fns.items()}
         out["decode"] = int(self._decode_fn._cache_size())
         if self.migration_supported:
-            out["kv_gather"] = int(self._kv_gather_fn._cache_size())
-            out["kv_scatter"] = int(self._kv_scatter_fn._cache_size())
+            for w in self._mig_buckets:
+                out[f"kv_gather_{w}"] = int(
+                    self._kv_gather_fns[w]._cache_size())
+                out[f"kv_scatter_{w}"] = int(
+                    self._kv_scatter_fns[w]._cache_size())
         if self.prefix_cache is not None and not self.paged:
             out["prefix_insert"] = int(self._insert_fn._cache_size())
         if self._spec is not None:

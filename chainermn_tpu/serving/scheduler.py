@@ -300,6 +300,32 @@ class SwapTicket:
         return self.t_executed - self.t_request
 
 
+class KvReuseTicket:
+    """Handle for one pending fleet KV-reuse operation served on the
+    scheduler's driving thread between steps (a prefix export for
+    cross-replica sharing, or a mid-decode rebalance handover). The
+    requesting thread ``wait()``s with a bounded timeout; a timeout or
+    ``None``/``False`` result decays to the do-nothing fallback
+    (re-prefill / decode in place) — the ticket never blocks the drive
+    loop and never fails a request."""
+
+    def __init__(self, kind: str, **kw) -> None:
+        self.kind = kind
+        self.kw = kw
+        self.result: object = None
+        self._done = threading.Event()
+
+    def resolve(self, result: object) -> None:
+        self.result = result
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> object:
+        """Block until served (or ``timeout``); the result, else None."""
+        if not self._done.wait(timeout):
+            return None
+        return self.result
+
+
 class FCFSScheduler:
     """First-come-first-served continuous-batching scheduler.
 
@@ -405,6 +431,11 @@ class FCFSScheduler:
         # admitted FCFS at step() start once the engine can take them
         self._pending_imports: deque = sanitizer.guarded(
             deque(), lock=self._lock, name="FCFSScheduler._pending_imports")
+        # fleet KV-reuse operations awaiting the drive thread: prefix
+        # share exports/imports and mid-decode rebalance handovers (all
+        # device work, so only step() may serve them)
+        self._pending_kv_reuse: deque = sanitizer.guarded(
+            deque(), lock=self._lock, name="FCFSScheduler._pending_kv_reuse")
         self._ids = itertools.count()
         self._pending_swap: Optional[SwapTicket] = None
 
@@ -514,6 +545,7 @@ class FCFSScheduler:
             return (bool(self._queue) or bool(self._by_slot)
                     or bool(self._prefilling)
                     or bool(self._pending_imports)
+                    or bool(self._pending_kv_reuse)
                     or self._pending_swap is not None)
 
     @property
@@ -546,6 +578,13 @@ class FCFSScheduler:
             # kill-mid-migration loses nothing
             drained.extend(req for req, _ in self._pending_imports)
             self._pending_imports.clear()
+            # pending KV-reuse tickets resolve empty-handed NOW: a share
+            # handshake waiting on this dead replica must decay to
+            # re-prefill immediately, not after its full timeout
+            reuse = list(self._pending_kv_reuse)
+            self._pending_kv_reuse.clear()
+        for ticket in reuse:
+            ticket.resolve(None)
         for req in drained:
             if self.costs is not None:
                 self.costs.finalize(req.id)
@@ -633,7 +672,11 @@ class FCFSScheduler:
         # spent elsewhere, they only need a slot + one scatter. They
         # admit even through a swap fence — they STARTED on the current
         # weights elsewhere, so they must finish on them here (the fence
-        # simply waits for them like any other in-flight work)
+        # simply waits for them like any other in-flight work).
+        # Fleet KV-reuse operations (prefix share export/import,
+        # rebalance handover) run first: a shared prefix landed here must
+        # be trie-resident BEFORE this step's fresh admissions match
+        self._serve_kv_reuse()
         self._admit_imports()
         with annotate("chainermn.serving_admit"):
             calls = 0
@@ -1197,17 +1240,26 @@ class FCFSScheduler:
 
     def _maybe_migrate(self, req: Request, slot: int) -> bool:
         """Offer a prefill-complete request to :attr:`migrate_cb` for
-        handover to a decode-tier peer. The slot's KV blocks are read out
+        handover to a decode-tier peer (see :meth:`_handover`)."""
+        cb = self.migrate_cb
+        if cb is None or not getattr(self.engine, "migration_supported",
+                                     False):
+            return False
+        return self._handover(req, slot, cb, reason="migrated")
+
+    def _handover(self, req: Request, slot: int, cb: Callable,
+                  reason: str = "migrated") -> bool:
+        """Hand an in-flight request's slot over to a peer through
+        ``cb(req, payload) -> bool``. The slot's KV blocks are read out
         host-side first (read-only gather — the slot keeps decoding in
         place if anything below fails), then the callback places the
         request: on True the SAME Request object now belongs to the
         destination scheduler and the slot is released here; on False —
         or an export/callback raise — the request is re-bound to its slot
-        unchanged. Never a lost request."""
-        cb = self.migrate_cb
-        if cb is None or not getattr(self.engine, "migration_supported",
-                                     False):
-            return False
+        unchanged. Never a lost request. Shared by the prefill-complete
+        migration (``reason="migrated"``) and the mid-decode rebalance
+        (``reason="rebalanced"``) — the payload format and the
+        all-or-nothing import don't care why the blocks are moving."""
         t0 = time.perf_counter()
         try:
             payload = self.engine.export_slot_kv(
@@ -1247,9 +1299,127 @@ class FCFSScheduler:
             self.costs.finalize(req.id)
         self.engine.release(slot)
         self._events.emit("slot_retire", req=req.id, slot=slot,
-                          reason="migrated", tokens=n_tokens,
+                          reason=reason, tokens=n_tokens,
                           **self._trace_label(req))
         return True
+
+    # ------------------------------------------------------------------ #
+    # fleet KV reuse (prefix sharing + mid-decode rebalancing)            #
+    # ------------------------------------------------------------------ #
+
+    def request_prefix_export(self, tokens, *,
+                              min_blocks: int = 1) -> KvReuseTicket:
+        """Ask the drive thread to export this engine's cached prefix of
+        ``tokens`` (thread-safe; the fleet router's share handshake).
+        The ticket resolves to the share payload, or ``None`` when the
+        trie holds fewer than ``min_blocks`` — the caller's timeout on
+        ``wait()`` is the whole backpressure story: a wedged holder just
+        means the destination re-prefills."""
+        ticket = KvReuseTicket("prefix_export", tokens=tokens,
+                               min_blocks=int(min_blocks))
+        with self._lock:
+            self._pending_kv_reuse.append(ticket)
+        return ticket
+
+    def enqueue_prefix_import(self, payload: dict,
+                              on_done: Optional[Callable] = None
+                              ) -> KvReuseTicket:
+        """Queue a shared prefix payload for adoption into this engine's
+        trie (thread-safe). Served at the next step() BEFORE fresh
+        admissions, so a request submitted after the returned ticket
+        resolves admits against the already-populated trie — zero
+        prefill of the shared blocks. The ticket resolves to the blocks
+        adopted (0 = already cached here, or the import failed —
+        decays to a plain prefill); ``on_done(adopted)`` additionally
+        fires on the drive thread."""
+        ticket = KvReuseTicket("prefix_import", payload=payload,
+                               on_done=on_done)
+        with self._lock:
+            self._pending_kv_reuse.append(ticket)
+        return ticket
+
+    def request_rebalance(self, place_cb: Callable) -> KvReuseTicket:
+        """Ask the drive thread to hand its cheapest decoding victim
+        over through ``place_cb(req, payload) -> bool`` (thread-safe;
+        the fleet controller's mid-decode rebalance). Resolves True when
+        a victim moved; False/None keeps everything decoding in place."""
+        ticket = KvReuseTicket("rebalance", place_cb=place_cb)
+        with self._lock:
+            self._pending_kv_reuse.append(ticket)
+        return ticket
+
+    def _serve_kv_reuse(self) -> None:
+        """Drain the pending KV-reuse queue on the drive thread (step()
+        start, before fresh admissions). Every operation is best-effort:
+        an export that can't match resolves None, an import that can't
+        land is dropped (the requester re-prefills), a rebalance that
+        can't place leaves the victim decoding here. Only a store-
+        consuming failure escalates (engine-failure boundary, same as
+        migrated imports)."""
+        eng = self.engine
+        while True:
+            with self._lock:
+                if not self._pending_kv_reuse:
+                    return
+                ticket = self._pending_kv_reuse.popleft()
+            if ticket.kind == "prefix_export":
+                payload = None
+                try:
+                    payload = eng.export_prefix_kv(
+                        ticket.kw["tokens"],
+                        min_blocks=ticket.kw["min_blocks"])
+                except Exception:  # noqa: BLE001 — share is best-effort
+                    payload = None
+                ticket.resolve(payload)
+            elif ticket.kind == "prefix_import":
+                adopted = 0
+                payload = ticket.kw["payload"]
+                try:
+                    if eng.can_import_prefix(payload):
+                        adopted = eng.import_prefix_kv(payload)
+                except EngineStateError as e:
+                    ticket.resolve(0)
+                    if not self._engine_failure(e):
+                        raise
+                    return
+                except Exception:  # noqa: BLE001 — decay to re-prefill
+                    adopted = 0
+                ticket.resolve(adopted)
+                on_done = ticket.kw.get("on_done")
+                if on_done is not None:
+                    try:
+                        on_done(adopted)
+                    except Exception:  # noqa: BLE001 — observer only
+                        pass
+            elif ticket.kind == "rebalance":
+                ok = False
+                try:
+                    ok = self._rebalance_once(ticket.kw["place_cb"])
+                except Exception:  # noqa: BLE001 — decode in place
+                    ok = False
+                ticket.resolve(bool(ok))
+
+    def _rebalance_once(self, place_cb: Callable) -> bool:
+        """Pick this scheduler's cheapest decoding victim — batch class
+        first, then fewest live KV blocks (least payload to move), then
+        the PR-18 tenant-overshare/recency order — and hand it over
+        mid-decode through :meth:`_handover`. PREFILLING slots are never
+        victims (their staged chunk state is not transferable)."""
+        with self._lock:
+            cands = [(slot, req) for slot, req in self._by_slot.items()
+                     if not req.finished]
+        if not cands:
+            return False
+
+        def cheap_key(item):
+            slot, req = item
+            blocks = self.engine.slot_block_count(slot)
+            return (req.priority == "batch", -blocks,
+                    (self._fair.tenant_share(req.tenant)
+                     if self._fair is not None else 0.0), req.id)
+
+        slot, req = max(cands, key=cheap_key)
+        return self._handover(req, slot, place_cb, reason="rebalanced")
 
     def enqueue_migrated(self, req: Request, payload: dict) -> Request:
         """Accept a prefill-complete request handed over from another

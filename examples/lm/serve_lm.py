@@ -105,6 +105,18 @@ their KV blocks migrate host-bounce to a decode replica (same token
 stream, rng and position ride along; a failed migration just decodes in
 place). The migration counters print with the fleet report.
 
+And fleet-wide KV reuse (ISSUE 20): ``--share-prefixes`` (paged fleet,
+affinity on) turns an affinity MISS on a prompt whose prefix another
+replica holds into a prefix hit — the holder exports the cached blocks
+once through the fused migration gather, a host-side payload LRU serves
+every later adopter, and the routed replica imports them before the
+request admits, prefilling only the uncached suffix; ``--rebalance``
+probes mid-stream decode rebalancing — while the burst is in flight the
+router migrates one live decode from the busiest replica to the least
+loaded, and the victim finishes token-exactly on its new home. The
+share/rebalance counters and payload-cache stats print with the fleet
+report.
+
 Run (CPU mesh; any accelerator works the same)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -139,6 +151,13 @@ Run (CPU mesh; any accelerator works the same)::
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/lm/serve_lm.py --paged-kv --chunk-tokens 4 \
         --prefill-replicas 1 --decode-replicas 1 --verify-parity
+
+    # fleet-wide KV reuse: cross-replica prefix sharing + a mid-stream
+    # decode-rebalance probe:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/lm/serve_lm.py --replicas 2 --paged-kv \
+        --kv-block-size 2 --shared-prefix 12 --share-prefixes \
+        --rebalance --verify-parity
 """
 
 from __future__ import annotations
@@ -239,6 +258,20 @@ def main() -> None:
                     help="disaggregated tiers: replicas that only take "
                          "migrated-in decode work (give with "
                          "--prefill-replicas; the fleet size is P+D)")
+    ap.add_argument("--share-prefixes", action="store_true",
+                    help="cross-replica prefix sharing (ISSUE 20, needs "
+                         "a --paged-kv fleet with affinity): an affinity "
+                         "miss on a prompt whose prefix another replica "
+                         "holds exports those blocks through the fused "
+                         "migration path (cached host-side, LRU) and "
+                         "imports them into the routed replica BEFORE "
+                         "admission — only the uncached suffix prefills")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="mid-stream decode rebalancing probe (ISSUE 20, "
+                         "needs a --paged-kv fleet): while the burst is "
+                         "in flight, migrate one live decode from the "
+                         "busiest replica to the least loaded — the "
+                         "victim finishes token-exactly on its new home")
     ap.add_argument("--affinity", dest="affinity", action="store_true",
                     default=True,
                     help="prefix-affinity routing (default): requests "
@@ -495,6 +528,16 @@ def main() -> None:
             raise SystemExit("--autoscale resizes a symmetric fleet; "
                              "static tiers don't mix with it")
     fleet_mode = args.replicas > 1 or args.autoscale or tiered
+    if args.share_prefixes or args.rebalance:
+        if not args.paged_kv:
+            raise SystemExit("--share-prefixes/--rebalance move "
+                             "block-store rows; add --paged-kv")
+        if not fleet_mode:
+            raise SystemExit("--share-prefixes/--rebalance need a fleet; "
+                             "add --replicas 2 (or more)")
+        if args.share_prefixes and not args.affinity:
+            raise SystemExit("--share-prefixes finds holders through the "
+                             "affinity trie; drop --no-affinity")
     n_start = (args.prefill_replicas + args.decode_replicas if tiered
                else max(args.replicas, args.min_replicas)
                if args.autoscale else args.replicas)
@@ -512,7 +555,9 @@ def main() -> None:
                             max_queue=args.max_queue or None,
                             default_deadline_s=args.deadline or None,
                             chunk_tokens_per_step=args.chunk_tokens
-                            or None, **tier_kw, **fair_kw)
+                            or None,
+                            share_prefixes=args.share_prefixes,
+                            **tier_kw, **fair_kw)
         front.wait_ready(600)   # every replica warm, off the burst clock
     else:
         engine = ServingEngine(model, params, **engine_kw)
@@ -634,6 +679,19 @@ def main() -> None:
                 parity_jobs.append((h, prompt, n_new, key))
             except QueueFullError:
                 rejected += 1
+        rebalanced = None
+        if args.rebalance:
+            # the probe: pick the busiest replica while the burst is in
+            # flight and ask the router to move one live decode off it
+            # (a False just means nothing was mid-decode to move — the
+            # demo burst may drain faster than the handshake)
+            snaps = [r.snapshot() for r in client.replicas]
+            busy = [s for s in snaps if s.active_slots > 0]
+            src = max(busy or snaps,
+                      key=lambda s: s.active_slots).replica_id
+            ticket = client.rebalance_decode(src)
+            rebalanced = (bool(ticket.wait(30))
+                          if ticket is not None else False)
         for h in handles + [streamed]:
             try:
                 h.wait(timeout=600)
@@ -746,6 +804,16 @@ def main() -> None:
                   "(zero recompiles after warmup)")
         print("fleet: " + ", ".join(
             f"{k}={v}" for k, v in fleet_rep["affinity"].items()))
+        if args.share_prefixes or args.rebalance:
+            kr = fleet_rep["kv_reuse"]
+            pc = kr.get("payload_cache") or {}
+            print(f"kv reuse: share_enabled={kr['share_enabled']} "
+                  f"shares={kr['shares']} rebalances={kr['rebalances']} "
+                  f"payload_cache_hits={pc.get('hits', 0)} "
+                  f"payload_cache_entries={pc.get('entries', 0)} "
+                  f"payload_cache_imports={pc.get('imports', 0)}")
+        if args.rebalance:
+            print(f"rebalance probe: moved={rebalanced}")
         if fleet_rep.get("tiers"):
             from chainermn_tpu.monitor._state import get_registry
 

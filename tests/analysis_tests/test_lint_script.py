@@ -11,11 +11,14 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 LINT_SH = os.path.join(REPO_ROOT, "scripts", "lint.sh")
 
 
+@pytest.mark.slow  # ~9s; the lint-0 invariant stays pinned tier-1 by test_repo_clean — keep tier-1 inside its timeout
 def test_lint_script_exits_clean(tmp_path):
     # full-tree target: the consistency rules are tree-global (catalog +
     # test references), so any subset produces spurious findings

@@ -64,6 +64,7 @@ def test_offline_publish_without_scheduler(lm_and_params):
     assert engine.weight_version == 1
 
 
+@pytest.mark.slow  # ~6s; fence semantics stay tier-1 via test_failed_swap_never_leaves_prior_version + test_engine_death_fails_the_fenced_ticket — keep tier-1 inside its timeout
 def test_swap_mid_stream_is_token_exact(lm_and_params):
     """THE hot-swap acceptance: requests in flight when the publish lands
     drain on the OLD weights (token-exact vs solo generate), requests

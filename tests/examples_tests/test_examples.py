@@ -49,6 +49,7 @@ def test_train_mnist():
     assert "epoch   1" in proc.stdout
 
 
+@pytest.mark.slow  # ~4s; MultiNodeChainList training stays tier-1 in links_tests/test_multi_node_chain_list
 def test_train_mnist_model_parallel():
     proc = run_example("mnist/train_mnist_model_parallel.py", TINY_MNIST)
     assert "epoch   1" in proc.stdout
@@ -78,6 +79,7 @@ TINY_SEQ2SEQ = ["--epoch", "2", "--n-train", "256", "--n-test", "64",
                 "--unit", "24", "--batchsize", "32", "--seq-len", "6"]
 
 
+@pytest.mark.slow  # ~8s; the seq2seq example keeps a tier-1 representative in test_seq2seq_hybrid_dp_mp
 def test_seq2seq_model_parallel():
     proc = run_example("seq2seq/seq2seq.py", TINY_SEQ2SEQ)
     assert "epoch   2" in proc.stdout
@@ -154,6 +156,7 @@ def test_train_lm_pipeline():
     assert "done: loss" in proc.stdout
 
 
+@pytest.mark.slow  # ~7s; the serve_lm CLI core is driven tier-1 by the paged/disagg/speculative example tests below — keep tier-1 inside its timeout
 def test_serve_lm():
     proc = run_example(
         "lm/serve_lm.py",
@@ -201,6 +204,31 @@ def test_serve_lm_disagg_tiers():
     mig = int(proc.stdout.split("kv_migrations_total=")[1].split()[0])
     assert mig >= 1, proc.stdout
     # the decode replica really served the migrated streams
+    for line in proc.stdout.splitlines():
+        if line.startswith("replica "):
+            assert "zero recompiles" in line
+
+
+@pytest.mark.slow  # ~14s; share/rebalance parity stays tier-1 in fleet_tests — keep tier-1 inside its timeout
+def test_serve_lm_kv_reuse():
+    """ISSUE 20: fleet-wide KV reuse through the example — a paged
+    2-replica fleet with ``--share-prefixes`` turns affinity misses on
+    the shared system prompt into cross-replica prefix imports, the
+    ``--rebalance`` probe runs mid-burst, parity vs solo generate()
+    holds, and the kv-reuse report line prints with the fleet report."""
+    proc = run_example(
+        "lm/serve_lm.py",
+        ["--requests", "8", "--slots", "1", "--replicas", "2",
+         "--max-new", "6", "--prefill-len", "16", "--d-model", "32",
+         "--layers", "1", "--heads", "4", "--paged-kv",
+         "--kv-block-size", "2", "--shared-prefix", "12",
+         "--share-prefixes", "--rebalance", "--verify-parity"],
+    )
+    assert "8/8 requests served" in proc.stdout
+    assert "parity vs solo generate: OK (3 requests)" in proc.stdout
+    assert "kv reuse: share_enabled=True" in proc.stdout
+    assert "payload_cache_hits=" in proc.stdout
+    assert "rebalance probe: moved=" in proc.stdout
     for line in proc.stdout.splitlines():
         if line.startswith("replica "):
             assert "zero recompiles" in line
@@ -432,6 +460,7 @@ def test_train_lm_snapshot_then_serve_resharded(tmp_path):
     assert "zero recompiles" in serve.stdout
 
 
+@pytest.mark.slow  # ~6s; TP serving parity stays tier-1 in serving_tests/test_engine — keep tier-1 inside its timeout
 def test_serve_lm_tensor_parallel():
     proc = run_example(
         "lm/serve_lm.py",

@@ -108,6 +108,7 @@ def test_concurrent_requests_through_tiers(lm_and_params, ref_tail):
         router.close()
 
 
+@pytest.mark.slow  # ~15s; migrate-fault fallback also pinned tier-1 by resilience_tests/test_serving_degradation reroute tests
 def test_migrate_chaos_decodes_in_place(lm_and_params, ref_tail):
     """Every fleet.migrate attempt faults: the prefill replica keeps the
     request and decodes it locally — degraded locality, zero loss."""
@@ -124,6 +125,7 @@ def test_migrate_chaos_decodes_in_place(lm_and_params, ref_tail):
             router.close()
 
 
+@pytest.mark.slow  # ~14s; fault fallback stays pinned tier-1 by resilience_tests/test_serving_degradation reroute tests
 def test_kill_decode_replica_mid_flight(lm_and_params, ref_tail):
     """The decode tier dies while a migrated request may be in any of
     queued / importing / decoding there: the router's failover path

@@ -45,6 +45,7 @@ def solo(lm, params, prompt, n):
 # --------------------------------------------------------------------- #
 
 
+@pytest.mark.slow  # ~7s; cross-replica parity stays tier-1 via test_kill_replica_mid_stream_loses_nothing + the share tests — keep tier-1 inside its timeout
 def test_two_replicas_interleaved_parity(lm_and_params):
     """Mixed prefix-heavy traffic through 2 replicas is token-for-token
     a set of solo generate() calls, no surviving replica recompiled

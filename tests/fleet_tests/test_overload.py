@@ -274,6 +274,7 @@ def test_controller_prefers_brownout_then_scales_then_relieves(
         assert all(fr.state is RequestState.DONE for fr in frs)
 
 
+@pytest.mark.slow  # ~6s; scale-up-from-snapshot stays tier-1 in fleet_tests/test_control — keep tier-1 inside its timeout
 def test_scale_up_spawns_from_snapshot_with_factory_fallback(
         lm_and_params, tmp_path):
     """Scale-up restores the new replica from the fleet's persisted

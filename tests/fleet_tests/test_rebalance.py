@@ -1,0 +1,182 @@
+"""Mid-stream decode rebalancing (ISSUE 20): a live decode slot moves
+from a loaded replica to an idle peer through the PR-19 migration
+primitive — the victim's KV blocks travel fused, the SAME scheduler
+Request continues on the destination, and the token stream is exactly
+what decode-in-place would have produced.
+
+Pinned: the end-to-end handover (token parity vs solo ``generate()``,
+victim lands on the destination, ``kv_rebalances_total`` + the
+``rebalance`` event move, zero recompiles); chaos at the
+``fleet.rebalance`` cut-point leaving the victim decoding in place with
+identical output; and the controller's ``migrate_decode`` policy branch
+driving the whole loop from a load-gap sensor reading."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.fleet import (
+    FleetController,
+    FleetRouter,
+    RebalancePolicy,
+)
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.monitor.health import fleet_health
+from chainermn_tpu.resilience import FaultInjector
+from chainermn_tpu.resilience.cutpoints import FLEET_REBALANCE
+from chainermn_tpu.serving import ServingEngine
+
+PROMPT = np.asarray([1, 4, 2, 7, 3, 5, 6, 2, 9, 4, 1, 3], np.int32)
+RNG = jax.random.PRNGKey(7)
+N_NEW = 20                      # long enough to still be decoding when
+                                # the rebalance lands (stream throttled)
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=2,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make_engine(lm, params):
+    return ServingEngine(lm, params, n_slots=2,
+                         prefill_buckets=(4, 8, 16), prefill_batch=2,
+                         paged=True, kv_block_size=2, kv_blocks=64,
+                         cache_len=48)
+
+
+@pytest.fixture(scope="module")
+def ref_tail(lm_and_params):
+    lm, params = lm_and_params
+    solo = np.asarray(generate(lm, params, jnp.asarray(PROMPT)[None],
+                               N_NEW, rng=RNG)[0])
+    return [int(t) for t in solo[len(PROMPT):]]
+
+
+def make_fleet(lm, params):
+    router = FleetRouter([make_engine(lm, params) for _ in range(2)])
+    assert router.wait_ready(300)
+    return router
+
+
+def _counter(name):
+    return sum(v for k, v in get_registry().snapshot()["counters"].items()
+               if k.startswith(name))
+
+
+def _throttle(delay_s=0.015):
+    """A stream consumer that slows the drive loop enough to keep the
+    request mid-decode while the rebalance handshake runs."""
+    def cb(tok):
+        time.sleep(delay_s)
+    return cb
+
+
+def _wait_first_token(fr, timeout=60.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if fr.tokens or fr.finished:
+            return
+        time.sleep(0.002)
+    raise AssertionError("request never produced a token")
+
+
+def test_mid_decode_rebalance_token_exact(lm_and_params, ref_tail):
+    lm, params = lm_and_params
+    router = make_fleet(lm, params)
+    try:
+        before = _counter("kv_rebalances_total")
+        fr = router.submit(PROMPT, N_NEW, rng=RNG,
+                           stream_cb=_throttle())
+        assert fr.replica_id == 0            # least-loaded tie
+        _wait_first_token(fr)
+        ticket = router.rebalance_decode(0, 1)
+        assert ticket is not None
+        assert ticket.wait(30) is True       # a victim moved
+        assert fr.wait(60)
+        assert [int(t) for t in fr.tokens] == ref_tail
+        assert fr.replica_id == 1            # attribution follows the KV
+        assert _counter("kv_rebalances_total") == before + 1
+        evs = [e for e in get_event_log().tail()
+               if e["kind"] == "rebalance" and e.get("req") == fr.id]
+        assert evs and evs[-1]["src"] == 0 and evs[-1]["dest"] == 1
+        rep = router.fleet_report()["kv_reuse"]
+        assert rep["rebalances"] == 1
+        for r in router.replicas:
+            assert r.engine.recompiles == {}
+    finally:
+        router.close()
+
+
+@pytest.mark.slow  # ~13s; cut-point containment runs tier-1 in resilience_tests — the token-exact handover above stays tier-1
+def test_rebalance_chaos_victim_decodes_in_place(lm_and_params,
+                                                 ref_tail):
+    """Every fleet.rebalance attempt faults: the victim keeps its slot
+    and decodes where it is — identical tokens, nothing lost."""
+    lm, params = lm_and_params
+    inj = FaultInjector()
+    inj.arm(FLEET_REBALANCE, times=100)
+    with inj:
+        router = make_fleet(lm, params)
+        try:
+            before = _counter("kv_rebalances_total")
+            fr = router.submit(PROMPT, N_NEW, rng=RNG,
+                               stream_cb=_throttle())
+            _wait_first_token(fr)
+            ticket = router.rebalance_decode(0, 1)
+            assert ticket is not None
+            assert not ticket.wait(30)       # chaos: stayed local
+            assert fr.wait(60)
+            assert [int(t) for t in fr.tokens] == ref_tail
+            assert fr.replica_id == 0
+            assert inj.fired_log, "rebalance cut-point never fired"
+            assert _counter("kv_rebalances_total") == before
+        finally:
+            router.close()
+
+
+@pytest.mark.slow  # ~15s; the rebalance handover itself is tier-1 above — the controller loop only re-drives it
+def test_controller_migrate_decode_policy_drives_handover(
+        lm_and_params, ref_tail):
+    """The closed loop: the controller's load-gap sensor reading picks
+    the busy replica as source and the idle peer as destination, and
+    one policy tick moves a live decode mid-stream."""
+    lm, params = lm_and_params
+    router = make_fleet(lm, params)
+    col = None
+    try:
+        col = fleet_health(router, stall_timeout_s=60.0)
+        ctrl = FleetController(
+            router, col,
+            rebalance=RebalancePolicy(migrate_decode=True,
+                                      migrate_load_gap=0.1,
+                                      migrate_cooldown_s=0.0))
+        before = _counter("kv_rebalances_total")
+        fr = router.submit(PROMPT, N_NEW, rng=RNG,
+                           stream_cb=_throttle())
+        _wait_first_token(fr)
+        col.tick(now=1.0)
+        s = ctrl.tick(now=1.0)
+        acts = [a for a in s["actions"]
+                if a["action"] == "rebalance_decode"]
+        assert acts and acts[0]["src"] == 0 and acts[0]["dest"] == 1
+        assert fr.wait(60)
+        assert [int(t) for t in fr.tokens] == ref_tail
+        assert fr.replica_id == 1
+        assert _counter("kv_rebalances_total") == before + 1
+        # cooldown honoured: an immediate second tick with nothing left
+        # to move takes no action
+        col.tick(now=1.1)
+        assert [a for a in ctrl.tick(now=1.1)["actions"]
+                if a["action"] == "rebalance_decode"] == []
+    finally:
+        if col is not None:
+            col.stop()
+        router.close()

@@ -1,0 +1,202 @@
+"""Cross-replica prefix sharing (ISSUE 20): when the fleet trie knows a
+holder but the routing policy sends a request elsewhere (holder
+overloaded/degraded), the router exports the holder's cached prefix KV
+through the fused block path and imports it into the destination's
+block pool + trie BEFORE the request admits — the affinity miss turns
+back into a prefix hit, with zero prefill of the shared blocks.
+
+Pinned: the payload LRU's refcount/eviction contract and longest-prefix
+match (host-only units); ``FleetTrie.forget`` (the disaggregation
+staleness fix — blocks that moved stop routing affinity at their old
+home); the end-to-end share handshake with token parity vs solo
+``generate()`` and an admission that prefilled only the uncached
+suffix; and chaos at the ``fleet.share`` cut-point decaying to a plain
+re-prefill on the destination — never a lost or wrong request."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.fleet import FleetRouter, FleetTrie, SharePayloadCache
+from chainermn_tpu.fleet.routing import RoutingPolicy
+from chainermn_tpu.models import TransformerLM, generate
+from chainermn_tpu.monitor._state import get_event_log, get_registry
+from chainermn_tpu.resilience import FaultInjector
+from chainermn_tpu.resilience.cutpoints import FLEET_SHARE
+from chainermn_tpu.serving import ServingEngine
+
+PROMPT = np.asarray([1, 4, 2, 7, 3, 5, 6, 2, 9, 4, 1, 3], np.int32)
+RNG = jax.random.PRNGKey(7)
+N_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    lm = TransformerLM(vocab_size=17, d_model=16, n_heads=4, n_layers=2,
+                       max_len=48, compute_dtype=jnp.float32)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.asarray([[1, 2, 3]], jnp.int32))
+    return lm, params
+
+
+def make_engine(lm, params):
+    return ServingEngine(lm, params, n_slots=2,
+                         prefill_buckets=(4, 8, 16), prefill_batch=2,
+                         paged=True, kv_block_size=2, kv_blocks=64,
+                         cache_len=48)
+
+
+@pytest.fixture(scope="module")
+def ref_tail(lm_and_params):
+    lm, params = lm_and_params
+    solo = np.asarray(generate(lm, params, jnp.asarray(PROMPT)[None],
+                               N_NEW, rng=RNG)[0])
+    return [int(t) for t in solo[len(PROMPT):]]
+
+
+def make_sharing_fleet(lm, params):
+    """Two replicas, sharing on, and a zero-tolerance imbalance policy:
+    ANY load on the holder rejects affinity — the deterministic way to
+    manufacture the share trigger (holder known, routed elsewhere)."""
+    router = FleetRouter([make_engine(lm, params) for _ in range(2)],
+                         share_prefixes=True, prefix_share_min_blocks=2,
+                         policy=RoutingPolicy(max_imbalance=0.0))
+    assert router.wait_ready(300)
+    return router
+
+
+def _counter(name):
+    return sum(v for k, v in get_registry().snapshot()["counters"].items()
+               if k.startswith(name))
+
+
+# --------------------------------------------------------------------- #
+# host-only units: payload cache + trie forget                           #
+# --------------------------------------------------------------------- #
+
+def _payload(tokens, n_blocks):
+    return {"tokens": np.asarray(tokens, np.int32),
+            "n_blocks": n_blocks, "block_size": 2, "kv_quant": False,
+            "n_layers": 1, "layers": [], "t_start": 0.0}
+
+
+def test_payload_cache_longest_prefix_match_and_refcounts():
+    cache = SharePayloadCache(max_entries=4)
+    short = cache.put(_payload([1, 2, 3, 4], 2))
+    long = cache.put(_payload([1, 2, 3, 4, 5, 6], 3))
+    cache.release(short)
+    cache.release(long)
+    assert cache.match([9, 9]) is None           # no counted hit
+    hit = cache.match([1, 2, 3, 4, 5, 6, 7, 8])
+    assert hit is long                           # longest covering entry
+    assert hit.pins == 1
+    mid = cache.match([1, 2, 3, 4, 5])           # long doesn't cover -> short
+    assert mid is short
+    cache.release(hit, imported=True)
+    cache.release(mid)
+    assert cache.to_json()["hits"] == 2
+    assert cache.to_json()["imports"] == 1
+
+
+def test_payload_cache_lru_eviction_spares_pinned():
+    cache = SharePayloadCache(max_entries=2)
+    a = cache.put(_payload([1, 1], 1))           # stays pinned
+    b = cache.put(_payload([2, 2], 1))
+    cache.release(b)
+    c = cache.put(_payload([3, 3], 1))           # evicts b (a is pinned)
+    cache.release(c)
+    assert cache.match([2, 2, 5]) is None
+    assert cache.match([1, 1, 5]) is a
+    assert cache.to_json()["evictions"] == 1
+    assert len(cache) == 2
+
+
+def test_payload_cache_put_dedups_per_prefix():
+    cache = SharePayloadCache(max_entries=4)
+    a = cache.put(_payload([4, 4, 4, 4], 2))
+    b = cache.put(_payload([4, 4, 4, 4], 2))     # racing second export
+    assert a is b and a.pins == 2
+    cache.release(a)
+    cache.release(b)
+    assert len(cache) == 1
+
+
+def test_fleet_trie_forget_is_surgical():
+    trie = FleetTrie(block_size=2)
+    trie.note([1, 2, 3, 4, 5, 6], replica_id=0)
+    trie.note([1, 2, 3, 4], replica_id=1)        # shares the first 2 blocks
+    assert trie.forget([1, 2, 3, 4, 5, 6], replica_id=0) == 3
+    # replica 1's co-ownership of the shared prefix survives
+    assert trie.lookup([1, 2, 3, 4]) == (1, 2)
+    # replica 0's exclusive tail was pruned with its last holder
+    rid, blocks = trie.lookup([1, 2, 3, 4, 5, 6])
+    assert (rid, blocks) == (1, 2)
+    # forgetting an unknown path/replica is a no-op
+    assert trie.forget([9, 9], replica_id=5) == 0
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: the share handshake                                        #
+# --------------------------------------------------------------------- #
+
+def test_share_turns_affinity_miss_into_prefix_hit(lm_and_params,
+                                                   ref_tail):
+    lm, params = lm_and_params
+    router = make_sharing_fleet(lm, params)
+    try:
+        assert router.share_prefixes
+        # request 1 lands on replica 0 (least-loaded tie) and caches the
+        # prompt's blocks there — replica 0 becomes the holder
+        out0 = router.generate(PROMPT, N_NEW, rng=RNG, timeout=60)
+        assert [int(t) for t in out0[len(PROMPT):]] == ref_tail
+        # shed the holder: its inflated load now rejects affinity, so the
+        # same prompt routes to replica 1 — the share trigger
+        router.set_admission_weight(0, 0.5)
+        before = _counter("kv_shares_total")
+        fr = router.submit(PROMPT, N_NEW, rng=RNG)
+        assert fr.wait(60)
+        assert fr.replica_id == 1
+        assert [int(t) for t in fr.tokens] == ref_tail
+        assert _counter("kv_shares_total") == before + 1
+        rep = router.fleet_report()["kv_reuse"]
+        assert rep["share_enabled"] and rep["shares"] >= 1
+        assert rep["payload_cache"]["entries"] == 1
+        assert rep["payload_cache"]["imports"] == 1
+        assert rep["payload_cache"]["pinned"] == 0   # refs all settled
+        # the destination admitted against the adopted blocks: its
+        # slot_admit shows the shared prefix as CACHED (the engine match
+        # caps at (len-1)//block_size = 5 blocks = 10 tokens), so only
+        # the 2-token suffix prefilled
+        admits = [e for e in get_event_log().tail()
+                  if e["kind"] == "slot_admit"
+                  and e.get("req") == fr._inner.id]
+        assert admits and admits[-1]["cached"] == 10
+        for r in router.replicas:
+            assert r.engine.recompiles == {}
+    finally:
+        router.close()
+
+
+@pytest.mark.slow  # ~12s; cut-point containment runs tier-1 in resilience_tests — the share happy path above stays tier-1
+def test_share_chaos_decays_to_plain_prefill(lm_and_params, ref_tail):
+    """Every fleet.share attempt faults: the destination prefills the
+    prefix itself — degraded reuse, zero loss, identical tokens."""
+    lm, params = lm_and_params
+    inj = FaultInjector()
+    inj.arm(FLEET_SHARE, times=100)
+    with inj:
+        router = make_sharing_fleet(lm, params)
+        try:
+            out0 = router.generate(PROMPT, N_NEW, rng=RNG, timeout=60)
+            assert [int(t) for t in out0[len(PROMPT):]] == ref_tail
+            router.set_admission_weight(0, 0.5)
+            before = _counter("kv_shares_total")
+            fr = router.submit(PROMPT, N_NEW, rng=RNG)
+            assert fr.wait(60)
+            assert [int(t) for t in fr.tokens] == ref_tail
+            assert inj.fired_log, "share cut-point never fired"
+            assert _counter("kv_shares_total") == before   # no share
+            assert router.fleet_report()["kv_reuse"]["shares"] == 0
+        finally:
+            router.close()

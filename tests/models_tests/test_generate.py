@@ -25,6 +25,7 @@ def comm():
     return chainermn_tpu.create_communicator("tpu")
 
 
+@pytest.mark.slow  # ~7s; greedy parity stays tier-1 via test_cache_matches_nocache + the eos tests — keep tier-1 inside its timeout
 def test_greedy_matches_stepwise_argmax(lm_and_params):
     """Cached generate(temperature=0) must equal the naive loop that re-runs
     the forward and argmaxes the last position each step."""
@@ -69,7 +70,10 @@ def test_sampling_is_deterministic_under_same_key(lm_and_params):
 
 
 @pytest.mark.parametrize("vocab_parallel", [
-    False,
+    # ~8s; TP decode parity stays tier-1 via serving_tests/test_engine
+    # test_tp_serving_matches_solo_tp_generate — keep tier-1 inside its
+    # timeout
+    pytest.param(False, marks=pytest.mark.slow),
     # ~7s; vocab-parallel head parity also pinned by the TP train tests — keep tier-1 inside its timeout
     pytest.param(True, marks=pytest.mark.slow),
 ])
@@ -107,6 +111,7 @@ def test_tp_generate(comm, vocab_parallel):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.slow  # ~6s; gshard MoE stays tier-1 via test_gspmd sharded training + cache-parity generate tests — keep tier-1 inside its timeout
 def test_moe_gshard_generate(lm_and_params):
     """MoE decode (round-4 verdict missing #4): a gshard MoE model decodes
     through the KV cache, cached == cacheless token-for-token (ample
@@ -164,6 +169,7 @@ def test_eos_early_stop(lm_and_params):
         generate(lm, params, prompt, 2, eos_id=99)
 
 
+@pytest.mark.slow  # ~6s; truncation semantics stay tier-1 via test_sampler_respects_filters + sampling determinism — keep tier-1 inside its timeout
 def test_top_k_top_p_sampling(lm_and_params):
     """Sampler truncation semantics end-to-end: top_k=1 and a tiny top_p
     both reduce to greedy for ANY rng; cached == cacheless under combined

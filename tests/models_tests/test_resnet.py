@@ -80,6 +80,7 @@ def test_resnet_eval_mode_uses_running_stats():
     assert not np.allclose(np.asarray(y1), np.asarray(y2))
 
 
+@pytest.mark.slow  # ~4s; ResNet shape/train coverage stays tier-1 in this file's other tests — keep tier-1 inside its timeout
 def test_resnet18_uses_basic_blocks():
     model = ResNet18(num_classes=10, width=8, compute_dtype=jnp.float32)
     x = jnp.ones((1, 64, 64, 3))

@@ -107,10 +107,11 @@ def test_zigzag_lm_forward_matches_full(comm):
 
 @pytest.mark.parametrize("kind", [
     "zigzag",
-    # ~21s; flash-block composition has tier-1 gradient parity in
+    # ~21s; flash-block composition keeps forward + bf16 parity in
     # parallel_tests/test_sequence — keep tier-1 inside its timeout
     pytest.param("zigzag_flash", marks=pytest.mark.slow),
 ])
+@pytest.mark.slow  # ~8s; seq-parallel LM training stays tier-1 via test_lm_train_step_sequence_parallel_learns
 def test_zigzag_lm_train_step_learns(comm, kind):
     """The SP train step with zigzag attention (XLA blocks and Pallas
     blocks): data permuted once on the host, loss (mean over tokens) needs
@@ -195,6 +196,7 @@ def test_lm_train_step_data_parallel(comm):
     # ~7s; top-2 routing covered by gshard tests — keep tier-1 inside its timeout
     pytest.param(2, marks=pytest.mark.slow),
 ])
+@pytest.mark.slow  # ~7s/param; sharded MoE training stays tier-1 via test_gspmd gshard coverage — keep tier-1 inside its timeout
 def test_moe_lm_trains(comm, top_k):
     """MoE TransformerLM (every 2nd block expert-routed over the mesh axis):
     the step adds the Switch aux loss, surfaces routing telemetry as a 4th
@@ -268,6 +270,7 @@ def test_remat_matches_nonremat():
                                    rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow  # ~8s; remat forward/grad parity stays tier-1 via test_remat_matches_nonremat — keep tier-1 inside its timeout
 def test_remat_train_step(comm):
     """remat threads through the canonical jitted DP train step."""
     from chainermn_tpu.training import jit_lm_train_step
@@ -324,6 +327,7 @@ def test_fused_ce_rejects_sharded_heads():
 
 
 @pytest.mark.slow  # ~17s; fused-CE parity vs materialized logits stays tier-1 — keep tier-1 inside its timeout
+@pytest.mark.slow  # ~7s; fused-CE math parity stays tier-1 via test_fused_ce_matches_materialized — keep tier-1 inside its timeout
 def test_fused_ce_sequence_parallel(comm):
     """fused_ce composes with the sequence-sharded step (zigzag): each
     shard's chunked CE over local tokens, global mean via the loss

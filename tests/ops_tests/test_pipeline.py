@@ -195,6 +195,7 @@ def test_pp_lm_forward_matches_dense_lm(comm):
     pytest.param(False, marks=pytest.mark.slow),
     True,
 ])
+@pytest.mark.slow  # ~13s; pp gradient parity (test_pipeline_gradients_match_serial) stays tier-1 — convergence is the slow tier
 def test_pp_lm_train_step_learns(comm, remat):
     from chainermn_tpu.ops import jit_pp_lm_train_step, pp_lm_opt_init
     import optax
@@ -213,6 +214,7 @@ def test_pp_lm_train_step_learns(comm, remat):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # ~6s; the bubble-formula pin is a perf-model check, parity stays tier-1 — keep tier-1 inside its timeout
 def test_pipeline_bubble_measured_vs_formula(comm):
     """Fill-drain accounting, measured: the schedule runs M + S - 1 ticks
     to do M microbatches of useful work, so with the PER-TICK cost held

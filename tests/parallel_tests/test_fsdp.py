@@ -104,6 +104,7 @@ def test_fsdp_matches_replicated_step(comm):
                                    rtol=2e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # ~7s; FSDP step parity stays tier-1 via test_fsdp_matches_replicated_step — keep tier-1 inside its timeout
 def test_fsdp_trains_transformer_lm(comm):
     """FSDP is model-agnostic: a TransformerLM trains through
     jit_fsdp_train_step (tokens as inputs, next-token ids as labels) with

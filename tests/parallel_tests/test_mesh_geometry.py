@@ -52,7 +52,11 @@ print(f"GEOMETRY_OK {n} grid={inter}x{intra}")
 """
 
 
-@pytest.mark.parametrize("n", [16, 7])
+@pytest.mark.parametrize("n", [
+    # ~7s; the factorable case rides the slow tier, the prime (fallback) case stays tier-1
+    pytest.param(16, marks=pytest.mark.slow),
+    7,
+])
 def test_hierarchical_factoring_subprocess(n):
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")

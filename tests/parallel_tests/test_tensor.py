@@ -140,6 +140,7 @@ def test_tp_grad_matches_serial(comm):
         )
 
 
+@pytest.mark.slow  # ~11s; TP training parity stays tier-1 via test_tp_lm_vocab_parallel_head_trains — keep tier-1 inside its timeout
 def test_tp_transformer_lm_trains(comm):
     """TransformerLM(tensor_axis=...) through jit_lm_train_step: the TP
     dispatch path, global-objective grads, plain optax optimizer. Loss must

@@ -156,6 +156,7 @@ def test_engine_raise_errors_in_flight_and_restarts(lm_and_params):
     assert m["requests_errored"] == 2 and m["engine_restarts"] == 1
 
 
+@pytest.mark.slow  # ~6s; in-flight raise + warm restart stays tier-1 via test_engine_raise_errors_in_flight_and_restarts — keep tier-1 inside its timeout
 def test_spec_verify_raise_errors_in_flight_and_restarts(lm_and_params):
     """The speculative target-verify call is an engine-failure boundary
     like ``serving.decode``: a raise inside ``serving.spec_verify``

@@ -33,6 +33,7 @@ def solo(lm, params, prompt, n, **kw):
     return np.asarray(out[0])
 
 
+@pytest.mark.slow  # ~7s; staggered ragged admission parity stays tier-1 via test_paged_kv's staggered test — keep tier-1 inside its timeout
 def test_ragged_staggered_admission_matches_solo_generate(lm_and_params):
     """THE continuous-batching parity test (acceptance criterion): mixed
     prompt lengths admitted at different times — more requests than

@@ -19,7 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import PartitionSpec as P
 
+import chainermn_tpu
+from chainermn_tpu.fleet import FleetRouter
 from chainermn_tpu.models import TransformerLM, generate
 from chainermn_tpu.monitor._state import get_registry
 from chainermn_tpu.serving import BlockPool, FCFSScheduler, ServingEngine
@@ -185,6 +188,41 @@ def test_import_pool_exhaustion_rolls_back(engines, ref_tail):
 
 
 # --------------------------------------------------------------------- #
+# fused transfer (ISSUE 20): bit-identical to the per-block reference    #
+# --------------------------------------------------------------------- #
+
+
+def test_fused_vs_per_block_bit_equality(engines):
+    """The fused gather reads exactly what N per-block dispatches read,
+    and rows written through the per-block scatter come back unchanged
+    through the fused gather — the bucket's pad lanes never leak into a
+    payload, so both sides are interchangeable byte-for-byte."""
+    src, dst = engines
+    slot_a, _ = _prefill_on(src)
+    fused = src.export_slot_kv(slot_a, fused=True)
+    ref = src.export_slot_kv(slot_a, fused=False)
+    assert fused["n_blocks"] == ref["n_blocks"] >= 2
+    for lf, lr in zip(fused["layers"], ref["layers"]):
+        assert set(lf) == set(lr)
+        for kk in lf:
+            np.testing.assert_array_equal(np.asarray(lf[kk]),
+                                          np.asarray(lr[kk]))
+    # write side crossed over: per-block import, fused re-export
+    slot_b = dst.import_slot_kv(ref, prompt=PROMPT, max_new=N_NEW,
+                                fused=False)
+    back = dst.export_slot_kv(slot_b, fused=True)
+    assert back["pos"] == fused["pos"]
+    assert back["token"] == fused["token"]
+    for lf, lb in zip(fused["layers"], back["layers"]):
+        for kk in lf:
+            np.testing.assert_array_equal(np.asarray(lf[kk]),
+                                          np.asarray(lb[kk]))
+    src.release(slot_a)
+    dst.release(slot_b)
+    assert src.recompiles == {} and dst.recompiles == {}
+
+
+# --------------------------------------------------------------------- #
 # scheduler-level handover                                               #
 # --------------------------------------------------------------------- #
 
@@ -274,3 +312,62 @@ def test_int8_chunked_and_migration_parity(lm_and_params):
     assert toks_m[:N_NEW] == toks_u
     for e in (eng_u, eng_c, eng_a, eng_b):
         assert e.recompiles == {}
+
+
+@pytest.mark.slow
+def test_int8_shared_prefix_parity(lm_and_params):
+    """A quantized prefix payload (int8 rows + scales as stored, no
+    dequant round-trip) adopted by a peer makes the peer's decode
+    token-identical to the holder's."""
+    lm, params = lm_and_params
+    eng_a = build(lm, params, kv_quant="int8")
+    eng_b = build(lm, params, kv_quant="int8")
+    sa = FCFSScheduler(eng_a)
+    ra = sa.submit(PROMPT, N_NEW, rng=RNG)
+    sa.run_until_idle()
+    assert ra.finished and len(ra.tokens) == N_NEW
+    payload = eng_a.export_prefix_kv(PROMPT, min_blocks=2)
+    assert payload is not None and payload["kv_quant"] == "int8"
+    covered = np.asarray(payload["tokens"], np.int32)
+    assert len(covered) == 10            # (len-1)//block_size blocks
+    assert eng_b.can_import_prefix(payload)
+    assert eng_b.import_prefix_kv(payload) == payload["n_blocks"]
+    assert eng_b.prefix_cache.missing_blocks(covered) == 0
+    sb = FCFSScheduler(eng_b)
+    rb = sb.submit(PROMPT, N_NEW, rng=RNG)
+    sb.run_until_idle()
+    assert rb.finished and rb.tokens == ra.tokens
+    assert eng_a.recompiles == {} and eng_b.recompiles == {}
+
+
+@pytest.mark.slow
+def test_tp_engine_degrades_sharing_gracefully():
+    """TP paged stores are head-sharded across the mesh — there is no
+    host-bounce path, so the share surface declines (None / False /
+    raise) instead of exporting shards, and a sharing-enabled router
+    over TP replicas silently runs with sharing off (the TP-fleet
+    stance: degrade, never error)."""
+    comm = chainermn_tpu.create_communicator("tpu")
+    lm = TransformerLM(vocab_size=32, d_model=16, n_heads=8, n_layers=2,
+                       max_len=32, tensor_axis=comm.axis_name,
+                       compute_dtype=jnp.float32)
+    params = jax.jit(comm.shard_map(
+        lambda t: lm.init(jax.random.PRNGKey(1), t),
+        in_specs=P(), out_specs=P(),
+    ))(jnp.asarray([[1, 2, 3]], jnp.int32))
+    eng = ServingEngine(lm, params, n_slots=2, prefill_len=8,
+                        cache_len=16, comm=comm, paged=True,
+                        kv_block_size=2)
+    assert not eng.migration_supported
+    assert eng.export_prefix_kv(np.arange(1, 9, dtype=np.int32)) is None
+    dummy = {"n_blocks": 1, "block_size": 2, "kv_quant": "none",
+             "n_layers": 2, "tokens": np.asarray([1, 2], np.int32),
+             "layers": [{}], "t_start": 0.0}
+    assert not eng.can_import_prefix(dummy)
+    with pytest.raises(RuntimeError, match="single-device"):
+        eng.import_prefix_kv(dummy)
+    router = FleetRouter([eng], share_prefixes=True, autostart=False)
+    try:
+        assert router.share_prefixes is False
+    finally:
+        router.close()

@@ -50,6 +50,7 @@ def _serve(lm, params, *, paged_kernel, **kw):
     return [list(r.output) for r in reqs], engine
 
 
+@pytest.mark.slow  # ~10s; op-level kernel parity stays tier-1 in parallel_tests/test_paged_kernel — keep tier-1 inside its timeout
 def test_kernel_engine_token_parity_and_zero_recompiles(lm_and_params):
     """paged_kernel=True serves the exact token streams of solo
     generate() — per-token decode shape. Equality with the default XLA

@@ -286,6 +286,7 @@ def test_restart_resets_tables_pool_and_trie_together(lm_and_params):
 # --------------------------------------------------------------------- #
 
 
+@pytest.mark.slow  # ~6s; int8 KV tolerance stays tier-1 via the op-level test_paged_int8_quant_tolerance + kernel int8 parity — keep tier-1 inside its timeout
 def test_int8_quant_greedy_tokens_within_tolerance(lm_and_params):
     """kv_quant='int8' perturbs attention by <= the per-row quant step —
     greedy decode must stay near-identical to the fp reference on this
@@ -315,6 +316,7 @@ def test_int8_quant_greedy_tokens_within_tolerance(lm_and_params):
 # --------------------------------------------------------------------- #
 
 
+@pytest.mark.slow  # ~11s; TP decode parity is tier-1 in models_tests/test_generate, paged parity tier-1 above — keep tier-1 inside its timeout
 def test_tp_paged_matches_solo_tp_generate():
     """The paged store head-sharded over the mesh: same scheduler, same
     parity bar — and a same-prefix follower shares head-sharded blocks."""

@@ -191,6 +191,7 @@ def test_zero_recompiles_across_buckets_after_warmup(lm_and_params,
     assert engine.compile_counts() == {"prefill": 2, "decode": 1}
 
 
+@pytest.mark.slow  # ~4s; the paged block-store twin of this scenario stays tier-1 in test_paged_kv — keep tier-1 inside its timeout
 def test_eviction_then_readmit_matches_solo(lm_and_params):
     """Acceptance criterion (c): once a cached prefix is evicted (tiny
     store), the same prompt admits as a miss — full prefill — with
@@ -217,6 +218,7 @@ def test_eviction_then_readmit_matches_solo(lm_and_params):
     np.testing.assert_array_equal(rb.output, solo(lm, params, b, 4))
 
 
+@pytest.mark.slow  # ~4s; restart semantics stay tier-1 via test_paged_kv restart coverage — keep tier-1 inside its timeout
 def test_restart_rebuilds_trie_with_store(lm_and_params):
     """The PR-5 bugfix: a warm restart must clear the prefix trie
     together with the slot mirrors/caches — a stale trie would 'hit' on

@@ -192,6 +192,7 @@ def test_spec_ngram_staggered_ragged_parity_and_zero_recompiles(
     assert "spec_accept_length_mean" in m
 
 
+@pytest.mark.slow  # ~7s; spec accept/verify parity stays tier-1 via the ngram + decode-window tests — keep tier-1 inside its timeout
 def test_spec_draft_model_parity(lm_and_params, draft_lm_and_params):
     """The draft-TransformerLM drafter: same parity bar, plus its two
     extra compiled programs pinned at one executable each (partial
@@ -458,6 +459,7 @@ def test_tp_spec_matches_solo_tp_generate():
 # --------------------------------------------------------------------- #
 
 
+@pytest.mark.slow  # ~6s; the dense decode_window twin + ngram spec parity stay tier-1 — keep tier-1 inside its timeout
 def test_decode_window_paged_parity(lm_and_params):
     """decode_window=n commits n tokens per dispatch through the SAME
     per-slot key splits — stream identical to the per-token program,

@@ -56,6 +56,7 @@ def test_label_smoothing_step(comm):
     assert float(loss_s) != float(loss_h)
 
 
+@pytest.mark.slow  # ~9s; BN batch-stats plumbing stays tier-1 via links_tests BatchNorm coverage — keep tier-1 inside its timeout
 def test_step_with_batch_stats(comm):
     model = ResNet(stage_sizes=[1, 1], width=4, num_classes=4,
                    compute_dtype=jnp.float32)
